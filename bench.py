@@ -1,0 +1,93 @@
+"""Benchmark: Gibbs iters/sec at BASELINE.json's north-star shape.
+
+North star (BASELINE.json): 1000 Gibbs iterations, p=10,000, 64 shards,
+in < 60 s at MATLAB-equivalent posterior Frobenius error.  This script runs
+that workload on whatever accelerator is visible (the driver runs it on one
+TPU chip; multi-chip scaling is exercised separately via the mesh tests and
+dryrun_multichip) and prints ONE JSON line:
+
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+vs_baseline is measured seconds / 60 s north-star budget (< 1.0 beats it).
+Accuracy is checked alongside: posterior Sigma relative Frobenius error on
+synthetic factor data must stay sane, so speed can't be bought with a broken
+sampler.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+# Benchmark shape: north-star config 3 (p=10k, 64 shards).  Overridable for
+# quick local runs: BENCH_P, BENCH_G, BENCH_N, BENCH_ITERS.
+P_TOTAL = int(os.environ.get("BENCH_P", 10_000))
+G = int(os.environ.get("BENCH_G", 64))
+N = int(os.environ.get("BENCH_N", 500))
+K_TOTAL = int(os.environ.get("BENCH_K", 512))     # 8 factors/shard
+ITERS = int(os.environ.get("BENCH_ITERS", 1000))
+BASELINE_SECONDS = 60.0
+
+
+def main():
+    import jax
+
+    from dcfm_tpu import BackendConfig, FitConfig, ModelConfig, RunConfig, fit
+
+    rng = np.random.default_rng(0)
+    # true rank must be coverable per shard: each shard sees all k_true
+    # factors, so factors_per_shard (= BENCH_K/BENCH_G) must be >= k_true.
+    k_true = 8
+    L = (rng.standard_normal((P_TOTAL, k_true)) / np.sqrt(k_true)).astype(np.float32)
+    F = rng.standard_normal((N, k_true)).astype(np.float32)
+    Y = F @ L.T + 0.3 * rng.standard_normal((N, P_TOTAL)).astype(np.float32)
+    Sigma_true = L @ L.T + 0.09 * np.eye(P_TOTAL, dtype=np.float32)
+
+    burnin = ITERS // 2
+    mcmc = ITERS - burnin
+    chunk = max(ITERS // 10, 1)
+    cfg = FitConfig(
+        model=ModelConfig(num_shards=G, factors_per_shard=K_TOTAL // G, rho=0.9),
+        run=RunConfig(burnin=burnin, mcmc=mcmc, thin=5, seed=0,
+                      chunk_size=chunk),
+        backend=BackendConfig(backend="auto"),
+    )
+
+    # Warm-up: one chunk-sized run on the same model config.  fit() caches
+    # jitted functions on (model, chunk_len) and the schedule enters as
+    # traced values, so the timed run below reuses this compilation exactly.
+    warm = FitConfig(model=cfg.model,
+                     run=RunConfig(burnin=chunk // 2, mcmc=chunk - chunk // 2,
+                                   thin=1, seed=0, chunk_size=chunk),
+                     backend=cfg.backend)
+    fit(Y, warm)
+
+    t0 = time.perf_counter()
+    res = fit(Y, cfg)
+    seconds = time.perf_counter() - t0
+
+    err = float(np.linalg.norm(res.Sigma - Sigma_true)
+                / np.linalg.norm(Sigma_true))
+    iters_per_sec = ITERS / seconds
+    result = {
+        "metric": f"Gibbs iters/sec/chip (p={P_TOTAL}, g={G}, n={N}, "
+                  f"k={K_TOTAL}, {ITERS} iters; rel frob err {err:.3f})",
+        "value": round(iters_per_sec, 2),
+        "unit": "iters/sec",
+        "vs_baseline": round(seconds / BASELINE_SECONDS, 4),
+    }
+    print(json.dumps(result))
+    # Accuracy guard: speed cannot be bought with a broken sampler.  The
+    # sample-covariance error at this n/p is ~0.2-0.3; a healthy posterior
+    # mean sits at or below that, and 2x it means regression.
+    if not np.isfinite(err) or err > 0.6:
+        print(f"ACCURACY REGRESSION: rel frob err {err:.3f} > 0.6",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,23 @@
+"""dcfm_tpu: TPU-native divide-and-conquer Bayesian factor models.
+
+A from-scratch JAX/XLA framework with the capabilities of the reference
+MATLAB implementation (gautam-sabnis/A-Divide-and-Conquer-Strategy-for-
+High-Dimensional-Bayesian-Factor-Models): Gibbs sampling for high-dimensional
+Bayesian factor models with MGP/horseshoe/Dirichlet-Laplace shrinkage priors,
+feature shards distributed over a TPU mesh, and blockwise posterior-mean
+covariance estimation.
+"""
+
+from dcfm_tpu.api import FitResult, divideconquer, fit
+from dcfm_tpu.config import (
+    BackendConfig, DLConfig, FitConfig, HorseshoeConfig, MGPConfig,
+    ModelConfig, RunConfig)
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "fit", "divideconquer", "FitResult",
+    "FitConfig", "ModelConfig", "RunConfig", "BackendConfig",
+    "MGPConfig", "HorseshoeConfig", "DLConfig",
+    "__version__",
+]

@@ -1,0 +1,164 @@
+"""Chain driver: jitted `lax.scan` over Gibbs sweeps with on-device
+accumulation of the posterior-mean covariance blocks.
+
+Replaces the reference's interpreted ``for iter = 1:N`` loop plus in-loop
+combine (``divideconquer.m:90,:180-196``).  The driver is written once and
+parameterized by (reduce_fn, gather_fn, shard_offset) so the identical code
+runs:
+
+* single-device: Gl = g, reduce = sum over axis 0, gather = identity;
+* mesh: inside ``shard_map``, reduce = local sum + psum, gather =
+  all_gather over the shard mesh axis.
+
+Accumulation happens on device in (Gl, G, P, P) row-panels - p^2/n_devices
+per device - and is stitched to the full p x p only on host
+(utils/estimate.py), which is what makes p = 50k feasible (SURVEY.md
+section 7 "the combine at p=10k-50k").
+"""
+
+from __future__ import annotations
+
+from typing import Callable, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from dcfm_tpu.config import ModelConfig, RunConfig
+from dcfm_tpu.models.conditionals import covariance_blocks, gibbs_sweep, local_sum
+from dcfm_tpu.models.priors import Prior
+from dcfm_tpu.models.state import SamplerState, init_state
+
+
+class ChainCarry(NamedTuple):
+    state: SamplerState
+    sigma_acc: jax.Array      # (Gl, G, P, P) running mean of Sigma row-panel
+    iteration: jax.Array      # scalar int32 - global Gibbs iteration count
+    health: jax.Array         # (Gl, 3) running [max|log tau|, min ps, max ps]
+                              # over every iteration seen (not just the last)
+
+
+class ChainStats(NamedTuple):
+    """Numerical-health diagnostics, running over all iterations seen
+    (SURVEY.md section 5 metrics)."""
+    tau_log_max: jax.Array    # max_h |log tau_h| seen - cumprod overflow watch
+    ps_min: jax.Array
+    ps_max: jax.Array
+
+
+def _health_now(state: SamplerState) -> jax.Array:
+    """(Gl, 3) health snapshot of one state."""
+    prior = state.prior
+    if isinstance(prior, dict) and "delta" in prior:
+        log_tau = jnp.cumsum(jnp.log(prior["delta"]), axis=-1)   # (Gl, K)
+        tau_log = jnp.max(jnp.abs(log_tau), axis=-1)
+    else:
+        tau_log = jnp.zeros(state.ps.shape[0], state.ps.dtype)
+    return jnp.stack(
+        [tau_log, jnp.min(state.ps, axis=-1), jnp.max(state.ps, axis=-1)],
+        axis=-1)
+
+
+def _health_init(num_local_shards: int, dtype) -> jax.Array:
+    return jnp.broadcast_to(
+        jnp.asarray([0.0, jnp.inf, 0.0], dtype), (num_local_shards, 3))
+
+
+def _health_update(running: jax.Array, now: jax.Array) -> jax.Array:
+    return jnp.stack([
+        jnp.maximum(running[:, 0], now[:, 0]),
+        jnp.minimum(running[:, 1], now[:, 1]),
+        jnp.maximum(running[:, 2], now[:, 2])], axis=-1)
+
+
+def schedule_array(run: RunConfig) -> jax.Array:
+    """Pack (burnin, thin, 1/num_saved) as a traced float32 triple so the
+    jitted chunk function is schedule-agnostic (no recompile per RunConfig)."""
+    eff = max(run.num_saved, 1)
+    return jnp.asarray([run.burnin, run.thin, 1.0 / eff], jnp.float32)
+
+
+def init_chain(
+    key: jax.Array,
+    Y: jax.Array,
+    cfg: ModelConfig,
+    prior: Prior,
+    *,
+    num_global_shards: int,
+    shard_offset=0,
+    dtype=jnp.float32,
+) -> ChainCarry:
+    Gl, n, P = Y.shape
+    K = cfg.factors_per_shard
+    state = init_state(
+        key, prior, num_local_shards=Gl, n=n, P=P, K=K,
+        as_=cfg.as_, bs=cfg.bs, shard_offset=shard_offset, dtype=dtype)
+    sigma_acc = jnp.zeros((Gl, num_global_shards, P, P), dtype)
+    return ChainCarry(state=state, sigma_acc=sigma_acc,
+                      iteration=jnp.zeros((), jnp.int32),
+                      health=_health_init(Gl, dtype))
+
+
+def run_chunk(
+    key: jax.Array,
+    Y: jax.Array,
+    carry: ChainCarry,
+    sched: jax.Array,
+    cfg: ModelConfig,
+    prior: Prior,
+    *,
+    num_iters: int,
+    shard_offset=0,
+    reduce_fn: Callable = local_sum,
+    gather_fn: Callable = lambda x: x,
+) -> tuple[ChainCarry, ChainStats]:
+    """Run ``num_iters`` Gibbs iterations from ``carry`` under one scan.
+
+    ``sched`` packs the chain schedule as traced values
+    (see :func:`schedule_array`) so one compilation serves any
+    burnin/thin/num_saved - only ``num_iters`` (the scan length) and the
+    model config are compile-time static.
+
+    Accumulates Sigma row-panels on every thin-th post-burn-in draw with the
+    running-mean weight 1/num_saved (reference ``divideconquer.m:194``).
+    ``lax.cond`` skips the O(p^2 K / g) block work on non-saved iterations,
+    so burn-in costs only the sweep.
+    """
+    burnin = sched[0].astype(jnp.int32)
+    thin = sched[1].astype(jnp.int32)
+    inv_eff = sched[2]
+
+    def body(carry: ChainCarry, it_key: jax.Array) -> tuple[ChainCarry, None]:
+        state = gibbs_sweep(
+            it_key, Y, carry.state, cfg, prior,
+            shard_offset=shard_offset, reduce_fn=reduce_fn)
+        it = carry.iteration + 1  # 1-based, like the reference
+
+        def accumulate(acc):
+            Lam_all = gather_fn(state.Lambda)
+            if cfg.estimator == "scaled":
+                eta = (jnp.sqrt(cfg.rho) * state.X[None]
+                       + jnp.sqrt(1.0 - cfg.rho) * state.Z)
+                eta_all = gather_fn(eta)
+            else:
+                eta = eta_all = None
+            blocks = covariance_blocks(
+                state.Lambda, state.ps, Lam_all, cfg.rho, shard_offset,
+                eta_local=eta, eta_all=eta_all)
+            return acc + blocks * inv_eff
+
+        save = jnp.logical_and(it > burnin, (it - burnin) % thin == 0)
+        sigma_acc = lax.cond(save, accumulate, lambda a: a, carry.sigma_acc)
+        health = _health_update(carry.health, _health_now(state))
+        return ChainCarry(state, sigma_acc, it, health), None
+
+    keys = jax.vmap(lambda i: jax.random.fold_in(key, i))(
+        carry.iteration + jnp.arange(num_iters))
+    carry, _ = lax.scan(body, carry, keys)
+
+    stats = ChainStats(
+        tau_log_max=jnp.max(carry.health[:, 0]),
+        ps_min=jnp.min(carry.health[:, 1]),
+        ps_max=jnp.max(carry.health[:, 2]),
+    )
+    return carry, stats

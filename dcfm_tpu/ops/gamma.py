@@ -1,0 +1,31 @@
+"""Gamma-family samplers, rate convention throughout.
+
+The reference's ``gamrnd(shape, scale)`` calls mix conventions: scale at init
+(``divideconquer.m:83``) vs 1/rate at update time (``:150,:158,:170``) -
+quirk Q8.  Here every sampler takes (shape, rate); ``jax.random.gamma``
+draws Gamma(shape, 1) and we divide by rate.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def gamma_rate(key: jax.Array, shape, rate, *, sample_shape=None) -> jax.Array:
+    """Gamma(shape, rate) draws; broadcasts shape/rate like NumPy."""
+    shape = jnp.asarray(shape)
+    rate = jnp.asarray(rate)
+    out_shape = sample_shape
+    if out_shape is None:
+        out_shape = jnp.broadcast_shapes(shape.shape, rate.shape)
+    g = jax.random.gamma(key, jnp.broadcast_to(shape, out_shape))
+    return g / jnp.broadcast_to(rate, out_shape)
+
+
+def inverse_gamma_rate(key: jax.Array, shape, scale, *, sample_shape=None) -> jax.Array:
+    """InvGamma(shape, scale): 1/x with x ~ Gamma(shape, rate=scale).
+
+    Used by the horseshoe prior's Makalic-Schmidt auxiliary conditionals.
+    """
+    return 1.0 / gamma_rate(key, shape, scale, sample_shape=sample_shape)

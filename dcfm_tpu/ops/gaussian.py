@@ -1,0 +1,99 @@
+"""Precision-form Gaussian samplers: factor once, solve many.
+
+This is the kernel that replaces all three hot loops of the reference sweep
+(SURVEY.md section 3.2):
+
+* Z update (``divideconquer.m:95-108``): one K x K precision shared by all n
+  observations, sampled in a per-observation MATLAB loop -> here a single
+  Cholesky + one batched triangular solve over the n axis.
+* X update (``divideconquer.m:111-129``): same shape, same fix.
+* Lambda update (``divideconquer.m:136-146``): P *different* K x K precisions,
+  one per loading row -> a batched (vmapped) Cholesky-sample; rows are
+  conditionally independent given eta.
+
+Sampling rule (Rue 2001): to draw from N(Q^{-1} b, Q^{-1}) with Q = L L',
+solve L v = b, L' m = v for the mean, then L' y = z with z ~ N(0, I) and
+return m + y.  The reference gets this right for Lambda (``chol(Q,'lower')``,
+``:142-144``) but pairs an *upper* factor from ``cholcov`` with the
+lower-factor solve order in the Z/X updates (``:100,:104`` and ``:118,:126``)
+- quirk Q2.  Here one correct lower-Cholesky code path serves all three.
+
+Everything is pure, shape-static, and dtype-preserving; float32 is the
+working precision (K x K Cholesky in bf16 is unusable - SURVEY.md section 7).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _tri_solve(L: jax.Array, b: jax.Array, *, trans: bool) -> jax.Array:
+    """Solve L x = b (trans=False) or L' x = b (trans=True), L lower-triangular.
+
+    b may be (..., K) or (..., K, m); leading batch dims must match L's.
+    """
+    vec = b.ndim == L.ndim - 1
+    if vec:
+        b = b[..., None]
+    x = lax.linalg.triangular_solve(
+        L, b, left_side=True, lower=True, transpose_a=trans)
+    return x[..., 0] if vec else x
+
+
+def sample_mvn_precision_shared(
+    key: jax.Array,
+    Q: jax.Array,
+    B: jax.Array,
+) -> jax.Array:
+    """Draw rows x_i ~ N(Q^{-1} b_i, Q^{-1}) for a *shared* precision Q.
+
+    Args:
+      key: PRNG key.
+      Q: (K, K) SPD precision matrix, shared across all rows.
+      B: (n, K) stacked linear terms b_i.
+
+    Returns:
+      (n, K) samples.  One Cholesky, two batched triangular solves, one
+      normal draw - this is the factor-once/solve-many pattern that maps the
+      reference's per-observation loops onto the MXU.
+    """
+    L = lax.linalg.cholesky(Q)                       # (K, K) lower
+    # Solve for all means at once: L V' = B', L' M' = V'.
+    V = _tri_solve(L, B.T, trans=False)              # (K, n)
+    M = _tri_solve(L, V, trans=True)                 # (K, n)
+    Zn = jax.random.normal(key, B.shape, B.dtype)    # (n, K)
+    Yn = _tri_solve(L, Zn.T, trans=True)             # (K, n)
+    return (M + Yn).T
+
+
+def sample_mvn_precision_batched(
+    key: jax.Array,
+    Q: jax.Array,
+    B: jax.Array,
+) -> jax.Array:
+    """Draw x_j ~ N(Q_j^{-1} b_j, Q_j^{-1}) for *per-row* precisions.
+
+    Args:
+      key: PRNG key.
+      Q: (P, K, K) SPD precisions, one per row.
+      B: (P, K) linear terms.
+
+    Returns:
+      (P, K) samples.  Batched Cholesky + batched solves; XLA tiles the
+      small-K factorizations across rows (the Lambda-update hot kernel, C10).
+    """
+    L = lax.linalg.cholesky(Q)                       # (P, K, K)
+    V = _tri_solve(L, B, trans=False)                # (P, K)
+    M = _tri_solve(L, V, trans=True)
+    Zn = jax.random.normal(key, B.shape, B.dtype)
+    Yn = _tri_solve(L, Zn, trans=True)
+    return M + Yn
+
+
+def mvn_mean_precision(Q: jax.Array, B: jax.Array) -> jax.Array:
+    """Posterior mean Q^{-1} b_i for shared Q - used by moment tests."""
+    L = lax.linalg.cholesky(Q)
+    V = _tri_solve(L, B.T, trans=False)
+    return _tri_solve(L, V, trans=True).T

@@ -1,0 +1,47 @@
+"""Device-mesh utilities for the shard axis.
+
+The divide-and-conquer shard axis is the framework's one model-parallel
+axis (SURVEY.md section 2, parallelism inventory): shard m's state lives on
+device m (or, when g > n_devices, a vmap-batch of g/n_devices shards per
+device - the config-5 "256 shards on 8 cores" layout).  Cross-shard traffic
+is exactly two psums per sweep (K x K and n x K, the X update) plus one
+all_gather of (P, K) loadings per saved draw - all riding ICI.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+SHARD_AXIS = "shards"
+
+
+def make_mesh(num_devices: int = 0, devices=None) -> Mesh:
+    """1-D mesh over the shard axis.  num_devices=0 -> all available."""
+    if devices is None:
+        devices = jax.devices()
+    if num_devices:
+        if num_devices > len(devices):
+            raise ValueError(
+                f"requested {num_devices} devices, have {len(devices)}")
+        devices = devices[:num_devices]
+    return Mesh(np.array(devices), (SHARD_AXIS,))
+
+
+def shards_per_device(num_shards: int, mesh: Mesh) -> int:
+    d = mesh.shape[SHARD_AXIS]
+    if num_shards % d != 0:
+        raise ValueError(
+            f"g={num_shards} shards must divide over {d} mesh devices; "
+            "choose g as a multiple of the mesh size")
+    return num_shards // d
+
+
+def shard_spec() -> P:
+    """PartitionSpec for arrays with a leading global-shard axis."""
+    return P(SHARD_AXIS)
+
+
+def replicated_spec() -> P:
+    return P()

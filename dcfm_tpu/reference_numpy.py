@@ -1,0 +1,138 @@
+"""Serial NumPy twin of the corrected sampler - the parity oracle.
+
+SURVEY.md section 4 ("Numerical parity"): an independent, loop-based NumPy
+implementation of the *same corrected math* as the JAX sweep (Q1-Q4 fixed:
+precision weighting, lower-Cholesky sampling, per-shard delta indexing).
+It shares no code with dcfm_tpu.models - deliberately, so a bug must be made
+twice to pass the cross-check.  Used by tests to compare posterior moments
+chain-to-chain; never used in production paths.
+
+Math per SURVEY.md section 0.3 (reference ``divideconquer.m:90-196``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def gibbs_numpy(
+    Yd: np.ndarray,          # (g, n, P) standardized shard-major data
+    K: int,
+    rho: float,
+    burnin: int,
+    mcmc: int,
+    thin: int = 1,
+    *,
+    seed: int = 0,
+    as_: float = 1.0,
+    bs: float = 0.3,
+    df: float = 3.0,
+    ad1: float = 2.0,
+    bd1: float = 1.0,
+    ad2: float = 2.0,
+    bd2: float = 1.0,
+    x_prior_precision: float = 1.0,
+    estimator: str = "scaled",
+):
+    """Returns (Sigma_blocks (g,g,P,P) posterior mean, final state dict)."""
+    rng = np.random.default_rng(seed)
+    g, n, P = Yd.shape
+    sr, s1 = np.sqrt(rho), np.sqrt(1 - rho)
+
+    # init (reference :68-87, rate convention)
+    ps = rng.gamma(as_, 1 / bs, size=(g, P))
+    Lam = np.zeros((g, P, K))
+    X = rng.standard_normal((n, K))
+    Z = rng.standard_normal((g, n, K))
+    psijh = rng.gamma(df / 2, 2 / df, size=(g, P, K))
+    delta = np.concatenate(
+        [rng.gamma(ad1, 1 / bd1, size=(g, 1)),
+         rng.gamma(ad2, 1 / bd2, size=(g, K - 1))], axis=1)
+
+    eff = max(mcmc // thin, 1)
+    Sig_acc = np.zeros((g, g, P, P))
+
+    def sample_mvn_prec(Q, B):
+        # rows ~ N(Q^{-1} b, Q^{-1}); B is (m, K)
+        L = np.linalg.cholesky(Q)
+        V = np.linalg.solve(L, B.T)
+        M = np.linalg.solve(L.T, V).T
+        Zr = rng.standard_normal(B.shape)
+        Yr = np.linalg.solve(L.T, Zr.T).T
+        return M + Yr
+
+    for it in range(1, burnin + mcmc + 1):
+        tauh = np.cumprod(delta, axis=1)          # (g, K)
+
+        # Z | rest
+        for m in range(g):
+            W = Lam[m] * ps[m][:, None]
+            Q = np.eye(K) + (1 - rho) * Lam[m].T @ W
+            R = Yd[m] - sr * X @ Lam[m].T
+            Z[m] = sample_mvn_prec(Q, s1 * (R @ W))
+
+        # X | rest (cross-shard sums)
+        S1 = np.zeros((K, K))
+        S2 = np.zeros((n, K))
+        for m in range(g):
+            W = Lam[m] * ps[m][:, None]
+            S1 += Lam[m].T @ W
+            S2 += (Yd[m] - s1 * Z[m] @ Lam[m].T) @ W
+        Qx = x_prior_precision * np.eye(K) + rho * S1
+        X = sample_mvn_prec(Qx, sr * S2)
+
+        eta = sr * X[None] + s1 * Z               # (g, n, K)
+
+        # Lambda | rest (per row)
+        for m in range(g):
+            E = eta[m].T @ eta[m]
+            EY = eta[m].T @ Yd[m]                 # (K, P)
+            plam = psijh[m] * tauh[m][None, :]
+            for j in range(P):
+                Q = np.diag(plam[j]) + ps[m, j] * E
+                Lam[m, j] = sample_mvn_prec(Q, (ps[m, j] * EY[:, j])[None])[0]
+
+        # psi | rest
+        tauh = np.cumprod(delta, axis=1)
+        for m in range(g):
+            rate = df / 2 + 0.5 * tauh[m][None, :] * Lam[m] ** 2
+            psijh[m] = rng.gamma(df / 2 + 0.5, 1.0) / rate
+
+        # delta | rest (sequential, per shard - Q4 fixed)
+        for m in range(g):
+            s = np.sum(psijh[m] * Lam[m] ** 2, axis=0)   # (K,)
+            for h in range(K):
+                tauh_m = np.cumprod(delta[m])
+                tau_minus = tauh_m / delta[m, h]
+                if h == 0:
+                    shape = ad1 + 0.5 * P * K
+                    rate = bd1 + 0.5 * np.sum(tau_minus * s)
+                else:
+                    shape = ad2 + 0.5 * P * (K - h)
+                    rate = bd2 + 0.5 * np.sum(tau_minus[h:] * s[h:])
+                delta[m, h] = rng.gamma(shape, 1.0) / rate
+
+        # ps | rest
+        for m in range(g):
+            resid = Yd[m] - eta[m] @ Lam[m].T
+            rate = bs + 0.5 * np.sum(resid ** 2, axis=0)
+            ps[m] = rng.gamma(as_ + 0.5 * n, 1.0, size=P) / rate
+
+        # combine (reference :180-196; "scaled" uses the draws' empirical
+        # factor cross-moments H_rc = eta_r'eta_c/n - see covariance_blocks)
+        if it > burnin and (it - burnin) % thin == 0:
+            for r in range(g):
+                for c in range(g):
+                    if estimator == "scaled":
+                        H = eta[r].T @ eta[c] / n
+                        blk = Lam[r] @ H @ Lam[c].T
+                    elif r == c:
+                        blk = Lam[r] @ Lam[r].T
+                    else:
+                        blk = rho * Lam[r] @ Lam[c].T
+                    if r == c:
+                        blk = blk + np.diag(1 / ps[r])
+                    Sig_acc[r, c] += blk / eff
+
+    state = dict(Lam=Lam, Z=Z, X=X, ps=ps, psijh=psijh, delta=delta)
+    return Sig_acc, state

@@ -1,0 +1,40 @@
+"""Test harness: force an 8-virtual-device CPU platform BEFORE jax import.
+
+This is the distributed-without-a-cluster strategy from SURVEY.md section 4:
+`shard_map`/`psum`/`all_gather` paths run in CI on
+``--xla_force_host_platform_device_count=8`` CPU devices, so the mesh code
+is exercised without TPUs.  Benchmarks (bench.py) run on the real chip and
+do NOT import this conftest.
+"""
+
+import os
+
+# The TPU image's sitecustomize imports jax at interpreter startup, so env
+# vars are too late here - but the backend is not initialized until first
+# use, so jax.config still wins.  XLA_FLAGS is read at backend init.
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)
+
+
+def make_synthetic(n, p, k_true, *, noise=0.2, seed=0):
+    """Y = F L' + eps with known Sigma = L L' + noise^2 I."""
+    r = np.random.default_rng(seed)
+    L = r.normal(size=(p, k_true)) / np.sqrt(k_true)
+    F = r.normal(size=(n, k_true))
+    Y = F @ L.T + noise * r.normal(size=(n, p))
+    Sigma_true = L @ L.T + noise**2 * np.eye(p)
+    return Y.astype(np.float32), Sigma_true.astype(np.float32)

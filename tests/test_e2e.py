@@ -1,0 +1,120 @@
+"""End-to-end statistical integration tests (SURVEY.md section 4).
+
+Synthetic Sigma = L L' + noise^2 I recovery within Frobenius tolerance, the
+NumPy-twin parity cross-check, and the mesh-vs-single-device equivalence.
+"""
+
+import numpy as np
+import pytest
+
+from tests.conftest import make_synthetic
+
+from dcfm_tpu import (
+    BackendConfig, FitConfig, ModelConfig, RunConfig, divideconquer, fit)
+from dcfm_tpu.reference_numpy import gibbs_numpy
+from dcfm_tpu.utils.estimate import stitch_blocks
+from dcfm_tpu.utils.preprocess import preprocess, restore_covariance
+
+
+def _rel_frob(A, B):
+    return np.linalg.norm(A - B) / np.linalg.norm(B)
+
+
+def test_single_shard_recovers_sigma():
+    """Config-1-like: g=1, p=64, k=5 - posterior mean close to truth."""
+    Y, St = make_synthetic(200, 64, 4, seed=1)
+    cfg = FitConfig(
+        model=ModelConfig(num_shards=1, factors_per_shard=5, rho=0.5),
+        run=RunConfig(burnin=300, mcmc=300, thin=1, seed=0))
+    res = fit(Y, cfg)
+    assert res.Sigma.shape == (64, 64)
+    assert _rel_frob(res.Sigma, St) < 0.25
+    # diagnostics populated and finite
+    assert np.isfinite(res.stats.tau_log_max)
+    assert res.stats.ps_min > 0
+
+
+def test_multishard_recovers_sigma():
+    Y, St = make_synthetic(150, 96, 4, seed=3)
+    cfg = FitConfig(
+        model=ModelConfig(num_shards=4, factors_per_shard=4, rho=0.95),
+        run=RunConfig(burnin=300, mcmc=300, thin=2, seed=0))
+    res = fit(Y, cfg)
+    err = _rel_frob(res.Sigma, St)
+    # D&C approximates cross-blocks by rho*Lam_r Hx Lam_c'; looser than g=1
+    assert err < 0.35
+    # diagonal entries (variances) must be solid regardless
+    diag_err = _rel_frob(np.diag(np.diag(res.Sigma)), np.diag(np.diag(St)))
+    assert diag_err < 0.2
+
+
+def test_parity_with_numpy_twin():
+    """JAX sampler and the independent NumPy twin agree statistically on the
+    posterior-mean covariance (same model, different RNG streams)."""
+    Y, _ = make_synthetic(120, 48, 3, seed=5)
+    g, K, rho = 2, 3, 0.7
+    pre = preprocess(Y, g, seed=0)
+    blocks_np, _ = gibbs_numpy(
+        pre.data.astype(np.float64), K, rho, 400, 400, seed=1)
+    cfg = FitConfig(
+        model=ModelConfig(num_shards=g, factors_per_shard=K, rho=rho),
+        run=RunConfig(burnin=400, mcmc=400, thin=1, seed=0))
+    res = fit(Y, cfg)
+    S_np = stitch_blocks(blocks_np)
+    S_jx = stitch_blocks(res.sigma_blocks.astype(np.float64))
+    assert _rel_frob(S_jx, S_np) < 0.05
+
+
+def test_chunked_run_matches_single_scan():
+    """chunk_size must not change the chain (global-iteration RNG keys)."""
+    Y, _ = make_synthetic(60, 32, 3, seed=7)
+    m = ModelConfig(num_shards=2, factors_per_shard=3, rho=0.5)
+    r1 = RunConfig(burnin=40, mcmc=40, thin=1, seed=0)
+    r2 = RunConfig(burnin=40, mcmc=40, thin=1, seed=0, chunk_size=17)
+    res1 = fit(Y, FitConfig(model=m, run=r1))
+    res2 = fit(Y, FitConfig(model=m, run=r2))
+    np.testing.assert_allclose(
+        res1.sigma_blocks, res2.sigma_blocks, rtol=1e-4, atol=1e-5)
+
+
+def test_divideconquer_compat_entrypoint():
+    """Reference-shaped API (divideconquer.m:1): 7 positional args."""
+    Y, St = make_synthetic(100, 40, 3, seed=9)
+    S = divideconquer(Y, 2, 6, 100, 100, 1, 0.8, seed=0)
+    assert S.shape == (40, 40)
+    np.testing.assert_allclose(S, S.T, atol=1e-5)
+    assert _rel_frob(S, St) < 1.0
+
+
+def test_zero_columns_reinserted_in_output():
+    """fit() returns (p, p) with zero rows/cols at all-zero input columns."""
+    Y, _ = make_synthetic(60, 20, 2, seed=13)
+    Y[:, 5] = 0.0
+    cfg = FitConfig(
+        model=ModelConfig(num_shards=2, factors_per_shard=2, rho=0.5),
+        run=RunConfig(burnin=20, mcmc=20, thin=1, seed=0))
+    res = fit(Y, cfg)
+    assert res.Sigma.shape == (20, 20)
+    assert np.all(res.Sigma[5, :] == 0) and np.all(res.Sigma[:, 5] == 0)
+    assert res.Sigma[6, 6] > 0
+
+
+def test_run_config_validation():
+    Y, _ = make_synthetic(30, 8, 2, seed=0)
+    m = ModelConfig(num_shards=2, factors_per_shard=2, rho=0.5)
+    for bad in [RunConfig(burnin=5, mcmc=5, thin=0),
+                RunConfig(burnin=-1, mcmc=5),
+                RunConfig(burnin=0, mcmc=0)]:
+        with pytest.raises(ValueError):
+            fit(Y, FitConfig(model=m, run=bad))
+
+
+def test_horseshoe_prior_runs():
+    Y, St = make_synthetic(100, 48, 3, seed=11)
+    cfg = FitConfig(
+        model=ModelConfig(num_shards=2, factors_per_shard=3, rho=0.8,
+                          prior="horseshoe"),
+        run=RunConfig(burnin=200, mcmc=200, thin=1, seed=0))
+    res = fit(Y, cfg)
+    assert np.isfinite(res.Sigma).all()
+    assert _rel_frob(res.Sigma, St) < 1.0

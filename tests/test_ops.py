@@ -1,0 +1,78 @@
+"""Kernel-layer tests: precision-form Gaussian samplers and Gamma convention.
+
+These pin the corrected linear algebra (quirk Q2: the reference pairs an
+upper Cholesky factor with a lower-factor solve order in its Z/X updates).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dcfm_tpu.ops.gamma import gamma_rate, inverse_gamma_rate
+from dcfm_tpu.ops.gaussian import (
+    mvn_mean_precision,
+    sample_mvn_precision_batched,
+    sample_mvn_precision_shared,
+)
+
+
+def _random_spd(rng, K, scale=1.0):
+    A = rng.normal(size=(K, K))
+    return (A @ A.T + K * np.eye(K)) * scale
+
+
+def test_mean_precision_solves_correctly(rng):
+    K, n = 5, 7
+    Q = _random_spd(rng, K)
+    B = rng.normal(size=(n, K))
+    M = mvn_mean_precision(jnp.asarray(Q), jnp.asarray(B))
+    np.testing.assert_allclose(np.asarray(M), np.linalg.solve(Q, B.T).T,
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_shared_sampler_moments(rng):
+    """Empirical mean/cov of many draws match N(Q^{-1}b, Q^{-1})."""
+    K = 3
+    Q = _random_spd(rng, K)
+    b = rng.normal(size=K)
+    n = 40000
+    B = jnp.broadcast_to(jnp.asarray(b), (n, K))
+    draws = np.asarray(sample_mvn_precision_shared(jax.random.key(0), jnp.asarray(Q), B))
+    mean_expect = np.linalg.solve(Q, b)
+    cov_expect = np.linalg.inv(Q)
+    np.testing.assert_allclose(draws.mean(0), mean_expect, atol=4 * np.sqrt(
+        np.max(cov_expect.diagonal()) / n) * 3)
+    np.testing.assert_allclose(np.cov(draws.T), cov_expect, atol=0.05)
+
+
+def test_batched_sampler_moments(rng):
+    """Per-row precisions: each row's draws follow its own Gaussian."""
+    K, P = 3, 2
+    Qs = np.stack([_random_spd(rng, K), _random_spd(rng, K, 4.0)])
+    bs = rng.normal(size=(P, K))
+    reps = 20000
+    keys = jax.random.split(jax.random.key(1), reps)
+    draws = np.asarray(jax.vmap(
+        lambda k: sample_mvn_precision_batched(k, jnp.asarray(Qs), jnp.asarray(bs))
+    )(keys))  # (reps, P, K)
+    for j in range(P):
+        mean_expect = np.linalg.solve(Qs[j], bs[j])
+        cov_expect = np.linalg.inv(Qs[j])
+        np.testing.assert_allclose(draws[:, j].mean(0), mean_expect, atol=0.05)
+        np.testing.assert_allclose(np.cov(draws[:, j].T), cov_expect, atol=0.05)
+
+
+def test_gamma_rate_convention():
+    """Gamma(shape, rate): mean = shape/rate, var = shape/rate^2 (quirk Q8)."""
+    shape, rate = 2.5, 4.0
+    x = np.asarray(gamma_rate(jax.random.key(2), shape, rate,
+                              sample_shape=(200000,)))
+    np.testing.assert_allclose(x.mean(), shape / rate, rtol=0.02)
+    np.testing.assert_allclose(x.var(), shape / rate**2, rtol=0.05)
+
+
+def test_inverse_gamma():
+    shape, scale = 3.0, 2.0
+    x = np.asarray(inverse_gamma_rate(jax.random.key(3), shape, scale,
+                                      sample_shape=(200000,)))
+    np.testing.assert_allclose(x.mean(), scale / (shape - 1), rtol=0.02)

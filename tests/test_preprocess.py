@@ -1,0 +1,78 @@
+"""Data layer tests: filter/shard/standardize and their inverses (C2-C4)."""
+
+import numpy as np
+import pytest
+
+from dcfm_tpu.utils.estimate import stitch_blocks
+from dcfm_tpu.utils.preprocess import preprocess, restore_covariance
+
+
+def test_shapes_and_shard_layout(rng):
+    Y = rng.normal(size=(50, 24))
+    pre = preprocess(Y, 4, seed=1)
+    assert pre.data.shape == (4, 50, 6)
+    assert pre.n_pad == 0
+    # column j of the shard layout is original kept column perm[j], standardized
+    flat = pre.data.transpose(1, 0, 2).reshape(50, 24)
+    expect = (Y[:, pre.perm] - Y[:, pre.perm].mean(0)) / Y[:, pre.perm].std(0, ddof=1)
+    np.testing.assert_allclose(flat, expect, rtol=1e-4, atol=1e-4)
+
+
+def test_zero_column_filter(rng):
+    Y = rng.normal(size=(30, 10))
+    Y[:, [2, 7]] = 0.0
+    pre = preprocess(Y, 2, seed=0)
+    assert list(pre.zero_cols) == [2, 7]
+    assert pre.data.shape == (2, 30, 4)  # 8 kept columns
+
+
+def test_padding_when_not_divisible(rng):
+    Y = rng.normal(size=(30, 10))
+    pre = preprocess(Y, 4, seed=0)
+    assert pre.n_pad == 2
+    assert pre.p_used == 12
+    assert pre.data.shape == (4, 30, 3)
+    with pytest.raises(ValueError):
+        preprocess(Y, 4, pad_to_shards=False)
+
+
+def test_restore_covariance_roundtrip(rng):
+    """A covariance built in shard coordinates maps back to caller order."""
+    n, p, g = 200, 12, 3
+    Y = rng.normal(size=(n, p))
+    pre = preprocess(Y, g, seed=3)
+    # "true" covariance in shard coords: identity -> caller coords must be
+    # diag(scale^2)
+    S_shard = np.eye(pre.p_used, dtype=np.float32)
+    S = restore_covariance(S_shard, pre)
+    scale = pre.col_scale.reshape(-1)[pre.inv_perm]
+    np.testing.assert_allclose(S, np.diag(scale**2), rtol=1e-5)
+    # without destandardization: plain permutation inverse
+    S2 = restore_covariance(S_shard, pre, destandardize=False)
+    np.testing.assert_allclose(S2, np.eye(p), rtol=1e-6)
+
+
+def test_restore_covariance_drops_padding_and_reinserts_zeros(rng):
+    Y = rng.normal(size=(40, 10))
+    Y[:, 4] = 0.0  # 9 kept -> pad 3 for g=4
+    pre = preprocess(Y, 4, seed=0)
+    assert pre.n_pad == 3
+    S_shard = np.arange(pre.p_used**2, dtype=np.float64).reshape(
+        pre.p_used, pre.p_used)
+    S = restore_covariance(S_shard, pre, destandardize=False)
+    assert S.shape == (9, 9)
+    full = restore_covariance(S_shard, pre, destandardize=False,
+                              reinsert_zero_cols=True)
+    assert full.shape == (10, 10)
+    assert np.all(full[4, :] == 0) and np.all(full[:, 4] == 0)
+    np.testing.assert_allclose(np.delete(np.delete(full, 4, 0), 4, 1), S)
+
+
+def test_stitch_blocks():
+    g, P = 3, 2
+    blocks = np.random.default_rng(0).normal(size=(g, g, P, P))
+    S = stitch_blocks(blocks)
+    assert S.shape == (6, 6)
+    np.testing.assert_allclose(S, S.T)
+    sym = 0.5 * (blocks[1, 2] + blocks[2, 1].T)
+    np.testing.assert_allclose(S[2:4, 4:6], sym)

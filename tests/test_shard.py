@@ -1,0 +1,95 @@
+"""Mesh-parallel tests on the 8-virtual-device CPU mesh (SURVEY.md section 4
+"Distributed-without-a-cluster").
+
+Exercises the real `shard_map` code path: psum in the X update, all_gather
+in the combine, per-device RNG offsets - and pins that it reproduces the
+single-device vmap layout (which is itself pinned to the NumPy twin).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tests.conftest import make_synthetic
+
+from dcfm_tpu import BackendConfig, FitConfig, ModelConfig, RunConfig, fit
+from dcfm_tpu.models.conditionals import local_sum
+from dcfm_tpu.parallel.mesh import SHARD_AXIS, make_mesh, shards_per_device
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 virtual CPU devices")
+
+
+def _run(Y, m, r, mesh_devices=0):
+    return fit(Y, FitConfig(
+        model=m, run=r, backend=BackendConfig(mesh_devices=mesh_devices)))
+
+
+def test_mesh_matches_vmap_one_shard_per_device():
+    Y, _ = make_synthetic(80, 160, 4, seed=2)
+    m = ModelConfig(num_shards=8, factors_per_shard=3, rho=0.9)
+    r = RunConfig(burnin=30, mcmc=30, thin=1, seed=0)
+    res1 = _run(Y, m, r)
+    res8 = _run(Y, m, r, mesh_devices=8)
+    np.testing.assert_allclose(
+        res1.sigma_blocks, res8.sigma_blocks, rtol=1e-3, atol=1e-4)
+    # final states match too (same RNG lineage on both layouts)
+    np.testing.assert_allclose(
+        np.asarray(res1.state.Lambda), np.asarray(res8.state.Lambda),
+        rtol=1e-3, atol=1e-4)
+
+
+def test_mesh_matches_vmap_multiple_shards_per_device():
+    """config-5 layout: more shards than devices -> vmap within shard_map."""
+    Y, _ = make_synthetic(60, 160, 4, seed=4)
+    m = ModelConfig(num_shards=16, factors_per_shard=2, rho=0.8)
+    r = RunConfig(burnin=20, mcmc=20, thin=1, seed=1)
+    res1 = _run(Y, m, r)
+    res8 = _run(Y, m, r, mesh_devices=8)
+    np.testing.assert_allclose(
+        res1.sigma_blocks, res8.sigma_blocks, rtol=1e-3, atol=1e-4)
+
+
+def test_mesh_with_two_devices():
+    Y, _ = make_synthetic(50, 64, 3, seed=6)
+    m = ModelConfig(num_shards=4, factors_per_shard=2, rho=0.7)
+    r = RunConfig(burnin=15, mcmc=15, thin=1, seed=2)
+    res1 = _run(Y, m, r)
+    res2 = _run(Y, m, r, mesh_devices=2)
+    np.testing.assert_allclose(
+        res1.sigma_blocks, res2.sigma_blocks, rtol=1e-3, atol=1e-4)
+
+
+def test_psum_equals_serial_sum():
+    """Property test from SURVEY.md section 4: the mesh psum equals the
+    serial over-shards sum the reference computes at divideconquer.m:112-116.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from dcfm_tpu.parallel.shard import shard_map
+
+    mesh = make_mesh(8)
+    x = np.random.default_rng(0).normal(size=(8, 4, 5)).astype(np.float32)
+
+    def f(xl):
+        return jax.lax.psum(jnp.sum(xl, axis=0), SHARD_AXIS)
+
+    out = jax.jit(shard_map(
+        f, mesh=mesh, in_specs=P(SHARD_AXIS), out_specs=P()))(x)
+    np.testing.assert_allclose(np.asarray(out), x.sum(0), rtol=1e-5)
+
+
+def test_shards_per_device_validation():
+    mesh = make_mesh(8)
+    assert shards_per_device(16, mesh) == 2
+    with pytest.raises(ValueError):
+        shards_per_device(12, mesh)
+
+
+def test_mesh_requires_enough_devices():
+    Y, _ = make_synthetic(30, 32, 2, seed=8)
+    m = ModelConfig(num_shards=4, factors_per_shard=2, rho=0.5)
+    r = RunConfig(burnin=5, mcmc=5, thin=1, seed=0)
+    with pytest.raises(ValueError, match="mesh_devices"):
+        _run(Y, m, r, mesh_devices=64)
