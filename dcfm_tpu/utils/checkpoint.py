@@ -1,0 +1,143 @@
+"""Checkpoint / resume for the Gibbs chain.
+
+The reference persists nothing - a crash loses the whole chain, whose state
+lives only in MATLAB locals (SURVEY.md section 5, "Checkpoint / resume:
+Absent").  Here the full restartable state is small and well-defined:
+
+* the ChainCarry pytree (sampler state, Sigma block accumulator, iteration
+  counter, health stats),
+* the FitConfig (to refuse resuming under a different model), and
+* a content fingerprint of the sharded data.  Preprocessing (permutation,
+  padding, standardization) is deterministic given the run seed, so the
+  resumed fit recomputes it from the caller's Y and the fingerprint check
+  refuses to resume on different data - the checkpoint never duplicates
+  the dataset.
+
+Format: one ``.npz`` per checkpoint (all pytree leaves flattened, treedef
+recorded structurally) plus a JSON metadata entry.  No orbax dependency:
+the state is a flat list of dense arrays; numpy's container format is
+sufficient, portable, and inspectable.  Writes are atomic (tmp + rename)
+so a crash mid-save never corrupts the latest checkpoint.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import tempfile
+from typing import Any, Optional, Tuple
+
+import jax
+import numpy as np
+
+from dcfm_tpu.config import (
+    BackendConfig, DLConfig, FitConfig, HorseshoeConfig, MGPConfig,
+    ModelConfig, RunConfig)
+
+_FORMAT_VERSION = 1
+
+
+def data_fingerprint(data: np.ndarray) -> str:
+    """Cheap content hash of the sharded data (shape + strided sample)."""
+    h = hashlib.sha256()
+    h.update(str(data.shape).encode())
+    flat = np.ascontiguousarray(data).reshape(-1)
+    h.update(flat[:: max(1, flat.size // 65536)].tobytes())
+    return h.hexdigest()[:16]
+
+
+def _config_to_json(cfg: FitConfig) -> dict:
+    return dataclasses.asdict(cfg)
+
+
+def _config_from_json(d: dict) -> FitConfig:
+    model = dict(d["model"])
+    model["mgp"] = MGPConfig(**model["mgp"])
+    model["horseshoe"] = HorseshoeConfig(**model["horseshoe"])
+    model["dl"] = DLConfig(**model["dl"])
+    return FitConfig(
+        model=ModelConfig(**model),
+        run=RunConfig(**d["run"]),
+        backend=BackendConfig(**d["backend"]),
+        permute=d["permute"],
+        standardize=d["standardize"],
+        pad_to_shards=d["pad_to_shards"],
+    )
+
+
+def save_checkpoint(
+    path: str,
+    carry: Any,
+    cfg: FitConfig,
+    *,
+    fingerprint: str,
+) -> None:
+    """Atomically write chain state + config + data fingerprint."""
+    carry = jax.device_get(carry)
+    leaves, treedef = jax.tree.flatten(carry)
+    meta = {
+        "version": _FORMAT_VERSION,
+        "config": _config_to_json(cfg),
+        "treedef": str(treedef),
+        "iteration": int(np.asarray(carry.iteration)),
+        "fingerprint": fingerprint,
+    }
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".npz.tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez(
+                f,
+                __meta__=np.frombuffer(
+                    json.dumps(meta).encode(), dtype=np.uint8),
+                **{f"leaf_{i}": np.asarray(l) for i, l in enumerate(leaves)},
+            )
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+
+def load_checkpoint(path: str, carry_template: Any) -> Tuple[Any, dict]:
+    """Load (carry, metadata).
+
+    ``carry_template`` supplies the pytree structure (build it with the same
+    configs via init_chain / jax.eval_shape); leaf shapes are checked so a
+    config/data mismatch fails loudly instead of resuming garbage.
+    """
+    with np.load(path) as z:
+        meta = json.loads(bytes(z["__meta__"]).decode())
+        if meta["version"] != _FORMAT_VERSION:
+            raise ValueError(
+                f"checkpoint format v{meta['version']} != v{_FORMAT_VERSION}")
+        template_leaves, treedef = jax.tree.flatten(carry_template)
+        leaves = []
+        for i, tl in enumerate(template_leaves):
+            arr = z[f"leaf_{i}"]
+            if tuple(arr.shape) != tuple(np.shape(tl)):
+                raise ValueError(
+                    f"checkpoint leaf {i} shape {arr.shape} != expected "
+                    f"{np.shape(tl)} - config/data mismatch?")
+            leaves.append(arr)
+        return jax.tree.unflatten(treedef, leaves), meta
+
+
+def checkpoint_compatible(
+    meta: dict, cfg: FitConfig, fingerprint: str
+) -> Optional[str]:
+    """None if resumable under ``cfg``, else a human-readable refusal."""
+    saved = _config_from_json(meta["config"])
+    if saved.model != cfg.model:
+        return f"model config changed: {saved.model} != {cfg.model}"
+    if saved.run.seed != cfg.run.seed:
+        return f"seed changed: {saved.run.seed} != {cfg.run.seed}"
+    if (saved.run.burnin, saved.run.thin) != (cfg.run.burnin, cfg.run.thin):
+        return "burnin/thin changed (the accumulator weighting depends on them)"
+    if saved.run.mcmc != cfg.run.mcmc:
+        return "mcmc length changed (1/num_saved running-mean weight differs)"
+    if meta["fingerprint"] != fingerprint:
+        return "data fingerprint mismatch - resuming on different data"
+    return None
