@@ -186,6 +186,8 @@ def divideconquer(
     backend: str = "auto",
     seed: int = 0,
     prior: str = "mgp",
+    estimator: str = "scaled",
+    x_prior_precision: float = 1.0,
 ) -> np.ndarray:
     """Reference-compatible entry point (``divideconquer.m:1``).
 
@@ -193,13 +195,25 @@ def divideconquer(
     in the *caller's* column order on the original scale, with zero rows and
     columns for all-zero input columns (the reference returns permuted,
     standardized, shrunken coordinates with no inverse - quirks Q5/Q7).
+
+    Two defaults deliberately differ from the reference's combine math;
+    both are overridable for MATLAB cross-validation:
+
+    * ``estimator="scaled"`` uses the draws' empirical factor cross-moments
+      instead of the reference's plain rule ``rho * Lam_r Lam_c'``
+      (``divideconquer.m:186,:189``); pass ``estimator="plain"`` for the
+      reference rule.
+    * ``x_prior_precision=1.0`` is the model-implied X prior precision; the
+      reference uses ``g`` (``divideconquer.m:117``, quirk Q3); pass
+      ``x_prior_precision=float(g)`` to reproduce it.
     """
     if k % g != 0:
         raise ValueError(f"k={k} must be divisible by g={g} (K = k/g factors "
                          "per shard; the reference crashes silently - Q6)")
     cfg = FitConfig(
         model=ModelConfig(num_shards=g, factors_per_shard=k // g, rho=rho,
-                          prior=prior),
+                          prior=prior, estimator=estimator,
+                          x_prior_precision=x_prior_precision),
         run=RunConfig(burnin=BURNIN, mcmc=MCMC, thin=thin, seed=seed),
         backend=BackendConfig(backend=backend),
     )

@@ -160,7 +160,8 @@ def validate(cfg: FitConfig, n: int, p: int) -> None:
         raise ValueError("mcmc must be divisible by thin")
     if m.prior not in ("mgp", "horseshoe", "dl"):
         raise ValueError(f"unknown prior {m.prior!r}")
-    if m.prior == "dl":
-        raise NotImplementedError(
-            "the Dirichlet-Laplace prior is not wired up yet; "
-            "use prior='mgp' or 'horseshoe'")
+    if m.estimator not in ("plain", "scaled"):
+        raise ValueError(
+            f"unknown estimator {m.estimator!r} (expected 'plain' or "
+            "'scaled'; a typo would otherwise silently fall back to the "
+            "plain reference combine rule)")

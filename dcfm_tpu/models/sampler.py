@@ -73,7 +73,14 @@ def _health_update(running: jax.Array, now: jax.Array) -> jax.Array:
 
 def schedule_array(run: RunConfig) -> jax.Array:
     """Pack (burnin, thin, 1/num_saved) as a traced float32 triple so the
-    jitted chunk function is schedule-agnostic (no recompile per RunConfig)."""
+    jitted chunk function is schedule-agnostic (no recompile per RunConfig).
+
+    burnin/thin round-trip through float32, exact only below 2**24; a
+    schedule that long would silently corrupt, so refuse it loudly."""
+    if max(run.burnin, run.thin) >= 2 ** 24:
+        raise ValueError(
+            f"burnin={run.burnin}, thin={run.thin}: schedule entries must be "
+            "< 2**24 (packed as float32 for the schedule-agnostic jit)")
     eff = max(run.num_saved, 1)
     return jnp.asarray([run.burnin, run.thin, 1.0 / eff], jnp.float32)
 
