@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import os
 import time
 from typing import Any, Optional
 
@@ -36,6 +37,9 @@ from dcfm_tpu.models.sampler import (
     ChainStats, init_chain, run_chunk, schedule_array)
 from dcfm_tpu.parallel.mesh import make_mesh, shards_per_device
 from dcfm_tpu.parallel.shard import build_mesh_chain, place_sharded
+from dcfm_tpu.utils.checkpoint import (
+    checkpoint_compatible, data_fingerprint, load_checkpoint,
+    read_checkpoint_meta, save_checkpoint)
 from dcfm_tpu.utils.estimate import (
     extract_upper_blocks, full_blocks_from_upper, posterior_covariance)
 from dcfm_tpu.utils.preprocess import PreprocessResult, preprocess
@@ -119,33 +123,66 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
 
     # Chunk schedule: full chunks + one remainder chunk (exactly total_iters;
     # per-iteration RNG keys are derived from the *global* iteration index in
-    # run_chunk, so the chunking does not change the chain).
+    # run_chunk, so neither chunking nor a checkpoint/resume boundary changes
+    # the chain).
     chunk = run.chunk_size or run.total_iters
-    schedule = [chunk] * (run.total_iters // chunk)
-    if run.total_iters % chunk:
-        schedule.append(run.total_iters % chunk)
+    fingerprint = (data_fingerprint(pre.data)
+                   if cfg.checkpoint_path else None)
+
+    def _chunks(num_iters: int) -> list:
+        out = [chunk] * (num_iters // chunk)
+        if num_iters % chunk:
+            out.append(num_iters % chunk)
+        return out
+
+    def _run_chain(init_fn, get_chunk_fn, Yd):
+        done = 0
+        if cfg.resume:
+            if not os.path.exists(cfg.checkpoint_path):
+                raise FileNotFoundError(
+                    f"resume=True but no checkpoint at {cfg.checkpoint_path}")
+            # Compatibility first (friendly refusal on config/data mismatch),
+            # then load into an eval_shape template - the real init never
+            # runs, so no wasted compile and no doubled accumulator peak.
+            meta = read_checkpoint_meta(cfg.checkpoint_path)
+            reason = checkpoint_compatible(meta, cfg, fingerprint)
+            if reason is not None:
+                raise ValueError(f"refusing to resume: {reason}")
+            template = jax.eval_shape(init_fn, k_init, Yd)
+            carry, meta = load_checkpoint(cfg.checkpoint_path, template)
+            done = int(meta["iteration"])
+        else:
+            carry = init_fn(k_init, Yd)
+        stats = None
+        executed = run.total_iters - done
+        for ni in _chunks(executed):
+            carry, stats = get_chunk_fn(ni)(k_chain, Yd, carry, sched)
+            if cfg.checkpoint_path:
+                save_checkpoint(cfg.checkpoint_path, carry, cfg,
+                                fingerprint=fingerprint)
+        return carry, stats, executed
 
     sched = schedule_array(run)
     t0 = time.perf_counter()
     if use_mesh:
         mesh = make_mesh(n_mesh, devices)
         shards_per_device(m.num_shards, mesh)  # validates divisibility
-        init_fn = _mesh_fns(mesh, m, schedule[0])[0]
-        chunk_fns = {ni: _mesh_fns(mesh, m, ni)[1] for ni in set(schedule)}
         Yd = place_sharded(pre.data, mesh)
-        carry = init_fn(k_init, Yd)
-        stats = None
-        for ni in schedule:
-            carry, stats = chunk_fns[ni](k_chain, Yd, carry, sched)
+        carry, stats, executed = _run_chain(
+            _mesh_fns(mesh, m, chunk)[0],
+            lambda ni: _mesh_fns(mesh, m, ni)[1], Yd)
     else:
         with jax.default_device(devices[0]):
             Yd = jax.device_put(jnp.asarray(pre.data), devices[0])
-            init_fn = _local_fns(m, schedule[0])[0]
-            chunk_fns = {ni: _local_fns(m, ni)[1] for ni in set(schedule)}
-            carry = init_fn(k_init, Yd)
-            stats = None
-            for ni in schedule:
-                carry, stats = chunk_fns[ni](k_chain, Yd, carry, sched)
+            carry, stats, executed = _run_chain(
+                _local_fns(m, chunk)[0],
+                lambda ni: _local_fns(m, ni)[1], Yd)
+    if stats is None:
+        # resumed from a finished checkpoint: recompute the diagnostics
+        # from the carried running-health panel.
+        h = np.asarray(carry.health)
+        stats = ChainStats(tau_log_max=h[:, 0].max(),
+                           ps_min=h[:, 1].min(), ps_max=h[:, 2].max())
 
     # Fetch results: the block accumulator dominates device->host traffic
     # (p^2/g^2 bytes per block pair); its grid is exactly symmetric, so only
@@ -170,7 +207,9 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
         stats=stats,
         config=cfg,
         seconds=seconds,
-        iters_per_sec=run.total_iters / max(seconds, 1e-9),
+        # iterations actually executed by THIS call (a resumed fit runs only
+        # the remainder; a finished-checkpoint resume runs none).
+        iters_per_sec=executed / max(seconds, 1e-9) if executed else 0.0,
     )
 
 

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 
 import numpy as np
@@ -57,6 +58,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="output .npy for the covariance estimate")
     f.add_argument("--raw-coords", action="store_true",
                    help="skip de-standardization (correlation-scale output)")
+    f.add_argument("--checkpoint", default=None, metavar="PATH",
+                   help="write the chain state here at every chunk boundary "
+                        "(--chunk-size is the cadence)")
+    f.add_argument("--resume", action="store_true",
+                   help="resume from --checkpoint when it exists (a resumed "
+                        "chain is bitwise-identical to an uninterrupted one)")
     return p
 
 
@@ -71,6 +78,10 @@ def main(argv=None) -> int:
         raise SystemExit(
             f"--factors {args.factors} must be divisible by --shards "
             f"{args.shards} (k/g factors per shard)")
+    if args.resume and not args.checkpoint:
+        raise SystemExit("--resume requires --checkpoint")
+    resume = bool(args.resume and args.checkpoint
+                  and os.path.exists(args.checkpoint))
     cfg = FitConfig(
         model=ModelConfig(
             num_shards=args.shards,
@@ -80,6 +91,8 @@ def main(argv=None) -> int:
                       seed=args.seed, chunk_size=args.chunk_size),
         backend=BackendConfig(backend=args.backend,
                               mesh_devices=args.mesh_devices),
+        checkpoint_path=args.checkpoint,
+        resume=resume,
     )
     res = fit(Y, cfg)
     Sigma = (res.covariance(destandardize=False)

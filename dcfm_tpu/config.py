@@ -135,6 +135,14 @@ class FitConfig:
     # If p is not divisible by g, pad with dummy N(0,1) columns (dropped from
     # the output) instead of crashing (fixes Q6).
     pad_to_shards: bool = True
+    # Checkpoint/resume (SURVEY.md section 5; the reference persists nothing).
+    # If set, the full chain state is written atomically to this path at
+    # every chunk boundary - RunConfig.chunk_size is the checkpoint cadence.
+    # With resume=True the fit restarts from the saved global iteration; the
+    # per-iteration RNG keys derive from the global iteration index, so the
+    # resumed chain is bitwise-identical to an uninterrupted run.
+    checkpoint_path: Optional[str] = None
+    resume: bool = False
 
 
 def validate(cfg: FitConfig, n: int, p: int) -> None:
@@ -165,3 +173,5 @@ def validate(cfg: FitConfig, n: int, p: int) -> None:
             f"unknown estimator {m.estimator!r} (expected 'plain' or "
             "'scaled'; a typo would otherwise silently fall back to the "
             "plain reference combine rule)")
+    if cfg.resume and not cfg.checkpoint_path:
+        raise ValueError("resume=True requires checkpoint_path")

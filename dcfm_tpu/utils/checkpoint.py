@@ -64,6 +64,8 @@ def _config_from_json(d: dict) -> FitConfig:
         permute=d["permute"],
         standardize=d["standardize"],
         pad_to_shards=d["pad_to_shards"],
+        checkpoint_path=d.get("checkpoint_path"),
+        resume=d.get("resume", False),
     )
 
 
@@ -99,6 +101,18 @@ def save_checkpoint(
     finally:
         if os.path.exists(tmp):
             os.unlink(tmp)
+
+
+def read_checkpoint_meta(path: str) -> dict:
+    """Read only the metadata entry - cheap, for compatibility checks before
+    any leaf is unflattened (a config mismatch then fails with the friendly
+    refusal instead of a raw missing-leaf error)."""
+    with np.load(path) as z:
+        meta = json.loads(bytes(z["__meta__"]).decode())
+    if meta["version"] != _FORMAT_VERSION:
+        raise ValueError(
+            f"checkpoint format v{meta['version']} != v{_FORMAT_VERSION}")
+    return meta
 
 
 def load_checkpoint(path: str, carry_template: Any) -> Tuple[Any, dict]:
