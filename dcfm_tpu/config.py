@@ -175,3 +175,7 @@ def validate(cfg: FitConfig, n: int, p: int) -> None:
             "plain reference combine rule)")
     if cfg.resume and not cfg.checkpoint_path:
         raise ValueError("resume=True requires checkpoint_path")
+    if m.prior == "dl" and not 0.0 < m.dl.a <= 1.0:
+        raise ValueError(
+            f"DL concentration a={m.dl.a} must be in (0, 1] "
+            "(1/K <= a <= 1/2 is the usual range)")

@@ -34,6 +34,7 @@ from jax import lax
 
 from dcfm_tpu.config import ModelConfig
 from dcfm_tpu.ops.gamma import gamma_rate, inverse_gamma_rate
+from dcfm_tpu.ops.gig import gig, inverse_gaussian
 
 
 class Prior(NamedTuple):
@@ -151,6 +152,62 @@ def make_horseshoe(cfg: ModelConfig) -> Prior:
 
 
 # --------------------------------------------------------------------------
+# Dirichlet-Laplace (Bhattacharya, Pati, Pillai & Dunson 2015), row-wise
+# --------------------------------------------------------------------------
+# Per loading row j (a K-vector theta = Lambda_{j,.}):
+#   theta_h ~ N(0, psi_jh phi_jh^2 tau_j^2),  psi_jh ~ Exp(1/2),
+#   phi_{j,.} ~ Dirichlet(a, ..., a),  tau_j ~ Gamma(K a, 1/2).
+# Conditionals (all elementwise iGauss/GIG - ops/gig.py):
+#   1/psi_jh | .  ~ iGauss(phi_jh tau_j / |theta_h|, 1)
+#   tau_j   | .  ~ GIG(K(a-1), 1, 2 sum_h |theta_h| / phi_jh)
+#   phi_j,. | .  =  T / sum(T),  T_h ~ GIG(a-1, 1, 2 |theta_h|)
+# This replaces the reference's MGP block (``divideconquer.m:148-165``) via
+# the same Prior seam (SURVEY.md section 2, C12 "prior-swap point").
+
+# Heavily shrunk coordinates drive psi phi^2 tau^2 below float32; the row
+# precision is clamped so the Lambda update's Cholesky stays finite (the
+# coordinate is then pinned to N(0, 1/_DL_MAX_PRECISION), i.e. zero).
+_DL_MAX_PRECISION = 1e8
+_DL_EPS = 1e-8
+
+
+def make_dl(cfg: ModelConfig) -> Prior:
+    a = cfg.dl.a
+
+    def init(key: jax.Array, P: int, K: int):
+        k_psi, k_phi, k_tau = jax.random.split(key, 3)
+        psi = 2.0 * jax.random.exponential(k_psi, (P, K))      # Exp(1/2)
+        d = gamma_rate(k_phi, a, 1.0, sample_shape=(P, K))     # Dirichlet(a)
+        phi = d / jnp.sum(d, axis=-1, keepdims=True)
+        tau = gamma_rate(k_tau, K * a, 0.5, sample_shape=(P,))
+        return {"psi": psi, "phi": phi, "tau": tau}
+
+    def update(key: jax.Array, state, Lam: jax.Array):
+        P, K = Lam.shape
+        k_psi, k_tau, k_phi = jax.random.split(key, 3)
+        absL = jnp.maximum(jnp.abs(Lam), _DL_EPS)
+        phi = jnp.maximum(state["phi"], _DL_EPS)
+        tau = state["tau"]
+
+        mu = phi * tau[:, None] / absL
+        psi = 1.0 / inverse_gaussian(k_psi, mu, 1.0)
+
+        tau = gig(k_tau, K * (a - 1.0), 1.0,
+                  2.0 * jnp.sum(absL / phi, axis=-1))
+
+        T = gig(k_phi, a - 1.0, 1.0, 2.0 * absL)
+        phi = T / jnp.sum(T, axis=-1, keepdims=True)
+        return {"psi": psi, "phi": phi, "tau": tau}
+
+    def row_precision(state):
+        v = (state["psi"] * jnp.square(state["phi"])
+             * jnp.square(state["tau"])[:, None])
+        return 1.0 / jnp.maximum(v, 1.0 / _DL_MAX_PRECISION)
+
+    return Prior("dl", init, update, row_precision)
+
+
+# --------------------------------------------------------------------------
 
 def make_prior(cfg: ModelConfig) -> Prior:
     if cfg.prior == "mgp":
@@ -158,7 +215,5 @@ def make_prior(cfg: ModelConfig) -> Prior:
     if cfg.prior == "horseshoe":
         return make_horseshoe(cfg)
     if cfg.prior == "dl":
-        raise NotImplementedError(
-            "the Dirichlet-Laplace prior needs a generalized-inverse-Gaussian "
-            "sampler and is not wired up yet; use prior='mgp' or 'horseshoe'")
+        return make_dl(cfg)
     raise ValueError(f"unknown prior {cfg.prior!r}")
