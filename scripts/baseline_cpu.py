@@ -1,0 +1,110 @@
+"""Establish the CPU baseline BASELINE.md calls for (its "first build-phase
+action"): run the serial NumPy twin of the reference algorithm (MATLAB is
+unavailable in this image) on BASELINE.json configs 1-2 and record
+iterations/sec and posterior-Sigma Frobenius error vs the known synthetic
+truth.  The JAX CPU backend is timed on the same data for context.
+
+Usage:  python scripts/baseline_cpu.py            (prints a JSON line per run)
+
+The numbers printed by this script are recorded in BASELINE.md; the twin's
+error is the "MATLAB-equivalent posterior Frobenius error" anchor the
+north-star target references (the twin implements the reference's corrected
+math in float64 - SURVEY.md section 0.4).
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+from dcfm_tpu.reference_numpy import gibbs_numpy  # noqa: E402
+from dcfm_tpu.utils.estimate import stitch_blocks  # noqa: E402
+from dcfm_tpu.utils.preprocess import preprocess  # noqa: E402
+
+
+def make_synthetic(n, p, k_true, *, noise=0.3, seed=0):
+    r = np.random.default_rng(seed)
+    L = r.normal(size=(p, k_true)) / np.sqrt(k_true)
+    F = r.normal(size=(n, k_true))
+    Y = F @ L.T + noise * r.normal(size=(n, p))
+    return Y, L @ L.T + noise**2 * np.eye(p)
+
+
+def run_twin(name, *, n, p, g, K, k_true, burnin, mcmc, thin=1, seed=0):
+    Y, Sigma_true = make_synthetic(n, p, k_true, seed=seed)
+    pre = preprocess(Y, g, seed=seed)
+    t0 = time.perf_counter()
+    blocks, _ = gibbs_numpy(
+        pre.data.astype(np.float64), K, 0.9 if g > 1 else 0.5,
+        burnin, mcmc, thin=thin, seed=seed + 1)
+    seconds = time.perf_counter() - t0
+    # error in the twin's (permuted, standardized) coordinates
+    S = stitch_blocks(blocks)
+    perm = pre.perm  # p divisible by g in these configs: no padding
+    scale = pre.col_scale.reshape(-1)
+    St = Sigma_true[np.ix_(perm, perm)] / np.outer(scale, scale)
+    err = float(np.linalg.norm(S - St) / np.linalg.norm(St))
+    iters = burnin + mcmc
+    out = {
+        "run": name,
+        "impl": "numpy-twin (float64, serial)",
+        "n": n, "p": p, "g": g, "K_per_shard": K,
+        "iters": iters,
+        "seconds": round(seconds, 2),
+        "iters_per_sec": round(iters / seconds, 3),
+        "rel_frob_err": round(err, 4),
+    }
+    print(json.dumps(out))
+    return out
+
+
+def run_jax_cpu(name, *, n, p, g, K, k_true, burnin, mcmc, thin=1, seed=0):
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from dcfm_tpu import BackendConfig, FitConfig, ModelConfig, RunConfig, fit
+
+    Y, Sigma_true = make_synthetic(n, p, k_true, seed=seed)
+    cfg = FitConfig(
+        model=ModelConfig(num_shards=g, factors_per_shard=K,
+                          rho=0.9 if g > 1 else 0.5),
+        run=RunConfig(burnin=burnin, mcmc=mcmc, thin=thin, seed=seed),
+        backend=BackendConfig(backend="jax_cpu"))
+    fit(Y, cfg)  # warm-up: compile
+    t0 = time.perf_counter()
+    res = fit(Y, cfg)
+    seconds = time.perf_counter() - t0
+    # same coordinates as run_twin (permuted/standardized): relative
+    # Frobenius error is not invariant to the diagonal rescaling, so both
+    # impls must be measured identically for the table to be comparable.
+    S = stitch_blocks(res.sigma_blocks.astype(np.float64))
+    pre = res.preprocess
+    scale = pre.col_scale.reshape(-1)
+    St = Sigma_true[np.ix_(pre.perm, pre.perm)] / np.outer(scale, scale)
+    err = float(np.linalg.norm(S - St) / np.linalg.norm(St))
+    iters = burnin + mcmc
+    out = {
+        "run": name,
+        "impl": "dcfm_tpu (jax_cpu backend, float32)",
+        "n": n, "p": p, "g": g, "K_per_shard": K,
+        "iters": iters,
+        "seconds": round(seconds, 2),
+        "iters_per_sec": round(iters / seconds, 3),
+        "rel_frob_err": round(err, 4),
+    }
+    print(json.dumps(out))
+    return out
+
+
+if __name__ == "__main__":
+    # BASELINE.json config 1: single-shard MGP, p=200, k=5
+    c1 = dict(n=100, p=200, g=1, K=5, k_true=5, burnin=500, mcmc=500)
+    # BASELINE.json config 2: 8-shard d&c, p=2000, k=10 -> K=ceil(10/8)=2
+    c2 = dict(n=200, p=2000, g=8, K=2, k_true=2, burnin=300, mcmc=300)
+    run_twin("config1", **c1)
+    run_twin("config2", **c2)
+    run_jax_cpu("config1", **c1)
+    run_jax_cpu("config2", **c2)

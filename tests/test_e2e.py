@@ -66,6 +66,32 @@ def test_parity_with_numpy_twin():
     assert _rel_frob(S_jx, S_np) < 0.05
 
 
+def test_parity_medium_scale_twin_vs_jax():
+    """BASELINE.md config-2-shape cross-check (p=1600, g=8): the float64
+    serial twin and the float32 JAX sampler agree on the posterior-mean
+    covariance and recover the truth to equivalent accuracy."""
+    Y, St = make_synthetic(150, 1600, 2, seed=21)
+    g, K, rho = 8, 2, 0.9
+    pre = preprocess(Y, g, seed=0)
+    blocks_np, _ = gibbs_numpy(
+        pre.data.astype(np.float64), K, rho, 200, 200, seed=1)
+    cfg = FitConfig(
+        model=ModelConfig(num_shards=g, factors_per_shard=K, rho=rho),
+        run=RunConfig(burnin=200, mcmc=200, thin=1, seed=0))
+    res = fit(Y, cfg)
+    S_np = stitch_blocks(blocks_np)
+    S_jx = stitch_blocks(res.sigma_blocks.astype(np.float64))
+    # direct twin-vs-JAX agreement on the posterior mean
+    assert _rel_frob(S_jx, S_np) < 0.05
+    # and equivalent accuracy vs truth in standardized coordinates
+    scale = pre.col_scale.reshape(-1)
+    St_std = St[np.ix_(pre.perm, pre.perm)] / np.outer(scale, scale)
+    e_np = _rel_frob(S_np, St_std)
+    e_jx = _rel_frob(S_jx, St_std)
+    assert e_np < 0.2 and e_jx < 0.2
+    assert abs(e_np - e_jx) < 0.05
+
+
 def test_chunked_run_matches_single_scan():
     """chunk_size must not change the chain (global-iteration RNG keys)."""
     Y, _ = make_synthetic(60, 32, 3, seed=7)
