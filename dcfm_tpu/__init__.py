@@ -10,14 +10,14 @@ covariance estimation.
 
 from dcfm_tpu.api import FitResult, divideconquer, fit
 from dcfm_tpu.config import (
-    BackendConfig, DLConfig, FitConfig, HorseshoeConfig, MGPConfig,
-    ModelConfig, RunConfig)
+    AdaptConfig, BackendConfig, DLConfig, FitConfig, HorseshoeConfig,
+    MGPConfig, ModelConfig, RunConfig)
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
 
 __all__ = [
     "fit", "divideconquer", "FitResult",
     "FitConfig", "ModelConfig", "RunConfig", "BackendConfig",
-    "MGPConfig", "HorseshoeConfig", "DLConfig",
+    "MGPConfig", "HorseshoeConfig", "DLConfig", "AdaptConfig",
     "__version__",
 ]

@@ -34,7 +34,9 @@ from dcfm_tpu.config import (
     BackendConfig, FitConfig, ModelConfig, RunConfig, validate)
 from dcfm_tpu.models.priors import make_prior
 from dcfm_tpu.models.sampler import (
-    ChainStats, init_chain, run_chunk, schedule_array)
+    TRACE_SUMMARIES, ChainStats, chain_keys, effective_ranks, init_chain,
+    run_chunk, schedule_array)
+from dcfm_tpu.utils.diagnostics import ess, split_rhat
 from dcfm_tpu.parallel.mesh import make_mesh, shards_per_device
 from dcfm_tpu.parallel.shard import build_mesh_chain, place_sharded
 from dcfm_tpu.utils.checkpoint import (
@@ -50,13 +52,24 @@ class FitResult:
     Sigma: np.ndarray              # (p, p) posterior-mean covariance in the
                                    # caller's coordinates (de-permuted,
                                    # de-standardized, zero cols reinserted)
-    sigma_blocks: np.ndarray       # (g, g, P, P) raw block accumulator
+    sigma_blocks: np.ndarray       # (g, g, P, P) raw block accumulator,
+                                   # averaged over chains when num_chains > 1
     preprocess: PreprocessResult
-    state: Any                     # final SamplerState (host pytree)
-    stats: ChainStats
+    state: Any                     # final SamplerState (host pytree); leaves
+                                   # gain a leading chain axis if num_chains>1
+    stats: ChainStats              # reduced over shards and chains
     config: FitConfig
     seconds: float
     iters_per_sec: float
+    # (num_chains, executed_iters, len(TRACE_SUMMARIES)) per-iteration scalar
+    # chain summaries (models/sampler.TRACE_SUMMARIES order).
+    traces: Optional[np.ndarray] = None
+    # {"rhat": {summary: float}, "ess": {summary: float}} on the post-burnin
+    # draws; rhat requires num_chains > 1 (utils/diagnostics.py).
+    diagnostics: Optional[dict] = None
+    # wall-clock per host-level chunk (SURVEY.md section 5 observability);
+    # chunk_seconds[0] includes compilation.
+    chunk_seconds: Optional[list] = None
 
     def covariance(self, *, destandardize=True, reinsert_zero_cols=False):
         return posterior_covariance(
@@ -66,25 +79,61 @@ class FitResult:
 
 
 @functools.lru_cache(maxsize=32)
-def _local_fns(model: ModelConfig, num_iters: int):
+def _local_fns(model: ModelConfig, num_iters: int, num_chains: int = 1):
     """Jitted single-device init/chunk functions, cached on the frozen model
     config and scan length so repeated fit() calls (warm-up, chunked
     schedules, notebooks) reuse compilations instead of re-tracing per call.
     The chain schedule enters as traced values (schedule_array), so any
-    burnin/mcmc/thin combination hits the same compilation."""
+    burnin/mcmc/thin combination hits the same compilation.
+
+    With ``num_chains`` > 1 the whole chain machinery is vmapped over a
+    leading chain axis with per-chain keys folded from the chain index
+    (the same derivation as parallel/shard.py, so the two layouts stay
+    chain-for-chain identical)."""
     prior = make_prior(model)
-    init_fn = jax.jit(functools.partial(
+    init_one = functools.partial(
         init_chain, cfg=model, prior=prior,
-        num_global_shards=model.num_shards))
-    chunk_fn = jax.jit(functools.partial(
-        run_chunk, cfg=model, prior=prior, num_iters=num_iters))
-    return init_fn, chunk_fn
+        num_global_shards=model.num_shards)
+    chunk_one = functools.partial(
+        run_chunk, cfg=model, prior=prior, num_iters=num_iters)
+    if num_chains == 1:
+        return jax.jit(init_one), jax.jit(chunk_one)
+
+    def init_fn(key, Y):
+        return jax.vmap(init_one, in_axes=(0, None))(
+            chain_keys(key, num_chains), Y)
+
+    def chunk_fn(key, Y, carry, sched):
+        return jax.vmap(chunk_one, in_axes=(0, None, 0, None))(
+            chain_keys(key, num_chains), Y, carry, sched)
+
+    return jax.jit(init_fn), jax.jit(chunk_fn)
 
 
 @functools.lru_cache(maxsize=32)
-def _mesh_fns(mesh, model: ModelConfig, num_iters: int):
+def _mesh_fns(mesh, model: ModelConfig, num_iters: int, num_chains: int = 1):
     prior = make_prior(model)
-    return build_mesh_chain(mesh, model, prior, num_iters=num_iters)
+    return build_mesh_chain(mesh, model, prior, num_iters=num_iters,
+                            num_chains=num_chains)
+
+
+def _diagnose(trace_arr: np.ndarray, done: int, run: RunConfig) -> dict:
+    """Split-R-hat/ESS on the post-burn-in slice of the chain traces.
+
+    ``done`` is the global iteration the (possibly resumed) run started at;
+    trace_arr covers global iterations done+1 .. total, so the post-burn-in
+    draws begin at local index max(burnin - done, 0).
+    """
+    start = max(run.burnin - done, 0)
+    post = trace_arr[:, start:, :]
+    out = {"rhat": {}, "ess": {}}
+    if post.shape[1] < 4:
+        return out
+    for i, name in enumerate(TRACE_SUMMARIES):
+        if trace_arr.shape[0] > 1:
+            out["rhat"][name] = split_rhat(post[:, :, i])
+        out["ess"][name] = ess(post[:, :, i])
+    return out
 
 
 def _resolve_devices(backend: BackendConfig):
@@ -154,49 +203,91 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
         else:
             carry = init_fn(k_init, Yd)
         stats = None
+        traces = []
+        chunk_secs = []
         executed = run.total_iters - done
         for ni in _chunks(executed):
-            carry, stats = get_chunk_fn(ni)(k_chain, Yd, carry, sched)
+            tc = time.perf_counter()
+            carry, stats, trace = get_chunk_fn(ni)(k_chain, Yd, carry, sched)
+            traces.append(np.asarray(trace))
+            chunk_secs.append(time.perf_counter() - tc)
             if cfg.checkpoint_path:
                 save_checkpoint(cfg.checkpoint_path, carry, cfg,
                                 fingerprint=fingerprint)
-        return carry, stats, executed
+        return carry, stats, executed, traces, chunk_secs, done
 
+    C = run.num_chains
     sched = schedule_array(run)
     t0 = time.perf_counter()
     if use_mesh:
         mesh = make_mesh(n_mesh, devices)
         shards_per_device(m.num_shards, mesh)  # validates divisibility
         Yd = place_sharded(pre.data, mesh)
-        carry, stats, executed = _run_chain(
-            _mesh_fns(mesh, m, chunk)[0],
-            lambda ni: _mesh_fns(mesh, m, ni)[1], Yd)
+        carry, stats, executed, traces, chunk_secs, done = _run_chain(
+            _mesh_fns(mesh, m, chunk, C)[0],
+            lambda ni: _mesh_fns(mesh, m, ni, C)[1], Yd)
     else:
         with jax.default_device(devices[0]):
             Yd = jax.device_put(jnp.asarray(pre.data), devices[0])
-            carry, stats, executed = _run_chain(
-                _local_fns(m, chunk)[0],
-                lambda ni: _local_fns(m, ni)[1], Yd)
+            # Commit the initial carry to the device explicitly: jit outputs
+            # are otherwise "uncommitted", so the second chunk call (whose
+            # carry IS committed, having flowed through a jit with the
+            # committed Yd) would present a different sharding signature and
+            # trigger a full recompile of the chunk function (~7s at the
+            # p=10k bench shape).
+            init_fn = _local_fns(m, chunk, C)[0]
+            carry, stats, executed, traces, chunk_secs, done = _run_chain(
+                lambda k, Y: jax.device_put(init_fn(k, Y), devices[0]),
+                lambda ni: _local_fns(m, ni, C)[1], Yd)
     if stats is None:
         # resumed from a finished checkpoint: recompute the diagnostics
         # from the carried running-health panel.
         h = np.asarray(carry.health)
-        stats = ChainStats(tau_log_max=h[:, 0].max(),
-                           ps_min=h[:, 1].min(), ps_max=h[:, 2].max())
+        ranks = np.asarray(effective_ranks(carry.state))
+        stats = ChainStats(tau_log_max=h[..., 0].max(),
+                           ps_min=h[..., 1].min(), ps_max=h[..., 2].max(),
+                           rank_min=ranks.min(), rank_max=ranks.max(),
+                           rank_mean=ranks.mean())
+    else:
+        # reduce the per-chain stats leaves ((C,) arrays when num_chains > 1)
+        # to the scalar cross-chain summary.
+        stats = jax.device_get(stats)
+        stats = ChainStats(
+            tau_log_max=np.max(stats.tau_log_max),
+            ps_min=np.min(stats.ps_min), ps_max=np.max(stats.ps_max),
+            rank_min=np.min(stats.rank_min), rank_max=np.max(stats.rank_max),
+            rank_mean=np.mean(stats.rank_mean))
+
+    # Per-iteration scalar traces -> (C, executed, S) + convergence report.
+    if traces:
+        trace_arr = np.concatenate(
+            [t if t.ndim == 3 else t[None] for t in traces], axis=1)
+    else:
+        trace_arr = np.zeros((C, 0, len(TRACE_SUMMARIES)))
+    diagnostics = _diagnose(trace_arr, done, run)
 
     # Fetch results: the block accumulator dominates device->host traffic
     # (p^2/g^2 bytes per block pair); its grid is exactly symmetric, so only
-    # the upper-triangle panels cross the link (see extract_upper_blocks).
+    # the upper-triangle panels cross the link (see extract_upper_blocks),
+    # optionally down-cast (backend.fetch_dtype) on a slow link.
+    # Chains are averaged on device first (each chain is an equal-weight
+    # posterior-mean estimate, so the mixture mean is the pooled estimate).
+    fetch_dtype = jnp.dtype(cfg.backend.fetch_dtype)
     upper = np.asarray(jax.jit(
-        functools.partial(extract_upper_blocks, g=m.num_shards)
+        lambda acc: extract_upper_blocks(
+            acc.mean(axis=0) if C > 1 else acc,
+            g=m.num_shards).astype(fetch_dtype)
     )(carry.sigma_acc))
-    state = jax.device_get(carry.state)
-    stats = jax.device_get(stats)
+    if upper.dtype != np.float32:
+        upper = upper.astype(np.float32)
+    state = jax.device_get(carry.state)  # stats is already host NumPy
     sigma_blocks = full_blocks_from_upper(upper, m.num_shards)
     # reinsert_zero_cols=True: Sigma is (p, p) in the caller's coordinates,
     # with zero rows/cols for all-zero input columns (variance of a constant
     # is 0) - indices never shift (the reference's Q7 drops them silently).
-    Sigma = posterior_covariance(sigma_blocks, pre, reinsert_zero_cols=True)
+    # assume_symmetric: the upper-blocks round trip makes it exact.
+    Sigma = posterior_covariance(sigma_blocks, pre, reinsert_zero_cols=True,
+                                 assume_symmetric=True)
     seconds = time.perf_counter() - t0
 
     return FitResult(
@@ -210,6 +301,9 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
         # iterations actually executed by THIS call (a resumed fit runs only
         # the remainder; a finished-checkpoint resume runs none).
         iters_per_sec=executed / max(seconds, 1e-9) if executed else 0.0,
+        traces=trace_arr,
+        diagnostics=diagnostics,
+        chunk_seconds=chunk_secs,
     )
 
 

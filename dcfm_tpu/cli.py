@@ -47,6 +47,13 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["mgp", "horseshoe", "dl"])
     f.add_argument("--estimator", default="scaled",
                    choices=["scaled", "plain"])
+    f.add_argument("--rank-adapt", action="store_true",
+                   help="adaptively truncate redundant loading columns "
+                        "during burn-in (Bhattacharya-Dunson adaptation)")
+    f.add_argument("--chains", type=int, default=1,
+                   help="independent MCMC chains (vmap axis); > 1 enables "
+                        "split-R-hat in the JSON report and pools the "
+                        "covariance estimate over chains")
     f.add_argument("--seed", type=int, default=0)
     f.add_argument("--backend", default="auto",
                    choices=["auto", "jax_cpu", "jax_tpu"])
@@ -86,9 +93,11 @@ def main(argv=None) -> int:
         model=ModelConfig(
             num_shards=args.shards,
             factors_per_shard=args.factors // args.shards,
-            rho=args.rho, prior=args.prior, estimator=args.estimator),
+            rho=args.rho, prior=args.prior, estimator=args.estimator,
+            rank_adapt=args.rank_adapt),
         run=RunConfig(burnin=args.burnin, mcmc=args.mcmc, thin=args.thin,
-                      seed=args.seed, chunk_size=args.chunk_size),
+                      seed=args.seed, chunk_size=args.chunk_size,
+                      num_chains=args.chains),
         backend=BackendConfig(backend=args.backend,
                               mesh_devices=args.mesh_devices),
         checkpoint_path=args.checkpoint,
@@ -104,8 +113,16 @@ def main(argv=None) -> int:
         "seconds": round(res.seconds, 3),
         "iters_per_sec": round(res.iters_per_sec, 2),
         "tau_log_max": float(np.asarray(res.stats.tau_log_max)),
+        "effective_rank_mean": float(np.asarray(res.stats.rank_mean)),
         "zero_cols_dropped": int(res.preprocess.zero_cols.size),
         "padded_cols": int(res.preprocess.n_pad),
+        # None (JSON null) for non-finite diagnostics: bare NaN is invalid
+        # JSON (RFC 8259) and would break consumers exactly when a diverged
+        # chain makes the report matter most.
+        "rhat": {k: round(v, 4) if np.isfinite(v) else None
+                 for k, v in res.diagnostics["rhat"].items()},
+        "ess": {k: round(v, 1) if np.isfinite(v) else None
+                for k, v in res.diagnostics["ess"].items()},
     }))
     return 0
 

@@ -50,6 +50,33 @@ class DLConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class AdaptConfig:
+    """Adaptive rank truncation (Bhattacharya & Dunson 2011, section 3.2).
+
+    The reference carries K = k/g loading columns per shard forever
+    (``divideconquer.m:41``); the adaptive Gibbs of the MGP paper prunes
+    columns whose loadings have collapsed to zero.  At iteration t, with
+    probability p(t) = exp(a0 + a1*t), the sampler adapts: per shard,
+    columns whose |loading| entries are (nearly) all below ``eps`` are
+    deactivated; if no column is redundant, one previously-deactivated
+    column is reactivated.  Adaptation runs during burn-in only - the mask
+    freezes afterwards, so the saved draws target a fixed (truncated) model.
+    Shapes stay static under jit: columns are masked, never removed.
+    """
+
+    a0: float = -1.0      # adaptation probability intercept (p(t)=exp(a0+a1 t))
+    a1: float = -5e-4     # adaptation probability decay (must be < 0)
+    eps: float = 0.05     # |loading| threshold defining a "zero" entry
+    # Fraction of a column's entries below eps for it to count as redundant.
+    # The paper's rule is "all entries in an eps-neighborhood of zero"
+    # (prop=1.0); at practical chain lengths a draw of a shrunk column still
+    # carries a few entries above any tight eps, so a high-but-not-unit
+    # default is the workable reading on standardized data.
+    prop: float = 0.95
+    min_active: int = 1   # never truncate below this many columns per shard
+
+
+@dataclasses.dataclass(frozen=True)
 class ModelConfig:
     """The statistical model (SURVEY.md section 0.1).
 
@@ -79,9 +106,20 @@ class ModelConfig:
     # Residual precision hyperpriors (``divideconquer.m:62``), rate convention.
     as_: float = 1.0
     bs: float = 0.3
+    # Input dtype for the combine-step block matmuls (the O(p^2 K) einsum
+    # that dominates save iterations).  "bfloat16" feeds the MXU at native
+    # rate with float32 accumulation: per-draw ~4e-3 relative rounding that
+    # averages away over saved draws (far below Monte Carlo error).  The
+    # Gibbs sweep itself always runs float32 (K x K Cholesky in bf16 is
+    # unusable - SURVEY.md section 7 "Numerics").
+    combine_dtype: str = "float32"  # "float32" | "bfloat16"
+    # Adaptive rank truncation (see AdaptConfig).  Off by default: the
+    # reference model has a fixed per-shard factor budget.
+    rank_adapt: bool = False
     mgp: MGPConfig = MGPConfig()
     horseshoe: HorseshoeConfig = HorseshoeConfig()
     dl: DLConfig = DLConfig()
+    adapt: AdaptConfig = AdaptConfig()
 
     @property
     def total_factors(self) -> int:
@@ -100,6 +138,13 @@ class RunConfig:
     # returning control to the host (for progress/checkpoint).  0 = whole run
     # in one scan.
     chunk_size: int = 0
+    # Independent MCMC chains, run as an extra vmap axis over the whole
+    # chain machinery (the "free" DP-like axis of SURVEY.md section 2; the
+    # reference runs exactly one chain, ``divideconquer.m:90``).  Chains
+    # share compilation and devices; the posterior-mean covariance averages
+    # over chains and split-R-hat/ESS diagnostics come for free (> 1 chain
+    # enables R-hat).
+    num_chains: int = 1
 
     @property
     def total_iters(self) -> int:
@@ -120,6 +165,12 @@ class BackendConfig:
     backend: str = "auto"        # "auto" | "jax_cpu" | "jax_tpu"
     # Number of mesh devices for the shard axis; 0 = single-device vmap.
     mesh_devices: int = 0
+    # Dtype for fetching the covariance block accumulator to the host.  The
+    # accumulator is the biggest device->host artifact of a run (p^2/2
+    # floats); on a bandwidth-constrained link "float16"/"bfloat16" halve
+    # the transfer at ~5e-4 relative rounding on the *reported* Sigma only -
+    # on-device accumulation stays float32.
+    fetch_dtype: str = "float32"  # "float32" | "bfloat16" | "float16"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -164,6 +215,9 @@ def validate(cfg: FitConfig, n: int, p: int) -> None:
         raise ValueError("burnin + mcmc must be >= 1")
     if cfg.run.thin < 1:
         raise ValueError(f"thin must be >= 1, got {cfg.run.thin}")
+    if cfg.run.num_chains < 1:
+        raise ValueError(
+            f"num_chains must be >= 1, got {cfg.run.num_chains}")
     if cfg.run.mcmc % cfg.run.thin != 0:
         raise ValueError("mcmc must be divisible by thin")
     if m.prior not in ("mgp", "horseshoe", "dl"):
@@ -173,8 +227,35 @@ def validate(cfg: FitConfig, n: int, p: int) -> None:
             f"unknown estimator {m.estimator!r} (expected 'plain' or "
             "'scaled'; a typo would otherwise silently fall back to the "
             "plain reference combine rule)")
+    if m.combine_dtype not in ("float32", "bfloat16"):
+        raise ValueError(
+            f"unknown combine_dtype {m.combine_dtype!r} "
+            "(float32 | bfloat16)")
     if cfg.resume and not cfg.checkpoint_path:
         raise ValueError("resume=True requires checkpoint_path")
+    if cfg.backend.fetch_dtype not in ("float32", "bfloat16", "float16"):
+        raise ValueError(
+            f"unknown fetch_dtype {cfg.backend.fetch_dtype!r} "
+            "(float32 | bfloat16 | float16)")
+    if cfg.backend.fetch_dtype == "float16" and not cfg.standardize:
+        raise ValueError(
+            "fetch_dtype='float16' requires standardize=True: raw-scale "
+            "covariance entries can exceed float16's 65504 max and would "
+            "silently saturate to inf (bfloat16 keeps float32 range)")
+    if m.rank_adapt:
+        a = m.adapt
+        if a.a1 >= 0:
+            raise ValueError(
+                f"adapt.a1={a.a1} must be < 0 (adaptation probability "
+                "exp(a0 + a1*t) must decay, Bhattacharya-Dunson condition)")
+        if not 0.0 < a.prop <= 1.0:
+            raise ValueError(f"adapt.prop={a.prop} must be in (0, 1]")
+        if a.eps <= 0:
+            raise ValueError(f"adapt.eps={a.eps} must be > 0")
+        if not 1 <= a.min_active <= m.factors_per_shard:
+            raise ValueError(
+                f"adapt.min_active={a.min_active} must be in "
+                f"[1, factors_per_shard={m.factors_per_shard}]")
     if m.prior == "dl" and not 0.0 < m.dl.a <= 1.0:
         raise ValueError(
             f"DL concentration a={m.dl.a} must be in (0, 1] "

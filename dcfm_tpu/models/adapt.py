@@ -1,0 +1,101 @@
+"""Adaptive rank truncation (Bhattacharya & Dunson 2011, section 3.2).
+
+The reference fixes K = k/g loading columns per shard for the whole chain
+(``divideconquer.m:41``); when K overshoots the true rank, most columns are
+shrunk to numerical zero by the MGP prior yet still cost full sweep work and
+pollute the covariance blocks with noise.  The adaptive Gibbs sampler of the
+MGP paper prunes them: at iteration t, with probability p(t) = exp(a0+a1*t),
+each shard drops loading columns whose entries have (nearly) all collapsed
+below a threshold; if none are redundant, one previously-dropped column is
+restored.
+
+TPU-native design: shapes must be static under jit, so columns are never
+physically removed - ``SamplerState.active`` is a per-shard (Gl, K) 0/1
+mask.  A deactivated column h is *conditioned at* Lambda_h = 0:
+
+* masked loadings contribute nothing to the Z/X/ps conditionals, which
+  therefore automatically target the truncated model;
+* the Lambda update masks eta's inactive columns before forming its
+  precision, so active coordinates are drawn from exactly their conditional
+  given the zeros (models/conditionals.py);
+* prior updates receive the mask and count only active columns in their
+  column-counting shape parameters (models/priors.py).
+
+Adaptation runs during burn-in only (``it <= burnin``); afterwards the mask
+is frozen, so the saved draws come from a fixed-model Markov chain and the
+diminishing-adaptation condition holds trivially.
+
+All shards share one Bernoulli(p(t)) adaptation decision per iteration (as
+in the paper's single-chain algorithm); the per-shard drop/restore choices
+are made independently from each shard's own loadings.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from dcfm_tpu.config import ModelConfig
+from dcfm_tpu.models.state import SamplerState
+
+# RNG site id for the adaptation decision (conditionals.py uses 1-5).
+_SITE_ADAPT = 6
+
+
+def adapt_rank(
+    key: jax.Array,
+    state: SamplerState,
+    it: jax.Array,
+    burnin: jax.Array,
+    cfg: ModelConfig,
+) -> SamplerState:
+    """One adaptation step; identity when the Bernoulli(p(t)) coin says no,
+    when ``it > burnin``, or when ``state.active`` is None.
+
+    Args:
+      key: the per-iteration key (same stream the sweep folded sites from).
+      state: post-sweep sampler state (Lambda already masked).
+      it: traced global 1-based iteration index.
+      burnin: traced burn-in length; the mask freezes beyond it.
+      cfg: model config; ``cfg.adapt`` holds the thresholds.
+    """
+    active = state.active
+    if active is None:
+        return state
+    ac = cfg.adapt
+    dtype = state.Lambda.dtype
+
+    u = jax.random.uniform(jax.random.fold_in(key, _SITE_ADAPT))
+    p_t = jnp.exp(ac.a0 + ac.a1 * it.astype(jnp.float32))
+    do = jnp.logical_and(u < p_t, it <= burnin)
+
+    # Per shard: a column is redundant when >= prop of its |loadings| are
+    # below eps.  Inactive columns are all-zero, hence trivially "small";
+    # exclude them so only live columns can be dropped.
+    small = (jnp.abs(state.Lambda) < ac.eps).astype(dtype)    # (Gl, P, K)
+    prop_small = jnp.mean(small, axis=1)                      # (Gl, K)
+    is_active = active > 0
+    redundant = jnp.logical_and(prop_small >= ac.prop, is_active)
+
+    num_red = jnp.sum(redundant, axis=-1)                     # (Gl,)
+    num_act = jnp.sum(is_active, axis=-1)                     # (Gl,)
+
+    # Drop: deactivate all redundant columns, but never below min_active.
+    can_drop = (num_act - num_red) >= ac.min_active
+    dropped = jnp.where(can_drop[:, None],
+                        active * (1.0 - redundant.astype(dtype)), active)
+
+    # Restore: when no column is redundant the model may want more rank -
+    # reactivate the first inactive column (it re-enters at Lambda_h = 0 and
+    # is resampled from its full conditional next sweep, a valid move).
+    has_inactive = num_act < active.shape[-1]
+    first_inactive = jnp.argmax(jnp.logical_not(is_active), axis=-1)  # (Gl,)
+    grown = jnp.clip(
+        active + (jax.nn.one_hot(first_inactive, active.shape[-1], dtype=dtype)
+                  * has_inactive[:, None].astype(dtype)),
+        0.0, 1.0)
+
+    new_active = jnp.where((num_red > 0)[:, None], dropped, grown)
+    new_active = jnp.where(do, new_active, active)
+    return state.replace(active=new_active,
+                         Lambda=state.Lambda * new_active[:, None, :])

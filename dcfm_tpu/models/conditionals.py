@@ -26,6 +26,7 @@ replicated X.
 
 from __future__ import annotations
 
+import functools
 from typing import Callable, Optional
 
 import jax
@@ -126,6 +127,14 @@ def gibbs_sweep(
     # ---- Lambda | rest  (``:136-146``) ---------------------------------
     plam = jax.vmap(prior.row_precision)(state.prior)           # (Gl, P, K)
 
+    # Under adaptive rank truncation (models/adapt.py) inactive columns are
+    # conditioned at Lambda_h = 0.  Masking eta's inactive columns *before*
+    # forming E and EY makes the K x K precision block-diagonal between
+    # active and inactive coordinates, so the active subvector is sampled
+    # from exactly its conditional N(Q_AA^{-1} b_A, Q_AA^{-1}); the inactive
+    # coordinates draw from their (irrelevant) prior and are re-zeroed.
+    eta_lam = eta if state.active is None else eta * state.active[:, None, :]
+
     def lam_update(kg, Ym, eta_m, ps, plam_m):
         E = eta_m.T @ eta_m                                     # (K, K)
         EY = eta_m.T @ Ym                                       # (K, P)
@@ -135,11 +144,17 @@ def gibbs_sweep(
         return sample_mvn_precision_batched(kg, Q, B)
 
     kl = _shard_keys(jax.random.fold_in(key, _SITE_LAM), shard_offset, Gl)
-    Lam = jax.vmap(lam_update)(kl, Y, eta, state.ps, plam)
+    Lam = jax.vmap(lam_update)(kl, Y, eta_lam, state.ps, plam)
+    if state.active is not None:
+        Lam = Lam * state.active[:, None, :]
 
     # ---- shrinkage prior (psi, delta/tau or equivalent; ``:148-165``) --
     kp = _shard_keys(jax.random.fold_in(key, _SITE_PRIOR), shard_offset, Gl)
-    prior_state = jax.vmap(prior.update)(kp, state.prior, Lam)
+    if state.active is None:
+        prior_state = jax.vmap(prior.update)(kp, state.prior, Lam)
+    else:
+        prior_state = jax.vmap(prior.update)(
+            kp, state.prior, Lam, state.active)
 
     # ---- residual precisions ps | rest  (``:167-172``) -----------------
     def ps_update(kg, Ym, eta_m, Lam_m):
@@ -150,7 +165,8 @@ def gibbs_sweep(
     ks = _shard_keys(jax.random.fold_in(key, _SITE_PS), shard_offset, Gl)
     ps = jax.vmap(ps_update)(ks, Y, eta, Lam)
 
-    return SamplerState(Lambda=Lam, Z=Z, X=X, ps=ps, prior=prior_state)
+    return SamplerState(Lambda=Lam, Z=Z, X=X, ps=ps, prior=prior_state,
+                        active=state.active)
 
 
 def covariance_blocks(
@@ -162,6 +178,7 @@ def covariance_blocks(
     *,
     eta_local: Optional[jax.Array] = None,
     eta_all: Optional[jax.Array] = None,
+    compute_dtype=None,
 ) -> jax.Array:
     """Per-draw covariance blocks for the combine step ("conquer").
 
@@ -196,21 +213,36 @@ def covariance_blocks(
       local_shard_start: global index of local shard 0.
       eta_local: (Gl, n, K) this device's factor draws, or None for plain.
       eta_all: (G, n, K) all shards' factor draws, or None for plain.
+      compute_dtype: input dtype for the block matmuls (None = keep float32;
+        jnp.bfloat16 feeds the MXU at native rate).  Accumulation and output
+        stay in the state dtype via preferred_element_type.
 
     Returns: (Gl, G, P, P) row-panel of Sigma blocks.
     """
     Gl, P, K = Lam_local.shape
     G = Lam_all.shape[0]
+    out_dtype = Lam_local.dtype
     r_idx = local_shard_start + jnp.arange(Gl)                  # global rows
-    onehot = jax.nn.one_hot(r_idx, G, dtype=Lam_local.dtype)    # (Gl, G)
+    onehot = jax.nn.one_hot(r_idx, G, dtype=out_dtype)          # (Gl, G)
+    if compute_dtype is not None:
+        Lam_local_c = Lam_local.astype(compute_dtype)
+        Lam_all_c = Lam_all.astype(compute_dtype)
+    else:
+        Lam_local_c, Lam_all_c = Lam_local, Lam_all
+    ein = functools.partial(jnp.einsum, preferred_element_type=out_dtype)
     if eta_local is not None:
         n = eta_local.shape[1]
+        # the K x K cross-moments are cheap - keep them full precision; only
+        # the O(p^2 K) block products run in compute_dtype
         H = jnp.einsum("rnk,cnj->rckj", eta_local, eta_all) / n  # (Gl,G,K,K)
-        blocks = jnp.einsum("rpk,rckj,cqj->rcpq", Lam_local, H, Lam_all)
+        LH = ein("rpk,rckj->rcpj", Lam_local_c,
+                 H.astype(compute_dtype or out_dtype))           # (Gl,G,P,K)
+        blocks = ein("rcpj,cqj->rcpq",
+                     LH.astype(compute_dtype or out_dtype), Lam_all_c)
     else:
         # reference rule (``divideconquer.m:186,:189``)
-        blocks = rho * jnp.einsum("rpk,cqk->rcpq", Lam_local, Lam_all)
-        diag_blocks = jnp.einsum("rpk,rqk->rpq", Lam_local, Lam_local)
+        blocks = rho * ein("rpk,cqk->rcpq", Lam_local_c, Lam_all_c)
+        diag_blocks = ein("rpk,rqk->rpq", Lam_local_c, Lam_local_c)
         blocks = (blocks * (1.0 - onehot)[:, :, None, None]
                   + diag_blocks[:, None] * onehot[:, :, None, None])
     # add the residual variances on the diagonal block

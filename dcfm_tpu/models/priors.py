@@ -38,9 +38,18 @@ from dcfm_tpu.ops.gig import gig, inverse_gaussian
 
 
 class Prior(NamedTuple):
+    """Triple of pure per-shard functions (see module docstring).
+
+    ``update`` additionally accepts an optional ``active`` (K,) 0/1 column
+    mask (adaptive rank truncation, models/adapt.py): deactivated columns'
+    loadings are conditioned at exactly 0, so their contributions to
+    shrinkage sufficient statistics vanish and column-counting shape
+    parameters count only active columns.
+    """
+
     name: str
     init: Callable[[jax.Array, int, int], Any]
-    update: Callable[[jax.Array, Any, jax.Array], Any]
+    update: Callable[..., Any]
     row_precision: Callable[[Any], jax.Array]
 
 
@@ -69,7 +78,7 @@ def make_mgp(cfg: ModelConfig) -> Prior:
         delta = jnp.concatenate([d1, dh])
         return {"psijh": psijh, "delta": delta}
 
-    def update(key: jax.Array, state, Lam: jax.Array):
+    def update(key: jax.Array, state, Lam: jax.Array, active=None):
         P, K = Lam.shape
         psijh, delta = state["psijh"], state["delta"]
         k_psi, k_delta = jax.random.split(key)
@@ -78,19 +87,26 @@ def make_mgp(cfg: ModelConfig) -> Prior:
         lam2 = Lam * Lam
 
         # psi_jh | rest ~ Gamma(df/2 + 1/2, df/2 + tau_h lam_jh^2 / 2)
-        # (``divideconquer.m:150-151``)
+        # (``divideconquer.m:150-151``).  Deactivated columns (lam2 = 0 by
+        # masking) carry no loading observation: their psi redraws from the
+        # prior Gamma(df/2, df/2), not the +1/2-shape conditional.
+        a = jnp.ones((K,), lam2.dtype) if active is None else active
         psijh = gamma_rate(
-            k_psi, c.df / 2 + 0.5, c.df / 2 + 0.5 * tauh[None, :] * lam2)
+            k_psi, c.df / 2 + 0.5 * a[None, :],
+            c.df / 2 + 0.5 * tauh[None, :] * lam2)
 
         # delta_h | rest, sequential in h with tau recomputed after each
         # update (``divideconquer.m:154-165``, with Q4 fixed: everything here
         # is this shard's own state).  s_l = sum_j psi_jl lam_jl^2.
+        # Column-counting shapes count only *active* columns l >= h (all K
+        # when adaptation is off): n_ge[h] = #{active l : l >= h}.
         s = jnp.sum(psijh * lam2, axis=0)                 # (K,)
         hs = jnp.arange(K)
+        n_ge = jnp.cumsum(a[::-1])[::-1]                  # (K,) suffix counts
         shapes = jnp.where(
             hs == 0,
-            c.ad1 + 0.5 * P * K,
-            c.ad2 + 0.5 * P * (K - hs).astype(lam2.dtype))
+            c.ad1 + 0.5 * P * n_ge[0],
+            c.ad2 + 0.5 * P * n_ge)
         rates0 = jnp.where(hs == 0, c.bd1, c.bd2)
         keys = jax.random.split(k_delta, K)
 
@@ -130,7 +146,7 @@ def make_horseshoe(cfg: ModelConfig) -> Prior:
             "xi": jnp.ones(()),
         }
 
-    def update(key: jax.Array, state, Lam: jax.Array):
+    def update(key: jax.Array, state, Lam: jax.Array, active=None):
         P, K = Lam.shape
         k1, k2, k3, k4 = jax.random.split(key, 4)
         lam_sq = Lam * Lam
@@ -139,8 +155,12 @@ def make_horseshoe(cfg: ModelConfig) -> Prior:
         lam2 = inverse_gamma_rate(
             k1, 1.0, 1.0 / state["nu"] + 0.5 * lam_sq / tau2)
         nu = inverse_gamma_rate(k2, 1.0, 1.0 + 1.0 / lam2)
+        # tau2's shape counts only loadings that exist: P per active column
+        # (all K columns when adaptation is off); deactivated columns'
+        # lam_sq is 0 by masking, so the rate needs no correction.
+        n_act = float(K) if active is None else jnp.sum(active)
         tau2 = inverse_gamma_rate(
-            k3, 0.5 * (P * K + 1),
+            k3, 0.5 * (P * n_act + 1),
             1.0 / state["xi"] + 0.5 * jnp.sum(lam_sq / lam2))
         xi = inverse_gamma_rate(k4, 1.0, 1.0 / s2 + 1.0 / tau2)
         return {"lam2": lam2, "nu": nu, "tau2": tau2, "xi": xi}
@@ -182,7 +202,13 @@ def make_dl(cfg: ModelConfig) -> Prior:
         tau = gamma_rate(k_tau, K * a, 0.5, sample_shape=(P,))
         return {"psi": psi, "phi": phi, "tau": tau}
 
-    def update(key: jax.Array, state, Lam: jax.Array):
+    def update(key: jax.Array, state, Lam: jax.Array, active=None):
+        # Under rank adaptation, deactivated columns' |loadings| sit at the
+        # _DL_EPS floor below, so their shrinkage contributions are already
+        # negligible; the row-wise GIG shapes keep the static K (the DL
+        # prior is row-exchangeable in h, so this only perturbs tau_j's
+        # order parameter, not the active columns' conditionals).
+        del active
         P, K = Lam.shape
         k_psi, k_tau, k_phi = jax.random.split(key, 3)
         absL = jnp.maximum(jnp.abs(Lam), _DL_EPS)

@@ -25,6 +25,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from dcfm_tpu.config import ModelConfig, RunConfig
+from dcfm_tpu.models.adapt import adapt_rank
 from dcfm_tpu.models.conditionals import covariance_blocks, gibbs_sweep, local_sum
 from dcfm_tpu.models.priors import Prior
 from dcfm_tpu.models.state import SamplerState, init_state
@@ -44,6 +45,19 @@ class ChainStats(NamedTuple):
     tau_log_max: jax.Array    # max_h |log tau_h| seen - cumprod overflow watch
     ps_min: jax.Array
     ps_max: jax.Array
+    # Effective rank (active loading columns per shard) at chunk end; equals
+    # factors_per_shard unless adaptive truncation pruned columns.
+    rank_min: jax.Array
+    rank_max: jax.Array
+    rank_mean: jax.Array
+
+
+def effective_ranks(state: SamplerState) -> jax.Array:
+    """(Gl,) active-column count per local shard (K when adaptation is off)."""
+    if state.active is None:
+        K = state.Lambda.shape[-1]
+        return jnp.full(state.Lambda.shape[0], float(K), jnp.float32)
+    return jnp.sum((state.active > 0).astype(jnp.float32), axis=-1)
 
 
 def _health_now(state: SamplerState) -> jax.Array:
@@ -69,6 +83,49 @@ def _health_update(running: jax.Array, now: jax.Array) -> jax.Array:
         jnp.maximum(running[:, 0], now[:, 0]),
         jnp.minimum(running[:, 1], now[:, 1]),
         jnp.maximum(running[:, 2], now[:, 2])], axis=-1)
+
+
+# Names of the per-iteration scalar chain summaries emitted by run_chunk's
+# trace output, in order.  Convergence diagnostics (split-R-hat/ESS) run on
+# these, so they must be *identified* functionals of the posterior: the
+# model leaves two ridges weakly identified (the Lambda <-> eta scale split
+# and the X <-> Z signal split - see covariance_blocks), and raw loading or
+# factor energies wander along them with R-hat >> 1 even at equilibrium.
+# These summaries are invariant to both ridges:
+#   signal_var_mean  - mean_j Var(signal_j) = tr(Lam (eta'eta/n) Lam') / p
+#   resid_var_mean   - mean_j 1/ps_j
+#   sigma_diag_mean  - their sum: the mean marginal variance ("selected
+#                      Sigma entries" summary, SURVEY.md section 4)
+TRACE_SUMMARIES = ("signal_var_mean", "resid_var_mean", "sigma_diag_mean")
+
+
+def _trace_now(state: SamplerState, reduce_fn: Callable,
+               num_global_shards: int, rho: float) -> jax.Array:
+    """(3,) per-iteration scalar summaries, globally reduced over shards."""
+    P = state.ps.shape[-1]
+    n = state.X.shape[0]
+    p_total = num_global_shards * P
+    eta = (jnp.sqrt(rho) * state.X[None]
+           + jnp.sqrt(1.0 - rho) * state.Z)                  # (Gl, n, K)
+    E = jnp.einsum("gnk,gnj->gkj", eta, eta) / n             # (Gl, K, K)
+    M = jnp.einsum("gpk,gkj->gpj", state.Lambda, E)
+    # one fused reduce (a single psum on a mesh) for both scalars
+    signal, resid = reduce_fn(jnp.stack(
+        [jnp.sum(M * state.Lambda, axis=(1, 2)),
+         jnp.sum(1.0 / state.ps, axis=1)], axis=-1))
+    return jnp.stack([signal / p_total, resid / p_total,
+                      (signal + resid) / p_total])
+
+
+def chain_keys(key: jax.Array, num_chains: int) -> jax.Array:
+    """(num_chains,) per-chain PRNG keys, folded from the chain index.
+
+    The ONE key derivation both execution layouts must share: the
+    single-device vmap path (api._local_fns) and the mesh path
+    (parallel.shard.build_mesh_chain) each call this, which is what keeps
+    the two layouts chain-for-chain bitwise identical."""
+    return jax.vmap(lambda c: jax.random.fold_in(key, c))(
+        jnp.arange(num_chains))
 
 
 def schedule_array(run: RunConfig) -> jax.Array:
@@ -99,7 +156,8 @@ def init_chain(
     K = cfg.factors_per_shard
     state = init_state(
         key, prior, num_local_shards=Gl, n=n, P=P, K=K,
-        as_=cfg.as_, bs=cfg.bs, shard_offset=shard_offset, dtype=dtype)
+        as_=cfg.as_, bs=cfg.bs, shard_offset=shard_offset,
+        rank_adapt=cfg.rank_adapt, dtype=dtype)
     sigma_acc = jnp.zeros((Gl, num_global_shards, P, P), dtype)
     return ChainCarry(state=state, sigma_acc=sigma_acc,
                       iteration=jnp.zeros((), jnp.int32),
@@ -118,7 +176,7 @@ def run_chunk(
     shard_offset=0,
     reduce_fn: Callable = local_sum,
     gather_fn: Callable = lambda x: x,
-) -> tuple[ChainCarry, ChainStats]:
+) -> tuple[ChainCarry, ChainStats, jax.Array]:
     """Run ``num_iters`` Gibbs iterations from ``carry`` under one scan.
 
     ``sched`` packs the chain schedule as traced values
@@ -130,6 +188,10 @@ def run_chunk(
     running-mean weight 1/num_saved (reference ``divideconquer.m:194``).
     ``lax.cond`` skips the O(p^2 K / g) block work on non-saved iterations,
     so burn-in costs only the sweep.
+
+    Returns (carry, stats, trace) with trace of shape
+    (num_iters, len(TRACE_SUMMARIES)): per-iteration scalar chain summaries
+    for convergence diagnostics (utils/diagnostics.py).
     """
     burnin = sched[0].astype(jnp.int32)
     thin = sched[1].astype(jnp.int32)
@@ -140,6 +202,8 @@ def run_chunk(
             it_key, Y, carry.state, cfg, prior,
             shard_offset=shard_offset, reduce_fn=reduce_fn)
         it = carry.iteration + 1  # 1-based, like the reference
+        if cfg.rank_adapt:
+            state = adapt_rank(it_key, state, it, burnin, cfg)
 
         def accumulate(acc):
             Lam_all = gather_fn(state.Lambda)
@@ -151,21 +215,29 @@ def run_chunk(
                 eta = eta_all = None
             blocks = covariance_blocks(
                 state.Lambda, state.ps, Lam_all, cfg.rho, shard_offset,
-                eta_local=eta, eta_all=eta_all)
+                eta_local=eta, eta_all=eta_all,
+                compute_dtype=(jnp.bfloat16
+                               if cfg.combine_dtype == "bfloat16" else None))
             return acc + blocks * inv_eff
 
         save = jnp.logical_and(it > burnin, (it - burnin) % thin == 0)
         sigma_acc = lax.cond(save, accumulate, lambda a: a, carry.sigma_acc)
         health = _health_update(carry.health, _health_now(state))
-        return ChainCarry(state, sigma_acc, it, health), None
+        trace = _trace_now(state, reduce_fn, carry.sigma_acc.shape[1],
+                           cfg.rho)
+        return ChainCarry(state, sigma_acc, it, health), trace
 
     keys = jax.vmap(lambda i: jax.random.fold_in(key, i))(
         carry.iteration + jnp.arange(num_iters))
-    carry, _ = lax.scan(body, carry, keys)
+    carry, trace = lax.scan(body, carry, keys)
 
+    ranks = effective_ranks(carry.state)
     stats = ChainStats(
         tau_log_max=jnp.max(carry.health[:, 0]),
         ps_min=jnp.min(carry.health[:, 1]),
         ps_max=jnp.max(carry.health[:, 2]),
+        rank_min=jnp.min(ranks),
+        rank_max=jnp.max(ranks),
+        rank_mean=jnp.mean(ranks),
     )
-    return carry, stats
+    return carry, stats, trace

@@ -21,7 +21,7 @@ Shard layout: every per-shard leaf carries a leading shard axis of size
 
 from __future__ import annotations
 
-from typing import Any
+from typing import Any, Optional
 
 import flax.struct
 import jax
@@ -37,6 +37,11 @@ class SamplerState:
     X: jax.Array           # (n, K) shared ("impure") factors - replicated
     ps: jax.Array          # (Gl, P) residual precisions sigma_j^{-2}
     prior: Any             # prior-state pytree, leaves with leading (Gl, ...)
+    # (Gl, K) 0/1 column mask for adaptive rank truncation (models/adapt.py),
+    # or None when adaptation is off (fixed K, the reference's behavior) -
+    # None keeps the non-adaptive pytree structure, and thus checkpoints and
+    # compiled signatures, unchanged.
+    active: Optional[jax.Array] = None
 
 
 def init_state(
@@ -50,6 +55,7 @@ def init_state(
     as_: float,
     bs: float,
     shard_offset=0,
+    rank_adapt: bool = False,
     dtype=jnp.float32,
 ) -> SamplerState:
     """Draw the initial state (reference ``divideconquer.m:68-87``).
@@ -75,4 +81,6 @@ def init_state(
         return Lam, Z, ps, prior_state
 
     Lam, Z, ps, prior_state = jax.vmap(init_one)(gidx)
-    return SamplerState(Lambda=Lam, Z=Z, X=X, ps=ps, prior=prior_state)
+    active = (jnp.ones((num_local_shards, K), dtype) if rank_adapt else None)
+    return SamplerState(Lambda=Lam, Z=Z, X=X, ps=ps, prior=prior_state,
+                        active=active)

@@ -38,7 +38,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from dcfm_tpu.config import ModelConfig, RunConfig
 from dcfm_tpu.models.priors import Prior
 from dcfm_tpu.models.sampler import (
-    ChainCarry, ChainStats, init_chain, run_chunk)
+    ChainCarry, ChainStats, chain_keys, init_chain, run_chunk)
 from dcfm_tpu.parallel.mesh import (
     SHARD_AXIS, replicated_spec, shard_spec, shards_per_device)
 
@@ -65,27 +65,38 @@ def build_mesh_chain(
     prior: Prior,
     *,
     num_iters: int,
+    num_chains: int = 1,
 ):
     """Returns jitted (init_fn, chunk_fn) operating on mesh-sharded arrays.
 
     init_fn(key, Y_sharded) -> ChainCarry (leaves sharded over SHARD_AXIS,
-    X replicated).  chunk_fn(key, Y_sharded, carry, sched) -> (carry, stats)
-    runs ``num_iters`` Gibbs iterations under the (burnin, thin, 1/eff)
-    schedule triple from models.sampler.schedule_array.
+    X replicated).  chunk_fn(key, Y_sharded, carry, sched) ->
+    (carry, stats, trace) runs ``num_iters`` Gibbs iterations under the
+    (burnin, thin, 1/eff) schedule triple from models.sampler.schedule_array.
+
+    With ``num_chains`` > 1, every carry leaf gains a leading chain axis -
+    chains are an inner vmap axis on each device (replicated over the mesh:
+    each device runs all chains for its local shards), with per-chain keys
+    folded from the chain index exactly as the single-device layout does,
+    so mesh and vmap runs stay chain-for-chain identical.
     """
     g = cfg.num_shards
     gl = shards_per_device(g, mesh)
+    C = num_chains
 
     sh = shard_spec()       # leading global-shard axis -> split over mesh
     rep = replicated_spec()
+    # under a chain axis, the shard axis moves to position 1
+    sh_c = P(None, SHARD_AXIS) if C > 1 else sh
 
     def carry_specs() -> ChainCarry:
         # Every SamplerState leaf is shard-major except the replicated X.
         from dcfm_tpu.models.state import SamplerState
-        state_spec = SamplerState(Lambda=sh, Z=sh, X=rep, ps=sh,
-                                  prior=jax.tree.map(lambda _: sh, prior_leaf_tree))
-        return ChainCarry(state=state_spec, sigma_acc=sh, iteration=rep,
-                          health=sh)
+        state_spec = SamplerState(Lambda=sh_c, Z=sh_c, X=rep, ps=sh_c,
+                                  prior=jax.tree.map(lambda _: sh_c, prior_leaf_tree),
+                                  active=sh_c if cfg.rank_adapt else None)
+        return ChainCarry(state=state_spec, sigma_acc=sh_c, iteration=rep,
+                          health=sh_c)
 
     # Build a template of the prior pytree structure to spec it out.
     import jax.numpy as jnp  # noqa: F811
@@ -93,25 +104,43 @@ def build_mesh_chain(
         lambda k: prior.init(k, 4, cfg.factors_per_shard),
         jax.random.key(0))
 
-    def _init(key, Y):
+    def _init_one(key, Y):
         return init_chain(
             key, Y, cfg, prior,
             num_global_shards=g,
             shard_offset=_shard_offset(gl))
 
-    def _chunk(key, Y, carry, sched):
-        carry, stats = run_chunk(
+    def _chunk_one(key, Y, carry, sched):
+        return run_chunk(
             key, Y, carry, sched, cfg, prior,
             num_iters=num_iters,
             shard_offset=_shard_offset(gl),
             reduce_fn=_mesh_reduce,
             gather_fn=_mesh_gather)
-        # Reduce diagnostics across the mesh so the replicated out_spec holds.
+
+    def _init(key, Y):
+        if C == 1:
+            return _init_one(key, Y)
+        return jax.vmap(_init_one, in_axes=(0, None))(chain_keys(key, C), Y)
+
+    def _chunk(key, Y, carry, sched):
+        if C == 1:
+            carry, stats, trace = _chunk_one(key, Y, carry, sched)
+        else:
+            carry, stats, trace = jax.vmap(
+                _chunk_one, in_axes=(0, None, 0, None))(
+                    chain_keys(key, C), Y, carry, sched)
+        # Reduce diagnostics across the mesh so the replicated out_spec
+        # holds (trace is already mesh-reduced via the psum in reduce_fn).
         stats = ChainStats(
             tau_log_max=lax.pmax(stats.tau_log_max, SHARD_AXIS),
             ps_min=lax.pmin(stats.ps_min, SHARD_AXIS),
-            ps_max=lax.pmax(stats.ps_max, SHARD_AXIS))
-        return carry, stats
+            ps_max=lax.pmax(stats.ps_max, SHARD_AXIS),
+            rank_min=lax.pmin(stats.rank_min, SHARD_AXIS),
+            rank_max=lax.pmax(stats.rank_max, SHARD_AXIS),
+            # devices hold equal shard counts, so the mean of means is exact
+            rank_mean=lax.pmean(stats.rank_mean, SHARD_AXIS))
+        return carry, stats, trace
 
     specs = carry_specs()
     init_fn = jax.jit(shard_map(
@@ -121,7 +150,8 @@ def build_mesh_chain(
     chunk_fn = jax.jit(shard_map(
         _chunk, mesh=mesh,
         in_specs=(rep, sh, specs, rep),
-        out_specs=(specs, ChainStats(rep, rep, rep))))
+        out_specs=(specs, ChainStats(*([rep] * len(ChainStats._fields))),
+                   rep)))
     return init_fn, chunk_fn
 
 

@@ -33,8 +33,8 @@ import jax
 import numpy as np
 
 from dcfm_tpu.config import (
-    BackendConfig, DLConfig, FitConfig, HorseshoeConfig, MGPConfig,
-    ModelConfig, RunConfig)
+    AdaptConfig, BackendConfig, DLConfig, FitConfig, HorseshoeConfig,
+    MGPConfig, ModelConfig, RunConfig)
 
 _FORMAT_VERSION = 1
 
@@ -57,6 +57,10 @@ def _config_from_json(d: dict) -> FitConfig:
     model["mgp"] = MGPConfig(**model["mgp"])
     model["horseshoe"] = HorseshoeConfig(**model["horseshoe"])
     model["dl"] = DLConfig(**model["dl"])
+    # .get: checkpoints written before the adapt field existed (v0.1.0) carry
+    # no 'adapt' key; they deserialize to the default config and remain
+    # resumable (their carry pytree is structurally identical).
+    model["adapt"] = AdaptConfig(**model.get("adapt", {}))
     return FitConfig(
         model=ModelConfig(**model),
         run=RunConfig(**d["run"]),
@@ -83,7 +87,9 @@ def save_checkpoint(
         "version": _FORMAT_VERSION,
         "config": _config_to_json(cfg),
         "treedef": str(treedef),
-        "iteration": int(np.asarray(carry.iteration)),
+        # scalar single-chain; (num_chains,) with all entries equal under
+        # the chain vmap axis
+        "iteration": int(np.asarray(carry.iteration).reshape(-1)[0]),
         "fingerprint": fingerprint,
     }
     d = os.path.dirname(os.path.abspath(path)) or "."
@@ -152,6 +158,9 @@ def checkpoint_compatible(
         return "burnin/thin changed (the accumulator weighting depends on them)"
     if saved.run.mcmc != cfg.run.mcmc:
         return "mcmc length changed (1/num_saved running-mean weight differs)"
+    if saved.run.num_chains != cfg.run.num_chains:
+        return (f"num_chains changed: {saved.run.num_chains} != "
+                f"{cfg.run.num_chains} (the carry has a per-chain axis)")
     if meta["fingerprint"] != fingerprint:
         return "data fingerprint mismatch - resuming on different data"
     return None

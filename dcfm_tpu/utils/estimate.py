@@ -35,23 +35,36 @@ def extract_upper_blocks(sigma_acc, g: int):
 
 
 def full_blocks_from_upper(upper: np.ndarray, g: int) -> np.ndarray:
-    """Host-side inverse of extract_upper_blocks (transposes fill the rest)."""
+    """Host-side inverse of extract_upper_blocks (transposes fill the rest).
+
+    The g diagonal blocks are explicitly symmetrized (they carry float-level
+    asymmetry from the einsum accumulation order), so the stitched matrix is
+    exactly symmetric by construction and stitch_blocks needs no O(p^2)
+    symmetrization pass (reference ``divideconquer.m:195``)."""
     n_pairs, P, _ = upper.shape
     r, c = upper_pair_indices(g)
     blocks = np.empty((g, g, P, P), upper.dtype)
     blocks[r, c] = upper
     blocks[c, r] = np.transpose(upper, (0, 2, 1))
+    diag = np.arange(g)
+    bd = blocks[diag, diag]
+    blocks[diag, diag] = 0.5 * (bd + np.transpose(bd, (0, 2, 1)))
     return blocks
 
 
-def stitch_blocks(sigma_blocks: np.ndarray) -> np.ndarray:
-    """(g, g, P, P) row-panels -> (g*P, g*P) dense covariance, symmetrized."""
+def stitch_blocks(sigma_blocks: np.ndarray, *,
+                  symmetrize: bool = True) -> np.ndarray:
+    """(g, g, P, P) row-panels -> (g*P, g*P) dense covariance.
+
+    ``symmetrize=False`` skips the O(p^2) (S+S')/2 pass - safe when the
+    block grid is already exactly symmetric (full_blocks_from_upper output).
+    """
     g, g2, P, _ = sigma_blocks.shape
     if g != g2:
         raise ValueError(f"expected square block grid, got {sigma_blocks.shape}")
     S = np.ascontiguousarray(
         np.transpose(sigma_blocks, (0, 2, 1, 3))).reshape(g * P, g * P)
-    return 0.5 * (S + S.T)
+    return 0.5 * (S + S.T) if symmetrize else S
 
 
 def posterior_covariance(
@@ -60,9 +73,15 @@ def posterior_covariance(
     *,
     destandardize: bool = True,
     reinsert_zero_cols: bool = False,
+    assume_symmetric: bool = False,
 ) -> np.ndarray:
-    """Blocks -> covariance in the caller's original coordinates (fixes Q5)."""
-    S = stitch_blocks(np.asarray(sigma_blocks))
+    """Blocks -> covariance in the caller's original coordinates (fixes Q5).
+
+    ``assume_symmetric`` skips the defensive symmetrization when the blocks
+    are known exactly symmetric (the fit() path, whose blocks round-trip
+    through extract_upper_blocks/full_blocks_from_upper)."""
+    S = stitch_blocks(np.asarray(sigma_blocks),
+                      symmetrize=not assume_symmetric)
     return restore_covariance(
         S, pre, destandardize=destandardize,
         reinsert_zero_cols=reinsert_zero_cols)

@@ -158,21 +158,25 @@ def restore_covariance(
     if Sigma_shard.shape != (p_used, p_used):
         raise ValueError(
             f"expected ({p_used}, {p_used}), got {Sigma_shard.shape}")
-
-    # undo permutation: row j of shard layout corresponds to kept column
-    # perm[j]; scatter back.
-    S = Sigma_shard[np.ix_(pre.inv_perm, pre.inv_perm)]
-    # drop padding columns (they occupy the last n_pad positions pre-permutation)
     p_kept = p_used - pre.n_pad
-    S = S[:p_kept, :p_kept]
 
+    # De-standardize FIRST, in shard coordinates (one sweep; the scales live
+    # in shard order already), then undo permutation + padding with a single
+    # gather - these are all O(p^2) memory-bound passes over a matrix that
+    # reaches gigabytes at p=10k-50k, so pass count is wall-clock.
     if destandardize:
         # column means don't enter a covariance; only the scales invert
-        scale_flat = pre.col_scale.reshape(-1)[pre.inv_perm][:p_kept]
-        S = S * scale_flat[:, None] * scale_flat[None, :]
+        s = pre.col_scale.reshape(-1)
+        S = Sigma_shard * s[:, None]
+        S *= s[None, :]
+    else:
+        S = Sigma_shard
+    # row j of the caller's kept layout corresponds to shard position
+    # inv_perm[j]; padded dummies occupy positions inv_perm[p_kept:].
+    gidx = pre.inv_perm[:p_kept]
 
     if reinsert_zero_cols:
         full = np.zeros((pre.p_original, pre.p_original), S.dtype)
-        full[np.ix_(pre.kept_cols, pre.kept_cols)] = S
+        full[np.ix_(pre.kept_cols, pre.kept_cols)] = S[np.ix_(gidx, gidx)]
         return full
-    return S
+    return S[np.ix_(gidx, gidx)]

@@ -1,0 +1,115 @@
+"""Pod-scale shape demo: p=50k features, g=256 shards on an 8-device mesh.
+
+BASELINE.json config 5 / SURVEY.md section 7-8: the scalability cliff is the
+combine step's p x p covariance (50k^2 f32 = 10 GB - SURVEY.md "the combine
+at p=10k-50k"), which must never materialize on one device.  This demo
+proves the layout holds at that scale on the 8-virtual-CPU-device mesh:
+
+* 256 shards over 8 devices = 32 shards/device via the vmap-within-shard_map
+  layout (the same code path as TPU pods);
+* the (Gl, G, P, P) row-panel accumulator = 32*256*196^2 f32 = 1.26 GB per
+  device - exactly p^2/n_devices; the full p x p exists only after host
+  stitching;
+* the X update's cross-shard psum and the combine's all_gather compile and
+  execute at this shape.
+
+Memory accounting (f32, per device, n=16, P=196, K=2):
+    sigma_acc row-panel   32*256*196*196*4  = 1.26 GB   <- dominates
+    Y + state             ~32*(16+196)*2*4 + 32*196*4  < 2 MB
+    all_gather'd Lambda   256*196*2*4                   = 0.4 MB
+    all_gather'd eta      256*16*2*4                    = 33 KB
+Total ~1.3 GB/device; a TPU v5e (16 GB HBM) holds it 12x over.  At p=100k
+(P=391) the panel is 5 GB/device - still fits; beyond that, shard P or
+stream panels per saved draw.
+
+Run:  python scripts/pod_scale_demo.py          (~2-4 min on 8 virtual CPUs)
+"""
+
+import os
+import sys
+import time
+
+# Virtual 8-device CPU platform, forced before backend init (same recipe as
+# tests/conftest.py; on a real 8-chip TPU host, drop these two lines).
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+if not os.environ.get("PODDEMO_REAL_TPU"):
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def run_demo(g=256, n_devices=8, P=196, n=16, K=2, iters=3, seed=0,
+             verbose=True):
+    from dcfm_tpu.config import ModelConfig, RunConfig
+    from dcfm_tpu.models.priors import make_prior
+    from dcfm_tpu.models.sampler import schedule_array
+    from dcfm_tpu.parallel.mesh import make_mesh, shards_per_device
+    from dcfm_tpu.parallel.shard import build_mesh_chain, place_sharded
+
+    p = g * P
+    cfg = ModelConfig(num_shards=g, factors_per_shard=K, rho=0.9)
+    run = RunConfig(burnin=iters - 1, mcmc=1, thin=1, seed=seed)
+    prior = make_prior(cfg)
+
+    mesh = make_mesh(n_devices)
+    gl = shards_per_device(g, mesh)
+    rng = np.random.default_rng(seed)
+    Y = rng.standard_normal((g, n, P)).astype(np.float32)
+
+    panel_gb = gl * g * P * P * 4 / 1e9
+    if verbose:
+        print(f"p={p:,} g={g} -> {gl} shards/device on {n_devices} devices; "
+              f"row-panel accumulator {panel_gb:.2f} GB/device "
+              f"({n_devices * panel_gb:.1f} GB total, full p^2 "
+              f"{p * p * 4 / 1e9:.1f} GB never on one device)")
+
+    t0 = time.perf_counter()
+    init_fn, chunk_fn = build_mesh_chain(mesh, cfg, prior, num_iters=iters)
+    Yd = place_sharded(Y, mesh)
+    key = jax.random.key(seed)
+    carry = init_fn(key, Yd)
+    jax.block_until_ready(carry)
+    t_init = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    carry, stats, trace = chunk_fn(key, Yd, carry, schedule_array(run))
+    jax.block_until_ready(carry)
+    t_run = time.perf_counter() - t0
+
+    blocks = carry.sigma_acc
+    # global logical shape: (g, G, P, P), sharded over the row axis so each
+    # device holds only its (gl, G, P, P) panel
+    assert blocks.shape == (g, g, P, P)
+    # per-device shard check without fetching the 10 GB accumulator: the
+    # diagonal blocks carry the residual variances, so their trace is
+    # strictly positive, and every entry must be finite.
+    finite = bool(jax.jit(
+        lambda b: jnp.isfinite(b).all())(blocks))
+    tr0 = float(jax.jit(lambda b: jnp.trace(b[0, 0]))(blocks))
+    assert finite, "non-finite covariance blocks at pod scale"
+    assert tr0 > 0, "empty accumulator - no draw saved"
+    it = int(np.asarray(carry.iteration).reshape(-1)[0])
+    assert it == iters
+
+    if verbose:
+        print(f"compile+init {t_init:.1f}s, {iters} Gibbs iterations + "
+              f"1 saved draw {t_run:.1f}s")
+        print(f"accumulator shape {tuple(blocks.shape)}, finite, "
+              f"tr(Sigma_00) = {tr0:.1f}")
+        print("OK")
+    return dict(p=p, g=g, gl=gl, panel_gb=panel_gb, t_init=t_init,
+                t_run=t_run)
+
+
+import jax.numpy as jnp  # noqa: E402
+
+
+if __name__ == "__main__":
+    run_demo()
+    sys.exit(0)

@@ -1,0 +1,157 @@
+"""Adaptive rank truncation tests (BASELINE.json config 5, SURVEY.md §7-8).
+
+The reference carries K = k/g loading columns forever
+(``divideconquer.m:41``); models/adapt.py implements the
+Bhattacharya-Dunson adaptive Gibbs with a static-shape column mask.  Tests:
+the mask mechanics (drop / grow / min_active / burn-in freeze), end-to-end
+rank recovery when K is set 2x the true rank, mesh == vmap equivalence, and
+checkpoint round-tripping of the mask.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tests.conftest import make_synthetic
+
+from dcfm_tpu import BackendConfig, FitConfig, ModelConfig, RunConfig, fit
+from dcfm_tpu.config import AdaptConfig
+from dcfm_tpu.models.adapt import adapt_rank
+from dcfm_tpu.models.state import SamplerState
+
+
+def _rel_frob(A, B):
+    return np.linalg.norm(A - B) / np.linalg.norm(B)
+
+
+def _mk_state(Lam, active):
+    Lam = jnp.asarray(Lam, jnp.float32)
+    Gl, P, K = Lam.shape
+    return SamplerState(
+        Lambda=Lam,
+        Z=jnp.zeros((Gl, 4, K)), X=jnp.zeros((4, K)),
+        ps=jnp.ones((Gl, P)), prior={},
+        active=jnp.asarray(active, jnp.float32))
+
+
+# a0 = 1 > 0 makes p(t) = exp(1 + a1 t) > 1 for small t: adaptation always
+# fires, so the mask mechanics are deterministic under test.
+_ALWAYS = AdaptConfig(a0=1.0, a1=-1e-6, eps=0.01, prop=1.0, min_active=1)
+
+
+def _cfg(adapt=_ALWAYS):
+    return ModelConfig(num_shards=2, factors_per_shard=3, rho=0.5,
+                       rank_adapt=True, adapt=adapt)
+
+
+def test_adapt_drops_redundant_and_grows_when_saturated():
+    # shard 0: column 1 all below eps -> dropped; shard 1: nothing redundant
+    # and column 2 inactive -> grown back.
+    Lam = np.full((2, 5, 3), 0.5, np.float32)
+    Lam[0, :, 1] = 1e-4
+    Lam[1, :, 2] = 0.0                      # inactive, stays zero by masking
+    active = np.array([[1, 1, 1], [1, 1, 0]], np.float32)
+    state = _mk_state(Lam, active)
+    out = adapt_rank(jax.random.key(0), state, jnp.int32(5), jnp.int32(100),
+                     _cfg())
+    np.testing.assert_array_equal(np.asarray(out.active),
+                                  [[1, 0, 1], [1, 1, 1]])
+    assert np.all(np.asarray(out.Lambda[0, :, 1]) == 0)  # masked on drop
+
+
+def test_adapt_respects_min_active():
+    # every column redundant; min_active=2 forbids dropping below 2 -> the
+    # all-or-nothing drop is refused entirely.
+    Lam = np.full((1, 5, 3), 1e-4, np.float32)
+    state = _mk_state(Lam, np.ones((1, 3), np.float32))
+    out = adapt_rank(jax.random.key(0), state, jnp.int32(5), jnp.int32(100),
+                     _cfg(AdaptConfig(a0=1.0, a1=-1e-6, eps=0.01,
+                                      min_active=2)))
+    np.testing.assert_array_equal(np.asarray(out.active), [[1, 1, 1]])
+
+
+def test_adapt_frozen_after_burnin():
+    Lam = np.full((1, 5, 3), 1e-4, np.float32)   # all redundant
+    state = _mk_state(Lam, np.ones((1, 3), np.float32))
+    out = adapt_rank(jax.random.key(0), state, jnp.int32(101), jnp.int32(100),
+                     _cfg())
+    np.testing.assert_array_equal(np.asarray(out.active), [[1, 1, 1]])
+
+
+def test_rank_adapt_shrinks_to_true_rank():
+    """K set 2x the true per-shard rank: the effective rank shrinks toward
+    truth during burn-in and accuracy is maintained (VERDICT item 4)."""
+    k_true = 2
+    Y, St = make_synthetic(200, 48, k_true, seed=29)
+    cfg = FitConfig(
+        model=ModelConfig(num_shards=2, factors_per_shard=2 * k_true, rho=0.9,
+                          rank_adapt=True,
+                          adapt=AdaptConfig(a0=-0.5, a1=-2e-3, eps=0.1,
+                                            prop=0.9)),
+        run=RunConfig(burnin=400, mcmc=200, thin=1, seed=0))
+    res = fit(Y, cfg)
+    # every shard sees all k_true shared factors; spare columns pruned
+    assert res.stats.rank_max <= 2 * k_true  # sanity
+    assert res.stats.rank_mean <= k_true + 1.0
+    assert res.stats.rank_min >= 1
+    assert _rel_frob(res.Sigma, St) < 0.35
+    # the final mask really is frozen into the state and the loadings
+    act = np.asarray(res.state.active)
+    assert np.all((act == 0) | (act == 1))
+    Lam = np.asarray(res.state.Lambda)
+    for m in range(act.shape[0]):
+        assert np.all(Lam[m][:, act[m] == 0] == 0)
+
+
+def test_rank_adapt_mesh_matches_vmap():
+    """Adaptation is per-shard-local; the mesh layout must reproduce the
+    single-device chain bitwise, mask included."""
+    Y, _ = make_synthetic(60, 32, 2, seed=31)
+    m = ModelConfig(num_shards=4, factors_per_shard=3, rho=0.7,
+                    rank_adapt=True,
+                    adapt=AdaptConfig(a0=0.0, a1=-1e-3, eps=0.05))
+    run = RunConfig(burnin=60, mcmc=40, thin=1, seed=0)
+    res_local = fit(Y, FitConfig(model=m, run=run))
+    res_mesh = fit(Y, FitConfig(
+        model=m, run=run, backend=BackendConfig(mesh_devices=4)))
+    np.testing.assert_array_equal(np.asarray(res_local.state.active),
+                                  np.asarray(res_mesh.state.active))
+    np.testing.assert_allclose(res_local.sigma_blocks, res_mesh.sigma_blocks,
+                               rtol=2e-4, atol=1e-5)
+
+
+def test_rank_adapt_checkpoint_resume(tmp_path, monkeypatch):
+    """The mask is chain state: a run killed mid-chain resumes to a bitwise
+    identical result, adaptation decisions included."""
+    import dcfm_tpu.api as api
+
+    Y, _ = make_synthetic(50, 24, 2, seed=37)
+    m = ModelConfig(num_shards=2, factors_per_shard=3, rho=0.6,
+                    rank_adapt=True,
+                    adapt=AdaptConfig(a0=0.0, a1=-1e-3, eps=0.05))
+    run = RunConfig(burnin=40, mcmc=40, thin=1, seed=0, chunk_size=30)
+    full = fit(Y, FitConfig(model=m, run=run))
+
+    ck = str(tmp_path / "adapt.npz")
+    cfg_ck = FitConfig(model=m, run=run, checkpoint_path=ck)
+    real_save = api.save_checkpoint
+    calls = {"n": 0}
+
+    def killing_save(*args, **kwargs):
+        real_save(*args, **kwargs)
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("simulated crash mid-chain")
+
+    monkeypatch.setattr(api, "save_checkpoint", killing_save)
+    with pytest.raises(RuntimeError, match="simulated crash"):
+        fit(Y, cfg_ck)
+    monkeypatch.setattr(api, "save_checkpoint", real_save)
+
+    resumed = fit(Y, dataclasses.replace(cfg_ck, resume=True))
+    np.testing.assert_array_equal(np.asarray(full.state.active),
+                                  np.asarray(resumed.state.active))
+    np.testing.assert_array_equal(full.sigma_blocks, resumed.sigma_blocks)

@@ -1,0 +1,215 @@
+"""Reference-semantics and joint-distribution tests (VERDICT round-1 item 8).
+
+The framework's defaults deliberately correct the reference's math (quirks
+Q1-Q4) and replace its combine rule; the knobs that *reproduce* reference
+behavior must themselves be pinned:
+
+* ``estimator="plain"`` - the reference combine rule Sigma_rc = rho Lam_r
+  Lam_c' (+ Omega on the diagonal), ``divideconquer.m:186,:189``.
+* ``x_prior_precision=g`` - the reference's g*I X-prior precision
+  (``divideconquer.m:117``, quirk Q3).
+
+Both are cross-checked against the independent NumPy twin.  Finally, a
+Geweke joint-distribution test of the FULL jitted sweep (SURVEY.md section
+4 names it): successive-conditional simulation (alternate Y | state with
+the Gibbs sweep state | Y) must reproduce prior moments.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tests.conftest import make_synthetic
+
+from dcfm_tpu import FitConfig, ModelConfig, RunConfig, fit
+from dcfm_tpu.models.conditionals import gibbs_sweep
+from dcfm_tpu.models.priors import make_prior
+from dcfm_tpu.models.state import SamplerState
+from dcfm_tpu.ops.gamma import gamma_rate
+from dcfm_tpu.reference_numpy import gibbs_numpy
+from dcfm_tpu.utils.estimate import stitch_blocks
+from dcfm_tpu.utils.preprocess import preprocess
+
+
+def _rel_frob(A, B):
+    return np.linalg.norm(A - B) / np.linalg.norm(B)
+
+
+def test_plain_estimator_twin_parity():
+    """estimator="plain" (the reference combine rule) agrees with the twin
+    running the same rule - the claim "plain reproduces the reference" is a
+    test, not a comment."""
+    Y, _ = make_synthetic(120, 48, 3, seed=61)
+    g, K, rho = 2, 3, 0.7
+    pre = preprocess(Y, g, seed=0)
+    blocks_np, _ = gibbs_numpy(
+        pre.data.astype(np.float64), K, rho, 400, 400, seed=1,
+        estimator="plain")
+    cfg = FitConfig(
+        model=ModelConfig(num_shards=g, factors_per_shard=K, rho=rho,
+                          estimator="plain"),
+        run=RunConfig(burnin=400, mcmc=400, thin=1, seed=0))
+    res = fit(Y, cfg)
+    S_np = stitch_blocks(blocks_np)
+    S_jx = stitch_blocks(res.sigma_blocks.astype(np.float64))
+    # Looser than the scaled-estimator parity test (0.05): the plain rule is
+    # NOT invariant to the slow-mixing Lambda<->eta scale ridge, so two
+    # independent chains' Monte Carlo averages sit at visibly different
+    # ridge points (both ~4-5% scale here).  That sensitivity is the
+    # documented reason "scaled" is the default (covariance_blocks).
+    assert _rel_frob(S_jx, S_np) < 0.12
+
+
+def test_plain_vs_scaled_differ_offdiagonal():
+    """Sanity: the two estimators are genuinely different rules (the plain
+    rule pins cross-blocks to rho * Lam_r Lam_c')."""
+    Y, _ = make_synthetic(100, 32, 2, seed=63)
+    base = dict(num_shards=2, factors_per_shard=2, rho=0.6)
+    run = RunConfig(burnin=150, mcmc=150, thin=1, seed=0)
+    S_plain = fit(Y, FitConfig(
+        model=ModelConfig(estimator="plain", **base), run=run)).sigma_blocks
+    S_scaled = fit(Y, FitConfig(
+        model=ModelConfig(estimator="scaled", **base), run=run)).sigma_blocks
+    off_diff = np.abs(S_plain[0, 1] - S_scaled[0, 1]).max()
+    assert off_diff > 1e-4
+
+
+def test_x_prior_precision_reproduces_reference_q3():
+    """x_prior_precision=g (the reference's g*I prior term,
+    ``divideconquer.m:117``) cross-checked against the twin with the same
+    setting; and it measurably changes the X conditional vs the default."""
+    Y, _ = make_synthetic(100, 32, 2, seed=67)
+    g, K, rho = 2, 2, 0.8
+    pre = preprocess(Y, g, seed=0)
+    blocks_np, _ = gibbs_numpy(
+        pre.data.astype(np.float64), K, rho, 300, 300, seed=1,
+        x_prior_precision=float(g))
+    cfg = FitConfig(
+        model=ModelConfig(num_shards=g, factors_per_shard=K, rho=rho,
+                          x_prior_precision=float(g)),
+        run=RunConfig(burnin=300, mcmc=300, thin=1, seed=0))
+    res = fit(Y, cfg)
+    assert _rel_frob(
+        stitch_blocks(res.sigma_blocks.astype(np.float64)),
+        stitch_blocks(blocks_np)) < 0.06
+    # the knob does something: with rho high and small n, X's posterior
+    # shrinks visibly harder under the g*I prior
+    res_default = fit(Y, FitConfig(
+        model=ModelConfig(num_shards=g, factors_per_shard=K, rho=rho),
+        run=RunConfig(burnin=300, mcmc=300, thin=1, seed=0)))
+    x_g = float(np.mean(np.asarray(res.state.X) ** 2))
+    x_1 = float(np.mean(np.asarray(res_default.state.X) ** 2))
+    assert x_g != pytest.approx(x_1, rel=1e-3)
+
+
+# ---------------------------------------------------------------------------
+# Geweke joint-distribution test of the full sweep
+# ---------------------------------------------------------------------------
+
+# Tiny model; hyperparameters chosen so every monitored moment is finite
+# (as=4 keeps E[1/ps] and Var[1/ps] finite; the statistics below are
+# log-scale or second-moment, all finite under the priors).
+_G, _N, _P, _K, _RHO = 2, 6, 4, 2, 0.7
+_AS, _BS = 4.0, 2.0
+
+
+def _geweke_cfg():
+    return ModelConfig(num_shards=_G, factors_per_shard=_K, rho=_RHO,
+                       as_=_AS, bs=_BS)
+
+
+def _prior_state(key, prior):
+    """Draw a full SamplerState from the prior (matches state.init_state's
+    distributions, but with Lambda ~ N(0, 1/(psi tau)) instead of zeros -
+    the Geweke test needs the exact prior, not the reference's zero init."""
+    cfg = _geweke_cfg()
+    k_x, k_shard = jax.random.split(key)
+    X = jax.random.normal(k_x, (_N, _K))
+
+    def init_one(g):
+        kg = jax.random.fold_in(k_shard, g)
+        k_ps, k_z, k_prior, k_lam = jax.random.split(kg, 4)
+        ps = gamma_rate(k_ps, _AS, _BS, sample_shape=(_P,))
+        Z = jax.random.normal(k_z, (_N, _K))
+        prior_state = prior.init(k_prior, _P, _K)
+        plam = prior.row_precision(prior_state)
+        Lam = jax.random.normal(k_lam, (_P, _K)) / jnp.sqrt(plam)
+        return Lam, Z, ps, prior_state
+
+    Lam, Z, ps, prior_state = jax.vmap(init_one)(jnp.arange(_G))
+    return SamplerState(Lambda=Lam, Z=Z, X=X, ps=ps, prior=prior_state)
+
+
+def _sample_Y(key, state):
+    """Y | state: Y_m = eta_m Lam_m' + N(0, diag(1/ps_m))."""
+    eta = (jnp.sqrt(_RHO) * state.X[None]
+           + jnp.sqrt(1.0 - _RHO) * state.Z)
+    mean = jnp.einsum("gnk,gpk->gnp", eta, state.Lambda)
+    noise = jax.random.normal(key, mean.shape) / jnp.sqrt(
+        state.ps[:, None, :])
+    return mean + noise
+
+
+def _stats(state, Y):
+    """Scalar functionals with finite prior variance, covering every site."""
+    return jnp.stack([
+        jnp.mean(jnp.log(state.ps)),
+        jnp.mean(jnp.log(state.prior["psijh"])),
+        jnp.mean(jnp.log(state.prior["delta"])),
+        jnp.mean(state.Z ** 2),
+        jnp.mean(state.X ** 2),
+        jnp.mean(state.Lambda ** 2),
+        jnp.mean(Y ** 2),
+    ])
+
+
+_STAT_NAMES = ("log_ps", "log_psi", "log_delta", "Z2", "X2", "lam2", "Y2")
+
+
+@pytest.mark.slow
+def test_geweke_joint_distribution():
+    """Marginal-conditional (prior) vs successive-conditional (prior
+    transported through the full Gibbs sweep) moments must agree.  A bug in
+    ANY conditional - wrong weighting, wrong Cholesky orientation, wrong
+    shape/rate, cross-shard leakage - shifts the stationary distribution of
+    the successive chain away from the prior and fails the z-test."""
+    cfg = _geweke_cfg()
+    prior = make_prior(cfg)
+    M_MARG = 4000
+    M_SUCC = 20000
+    THIN = 5
+
+    # marginal-conditional: independent prior draws
+    def marg_one(key):
+        k1, k2 = jax.random.split(key)
+        state = _prior_state(k1, prior)
+        Y = _sample_Y(k2, state)
+        return _stats(state, Y)
+
+    marg = np.asarray(jax.jit(jax.vmap(marg_one))(
+        jax.random.split(jax.random.key(0), M_MARG)))
+
+    # successive-conditional: Y | state, then state | Y via the real sweep
+    def succ_body(state, key):
+        ky, ks = jax.random.split(key)
+        Y = _sample_Y(ky, state)
+        new_state = gibbs_sweep(ks, Y, state, cfg, prior)
+        return new_state, _stats(new_state, Y)
+
+    state0 = _prior_state(jax.random.key(1), prior)
+    _, succ = jax.jit(lambda s0, ks: jax.lax.scan(succ_body, s0, ks))(
+        state0, jax.random.split(jax.random.key(2), M_SUCC))
+    succ = np.asarray(succ)[500::THIN]   # drop warm-up, thin autocorrelation
+
+    for i, name in enumerate(_STAT_NAMES):
+        m1, m2 = marg[:, i].mean(), succ[:, i].mean()
+        se1 = marg[:, i].std(ddof=1) / np.sqrt(marg.shape[0])
+        # autocorrelation beyond the thinning: inflate the SE via a crude
+        # batch-means estimate
+        b = succ[:, i].reshape(-1, 20).mean(axis=1)
+        se2 = b.std(ddof=1) / np.sqrt(b.size)
+        z = abs(m1 - m2) / np.sqrt(se1 ** 2 + se2 ** 2)
+        assert z < 5.0, f"Geweke z[{name}] = {z:.2f} ({m1:.4f} vs {m2:.4f})"
