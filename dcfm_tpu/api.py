@@ -20,6 +20,7 @@ Execution layouts:
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import functools
 import os
@@ -70,12 +71,30 @@ class FitResult:
     # wall-clock per host-level chunk (SURVEY.md section 5 observability);
     # chunk_seconds[0] includes compilation.
     chunk_seconds: Optional[list] = None
+    # (p, p) entrywise posterior standard deviation of the covariance, in
+    # the caller's coordinates; set when ModelConfig.posterior_sd is on.
+    Sigma_sd: Optional[np.ndarray] = None
+    # (g, g, P, P) raw entrywise-SD blocks (shard coordinates), for
+    # posterior_sd() with custom coordinate options.
+    sigma_sd_blocks: Optional[np.ndarray] = None
 
     def covariance(self, *, destandardize=True, reinsert_zero_cols=False):
         return posterior_covariance(
             self.sigma_blocks, self.preprocess,
             destandardize=destandardize,
             reinsert_zero_cols=reinsert_zero_cols)
+
+    def posterior_sd(self, *, destandardize=True, reinsert_zero_cols=False):
+        """Entrywise posterior SD with the same coordinate options as
+        covariance() - de-standardization is entrywise-linear, so it maps
+        an SD exactly like a covariance entry."""
+        if self.sigma_sd_blocks is None:
+            raise ValueError("run with ModelConfig(posterior_sd=True)")
+        return posterior_covariance(
+            self.sigma_sd_blocks, self.preprocess,
+            destandardize=destandardize,
+            reinsert_zero_cols=reinsert_zero_cols,
+            assume_symmetric=True)
 
 
 @functools.lru_cache(maxsize=32)
@@ -184,24 +203,39 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
             out.append(num_iters % chunk)
         return out
 
-    def _run_chain(init_fn, get_chunk_fn, Yd):
-        done = 0
-        if cfg.resume:
-            if not os.path.exists(cfg.checkpoint_path):
-                raise FileNotFoundError(
-                    f"resume=True but no checkpoint at {cfg.checkpoint_path}")
+    def _resume_state(init_fn, Yd):
+        """-> (carry, done).  resume=True demands a compatible checkpoint;
+        resume="auto" (elastic recovery) falls back to a fresh start when
+        the checkpoint is missing or incompatible."""
+        auto = cfg.resume == "auto"
+        if cfg.resume and os.path.exists(cfg.checkpoint_path):
             # Compatibility first (friendly refusal on config/data mismatch),
             # then load into an eval_shape template - the real init never
             # runs, so no wasted compile and no doubled accumulator peak.
-            meta = read_checkpoint_meta(cfg.checkpoint_path)
-            reason = checkpoint_compatible(meta, cfg, fingerprint)
-            if reason is not None:
+            # In auto mode an unreadable/old-format/corrupt checkpoint is
+            # just another reason to start fresh - the elastic-recovery
+            # contract must survive library upgrades, not crash-loop on
+            # them.
+            try:
+                meta = read_checkpoint_meta(cfg.checkpoint_path)
+                reason = checkpoint_compatible(meta, cfg, fingerprint)
+            except Exception:
+                if not auto:
+                    raise
+                reason = "unreadable or incompatible checkpoint"
+            if reason is not None and not auto:
                 raise ValueError(f"refusing to resume: {reason}")
-            template = jax.eval_shape(init_fn, k_init, Yd)
-            carry, meta = load_checkpoint(cfg.checkpoint_path, template)
-            done = int(meta["iteration"])
-        else:
-            carry = init_fn(k_init, Yd)
+            if reason is None:
+                template = jax.eval_shape(init_fn, k_init, Yd)
+                carry, meta = load_checkpoint(cfg.checkpoint_path, template)
+                return carry, int(meta["iteration"])
+        elif cfg.resume and not auto:
+            raise FileNotFoundError(
+                f"resume=True but no checkpoint at {cfg.checkpoint_path}")
+        return init_fn(k_init, Yd), 0
+
+    def _run_chain(init_fn, get_chunk_fn, Yd):
+        carry, done = _resume_state(init_fn, Yd)
         stats = None
         traces = []
         chunk_secs = []
@@ -218,27 +252,30 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
 
     C = run.num_chains
     sched = schedule_array(run)
+    profile_ctx = (jax.profiler.trace(cfg.backend.profile_dir)
+                   if cfg.backend.profile_dir else contextlib.nullcontext())
     t0 = time.perf_counter()
-    if use_mesh:
-        mesh = make_mesh(n_mesh, devices)
-        shards_per_device(m.num_shards, mesh)  # validates divisibility
-        Yd = place_sharded(pre.data, mesh)
-        carry, stats, executed, traces, chunk_secs, done = _run_chain(
-            _mesh_fns(mesh, m, chunk, C)[0],
-            lambda ni: _mesh_fns(mesh, m, ni, C)[1], Yd)
-    else:
-        with jax.default_device(devices[0]):
-            Yd = jax.device_put(jnp.asarray(pre.data), devices[0])
-            # Commit the initial carry to the device explicitly: jit outputs
-            # are otherwise "uncommitted", so the second chunk call (whose
-            # carry IS committed, having flowed through a jit with the
-            # committed Yd) would present a different sharding signature and
-            # trigger a full recompile of the chunk function (~7s at the
-            # p=10k bench shape).
-            init_fn = _local_fns(m, chunk, C)[0]
+    with profile_ctx:
+        if use_mesh:
+            mesh = make_mesh(n_mesh, devices)
+            shards_per_device(m.num_shards, mesh)  # validates divisibility
+            Yd = place_sharded(pre.data, mesh)
             carry, stats, executed, traces, chunk_secs, done = _run_chain(
-                lambda k, Y: jax.device_put(init_fn(k, Y), devices[0]),
-                lambda ni: _local_fns(m, ni, C)[1], Yd)
+                _mesh_fns(mesh, m, chunk, C)[0],
+                lambda ni: _mesh_fns(mesh, m, ni, C)[1], Yd)
+        else:
+            with jax.default_device(devices[0]):
+                Yd = jax.device_put(jnp.asarray(pre.data), devices[0])
+                # Commit the initial carry to the device explicitly: jit
+                # outputs are otherwise "uncommitted", so the second chunk
+                # call (whose carry IS committed, having flowed through a
+                # jit with the committed Yd) would present a different
+                # sharding signature and trigger a full recompile of the
+                # chunk function (~7s at the p=10k bench shape).
+                init_fn = _local_fns(m, chunk, C)[0]
+                carry, stats, executed, traces, chunk_secs, done = _run_chain(
+                    lambda k, Y: jax.device_put(init_fn(k, Y), devices[0]),
+                    lambda ni: _local_fns(m, ni, C)[1], Yd)
     if stats is None:
         # resumed from a finished checkpoint: recompute the diagnostics
         # from the carried running-health panel.
@@ -247,7 +284,8 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
         stats = ChainStats(tau_log_max=h[..., 0].max(),
                            ps_min=h[..., 1].min(), ps_max=h[..., 2].max(),
                            rank_min=ranks.min(), rank_max=ranks.max(),
-                           rank_mean=ranks.mean())
+                           rank_mean=ranks.mean(),
+                           nonfinite_count=h[..., 3].sum())
     else:
         # reduce the per-chain stats leaves ((C,) arrays when num_chains > 1)
         # to the scalar cross-chain summary.
@@ -256,7 +294,8 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
             tau_log_max=np.max(stats.tau_log_max),
             ps_min=np.min(stats.ps_min), ps_max=np.max(stats.ps_max),
             rank_min=np.min(stats.rank_min), rank_max=np.max(stats.rank_max),
-            rank_mean=np.mean(stats.rank_mean))
+            rank_mean=np.mean(stats.rank_mean),
+            nonfinite_count=np.sum(stats.nonfinite_count))
 
     # Per-iteration scalar traces -> (C, executed, S) + convergence report.
     if traces:
@@ -272,14 +311,21 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
     # optionally down-cast (backend.fetch_dtype) on a slow link.
     # Chains are averaged on device first (each chain is an equal-weight
     # posterior-mean estimate, so the mixture mean is the pooled estimate).
-    fetch_dtype = jnp.dtype(cfg.backend.fetch_dtype)
-    upper = np.asarray(jax.jit(
-        lambda acc: extract_upper_blocks(
-            acc.mean(axis=0) if C > 1 else acc,
-            g=m.num_shards).astype(fetch_dtype)
-    )(carry.sigma_acc))
-    if upper.dtype != np.float32:
-        upper = upper.astype(np.float32)
+    # posterior_sd forces full-precision fetch: the SD comes from the
+    # E[X^2] - E[X]^2 difference, which reduced-precision moments cancel
+    # catastrophically (fetch_dtype's rounding is benign only for a value
+    # reported directly, not for a variance-by-differences).
+    fetch_dtype = jnp.dtype(np.float32 if m.posterior_sd
+                            else cfg.backend.fetch_dtype)
+
+    def _fetch_upper(acc):
+        return np.asarray(jax.jit(
+            lambda a: extract_upper_blocks(
+                a.mean(axis=0) if C > 1 else a,
+                g=m.num_shards).astype(fetch_dtype)
+        )(acc)).astype(np.float32, copy=False)
+
+    upper = _fetch_upper(carry.sigma_acc)
     state = jax.device_get(carry.state)  # stats is already host NumPy
     sigma_blocks = full_blocks_from_upper(upper, m.num_shards)
     # reinsert_zero_cols=True: Sigma is (p, p) in the caller's coordinates,
@@ -288,6 +334,21 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
     # assume_symmetric: the upper-blocks round trip makes it exact.
     Sigma = posterior_covariance(sigma_blocks, pre, reinsert_zero_cols=True,
                                  assume_symmetric=True)
+
+    Sigma_sd = sd_blocks = None
+    if carry.sigma_sq_acc is not None:
+        # entrywise posterior SD from the accumulated first/second moments,
+        # Bessel-corrected over the pooled draw count; de-standardization
+        # scales an SD exactly like a covariance entry (linear in the
+        # scale product), so the same restore path applies.
+        n_draws = max(run.num_saved * C, 1)
+        upper_sq = _fetch_upper(carry.sigma_sq_acc)
+        var_u = np.maximum(upper_sq - upper * upper, 0.0)
+        if n_draws > 1:
+            var_u *= n_draws / (n_draws - 1)
+        sd_blocks = full_blocks_from_upper(np.sqrt(var_u), m.num_shards)
+        Sigma_sd = posterior_covariance(
+            sd_blocks, pre, reinsert_zero_cols=True, assume_symmetric=True)
     seconds = time.perf_counter() - t0
 
     return FitResult(
@@ -304,6 +365,8 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
         traces=trace_arr,
         diagnostics=diagnostics,
         chunk_seconds=chunk_secs,
+        Sigma_sd=Sigma_sd,
+        sigma_sd_blocks=sd_blocks,
     )
 
 

@@ -50,6 +50,9 @@ def build_parser() -> argparse.ArgumentParser:
     f.add_argument("--rank-adapt", action="store_true",
                    help="adaptively truncate redundant loading columns "
                         "during burn-in (Bhattacharya-Dunson adaptation)")
+    f.add_argument("--posterior-sd", action="store_true",
+                   help="also write entrywise posterior standard deviations "
+                        "to <out>_sd.npy (second-moment accumulation)")
     f.add_argument("--chains", type=int, default=1,
                    help="independent MCMC chains (vmap axis); > 1 enables "
                         "split-R-hat in the JSON report and pools the "
@@ -94,7 +97,7 @@ def main(argv=None) -> int:
             num_shards=args.shards,
             factors_per_shard=args.factors // args.shards,
             rho=args.rho, prior=args.prior, estimator=args.estimator,
-            rank_adapt=args.rank_adapt),
+            rank_adapt=args.rank_adapt, posterior_sd=args.posterior_sd),
         run=RunConfig(burnin=args.burnin, mcmc=args.mcmc, thin=args.thin,
                       seed=args.seed, chunk_size=args.chunk_size,
                       num_chains=args.chains),
@@ -107,8 +110,17 @@ def main(argv=None) -> int:
     Sigma = (res.covariance(destandardize=False)
              if args.raw_coords else res.Sigma)
     np.save(args.out, Sigma)
+    sd_out = None
+    if res.Sigma_sd is not None:
+        root, ext = os.path.splitext(args.out)
+        sd_out = f"{root}_sd{ext or '.npy'}"
+        # same coordinate convention as the mean output (--raw-coords must
+        # apply to both files or sd/mean ratios silently mix units)
+        np.save(sd_out, res.posterior_sd(destandardize=False)
+                if args.raw_coords else res.Sigma_sd)
     print(json.dumps({
         "out": args.out,
+        "sd_out": sd_out,
         "shape": list(Sigma.shape),
         "seconds": round(res.seconds, 3),
         "iters_per_sec": round(res.iters_per_sec, 2),

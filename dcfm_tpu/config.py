@@ -106,6 +106,13 @@ class ModelConfig:
     # Residual precision hyperpriors (``divideconquer.m:62``), rate convention.
     as_: float = 1.0
     bs: float = 0.3
+    # Also accumulate the elementwise SECOND moment of the covariance draws,
+    # enabling entrywise posterior standard deviations (FitResult.Sigma_sd)
+    # - the uncertainty quantification the posterior-mean-only reference
+    # throws away (``divideconquer.m:194`` keeps nothing but the mean).
+    # Costs one extra (Gl, G, P, P) accumulator per device and a second
+    # upper-panel fetch.
+    posterior_sd: bool = False
     # Input dtype for the combine-step block matmuls (the O(p^2 K) einsum
     # that dominates save iterations).  "bfloat16" feeds the MXU at native
     # rate with float32 accumulation: per-draw ~4e-3 relative rounding that
@@ -171,6 +178,11 @@ class BackendConfig:
     # the transfer at ~5e-4 relative rounding on the *reported* Sigma only -
     # on-device accumulation stays float32.
     fetch_dtype: str = "float32"  # "float32" | "bfloat16" | "float16"
+    # If set, fit() wraps the chain in a jax.profiler trace and writes
+    # XProf/Perfetto dumps here (open with tensorboard or ui.perfetto.dev).
+    # The per-conditional named_scope labels (z_update, x_update,
+    # lambda_update, prior_update, ps_update, combine) mark the phases.
+    profile_dir: Optional[str] = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -192,8 +204,12 @@ class FitConfig:
     # With resume=True the fit restarts from the saved global iteration; the
     # per-iteration RNG keys derive from the global iteration index, so the
     # resumed chain is bitwise-identical to an uninterrupted run.
+    # resume="auto" is the elastic-recovery mode: resume when a COMPATIBLE
+    # checkpoint exists (same model/schedule/seed/data), start fresh
+    # otherwise - so a crashed job can simply be re-launched with the same
+    # config and it picks up where it died.
     checkpoint_path: Optional[str] = None
-    resume: bool = False
+    resume: "bool | str" = False  # False | True | "auto"
 
 
 def validate(cfg: FitConfig, n: int, p: int) -> None:
@@ -231,8 +247,11 @@ def validate(cfg: FitConfig, n: int, p: int) -> None:
         raise ValueError(
             f"unknown combine_dtype {m.combine_dtype!r} "
             "(float32 | bfloat16)")
+    if cfg.resume not in (False, True, "auto"):
+        raise ValueError(
+            f"resume must be False, True, or 'auto', got {cfg.resume!r}")
     if cfg.resume and not cfg.checkpoint_path:
-        raise ValueError("resume=True requires checkpoint_path")
+        raise ValueError("resume requires checkpoint_path")
     if cfg.backend.fetch_dtype not in ("float32", "bfloat16", "float16"):
         raise ValueError(
             f"unknown fetch_dtype {cfg.backend.fetch_dtype!r} "

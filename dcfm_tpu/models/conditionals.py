@@ -91,6 +91,10 @@ def gibbs_sweep(
     def weighted(Lam, ps):
         return Lam * ps[:, None]
 
+    # named_scope per conditional: the labels survive into the HLO and show
+    # up in jax.profiler / XProf traces, giving the per-phase breakdown the
+    # reference's single tic/toc lacks (SURVEY.md section 5 "Tracing").
+
     # ---- I) Z_m | rest  (``divideconquer.m:95-108``) -------------------
     def z_update(kg, Ym, Lam, ps, X):
         W = weighted(Lam, ps)                                   # (P, K)
@@ -99,9 +103,10 @@ def gibbs_sweep(
         B = sq_1mr * (R @ W)                                    # (n, K)
         return sample_mvn_precision_shared(kg, Q, B)
 
-    kz = _shard_keys(jax.random.fold_in(key, _SITE_Z), shard_offset, Gl)
-    Z = jax.vmap(z_update, in_axes=(0, 0, 0, 0, None))(
-        kz, Y, state.Lambda, state.ps, state.X)
+    with jax.named_scope("z_update"):
+        kz = _shard_keys(jax.random.fold_in(key, _SITE_Z), shard_offset, Gl)
+        Z = jax.vmap(z_update, in_axes=(0, 0, 0, 0, None))(
+            kz, Y, state.Lambda, state.ps, state.X)
 
     # ---- II) X | rest - the one cross-shard update (``:111-129``) ------
     def x_terms(Ym, Lam, ps, Zm):
@@ -111,15 +116,17 @@ def gibbs_sweep(
         B = R @ W                                               # (n, K)
         return A, B
 
-    A_loc, B_loc = jax.vmap(x_terms)(Y, state.Lambda, state.ps, Z)
-    S1 = reduce_fn(A_loc)                                       # (K, K) psum
-    S2 = reduce_fn(B_loc)                                       # (n, K) psum
-    # Model-implied prior precision is I_K (X ~ N(0, I)); the reference uses
-    # g*I (quirk Q3) - reproduce via cfg.x_prior_precision if desired.
-    Qx = cfg.x_prior_precision * jnp.eye(K, dtype=Y.dtype) + rho * S1
-    Bx = sq_r * S2
-    # Unfolded site key: X is replicated, every device must draw identically.
-    X = sample_mvn_precision_shared(jax.random.fold_in(key, _SITE_X), Qx, Bx)
+    with jax.named_scope("x_update"):
+        A_loc, B_loc = jax.vmap(x_terms)(Y, state.Lambda, state.ps, Z)
+        S1 = reduce_fn(A_loc)                                   # (K, K) psum
+        S2 = reduce_fn(B_loc)                                   # (n, K) psum
+        # Model-implied prior precision is I_K (X ~ N(0, I)); the reference
+        # uses g*I (quirk Q3) - reproduce via cfg.x_prior_precision.
+        Qx = cfg.x_prior_precision * jnp.eye(K, dtype=Y.dtype) + rho * S1
+        Bx = sq_r * S2
+        # Unfolded site key: X is replicated, every device draws identically.
+        X = sample_mvn_precision_shared(
+            jax.random.fold_in(key, _SITE_X), Qx, Bx)
 
     # ---- eta recomposition (``:131-134``) ------------------------------
     eta = sq_r * X[None] + sq_1mr * Z                           # (Gl, n, K)
@@ -143,18 +150,21 @@ def gibbs_sweep(
         B = ps[:, None] * EY.T                                  # (P, K)
         return sample_mvn_precision_batched(kg, Q, B)
 
-    kl = _shard_keys(jax.random.fold_in(key, _SITE_LAM), shard_offset, Gl)
-    Lam = jax.vmap(lam_update)(kl, Y, eta_lam, state.ps, plam)
-    if state.active is not None:
-        Lam = Lam * state.active[:, None, :]
+    with jax.named_scope("lambda_update"):
+        kl = _shard_keys(jax.random.fold_in(key, _SITE_LAM), shard_offset, Gl)
+        Lam = jax.vmap(lam_update)(kl, Y, eta_lam, state.ps, plam)
+        if state.active is not None:
+            Lam = Lam * state.active[:, None, :]
 
     # ---- shrinkage prior (psi, delta/tau or equivalent; ``:148-165``) --
-    kp = _shard_keys(jax.random.fold_in(key, _SITE_PRIOR), shard_offset, Gl)
-    if state.active is None:
-        prior_state = jax.vmap(prior.update)(kp, state.prior, Lam)
-    else:
-        prior_state = jax.vmap(prior.update)(
-            kp, state.prior, Lam, state.active)
+    with jax.named_scope("prior_update"):
+        kp = _shard_keys(jax.random.fold_in(key, _SITE_PRIOR),
+                         shard_offset, Gl)
+        if state.active is None:
+            prior_state = jax.vmap(prior.update)(kp, state.prior, Lam)
+        else:
+            prior_state = jax.vmap(prior.update)(
+                kp, state.prior, Lam, state.active)
 
     # ---- residual precisions ps | rest  (``:167-172``) -----------------
     def ps_update(kg, Ym, eta_m, Lam_m):
@@ -162,8 +172,9 @@ def gibbs_sweep(
         sse = jnp.sum(resid * resid, axis=0)                    # (P,)
         return gamma_rate(kg, cfg.as_ + 0.5 * n, cfg.bs + 0.5 * sse)
 
-    ks = _shard_keys(jax.random.fold_in(key, _SITE_PS), shard_offset, Gl)
-    ps = jax.vmap(ps_update)(ks, Y, eta, Lam)
+    with jax.named_scope("ps_update"):
+        ks = _shard_keys(jax.random.fold_in(key, _SITE_PS), shard_offset, Gl)
+        ps = jax.vmap(ps_update)(ks, Y, eta, Lam)
 
     return SamplerState(Lambda=Lam, Z=Z, X=X, ps=ps, prior=prior_state,
                         active=state.active)

@@ -45,12 +45,18 @@ class Prior(NamedTuple):
     loadings are conditioned at exactly 0, so their contributions to
     shrinkage sufficient statistics vanish and column-counting shape
     parameters count only active columns.
+
+    ``health`` maps a per-shard prior state to one scalar: the largest
+    |log global-shrinkage scale|, the quantity whose drift signals numeric
+    trouble (tau cumprod overflow for MGP - SURVEY.md section 5 names it
+    the key health metric; the analogous global scale for the others).
     """
 
     name: str
     init: Callable[[jax.Array, int, int], Any]
     update: Callable[..., Any]
     row_precision: Callable[[Any], jax.Array]
+    health: Callable[[Any], jax.Array]
 
 
 # --------------------------------------------------------------------------
@@ -126,7 +132,11 @@ def make_mgp(cfg: ModelConfig) -> Prior:
         # Plam_{j,h} = psi_jh * tau_h  (``divideconquer.m:86,:176``)
         return state["psijh"] * _mgp_tauh(state["delta"])[None, :]
 
-    return Prior("mgp", init, update, row_precision)
+    def health(state):
+        # max_h |log tau_h|: the cumprod overflow watch
+        return jnp.max(jnp.abs(jnp.cumsum(jnp.log(state["delta"]))))
+
+    return Prior("mgp", init, update, row_precision, health)
 
 
 # --------------------------------------------------------------------------
@@ -168,7 +178,11 @@ def make_horseshoe(cfg: ModelConfig) -> Prior:
     def row_precision(state):
         return 1.0 / (state["lam2"] * state["tau2"])
 
-    return Prior("horseshoe", init, update, row_precision)
+    def health(state):
+        # |log tau^2|: global horseshoe scale collapse/blowup watch
+        return jnp.abs(jnp.log(state["tau2"]))
+
+    return Prior("horseshoe", init, update, row_precision, health)
 
 
 # --------------------------------------------------------------------------
@@ -230,7 +244,11 @@ def make_dl(cfg: ModelConfig) -> Prior:
              * jnp.square(state["tau"])[:, None])
         return 1.0 / jnp.maximum(v, 1.0 / _DL_MAX_PRECISION)
 
-    return Prior("dl", init, update, row_precision)
+    def health(state):
+        # max_j |log tau_j|: per-row DL global scale watch
+        return jnp.max(jnp.abs(jnp.log(state["tau"])))
+
+    return Prior("dl", init, update, row_precision, health)
 
 
 # --------------------------------------------------------------------------

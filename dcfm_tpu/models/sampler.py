@@ -35,14 +35,21 @@ class ChainCarry(NamedTuple):
     state: SamplerState
     sigma_acc: jax.Array      # (Gl, G, P, P) running mean of Sigma row-panel
     iteration: jax.Array      # scalar int32 - global Gibbs iteration count
-    health: jax.Array         # (Gl, 3) running [max|log tau|, min ps, max ps]
-                              # over every iteration seen (not just the last)
+    health: jax.Array         # (Gl, 4) running [max |log shrink-scale|,
+                              # min ps, max ps, #iterations with non-finite
+                              # state] over every iteration seen
+    # (Gl, G, P, P) running mean of Sigma**2 (elementwise second moment) for
+    # posterior-SD estimation, or None when ModelConfig.posterior_sd is off
+    # (None keeps the default pytree structure unchanged).
+    sigma_sq_acc: Optional[jax.Array] = None
 
 
 class ChainStats(NamedTuple):
     """Numerical-health diagnostics, running over all iterations seen
     (SURVEY.md section 5 metrics)."""
-    tau_log_max: jax.Array    # max_h |log tau_h| seen - cumprod overflow watch
+    # max |log global-shrinkage scale| seen (prior-specific via Prior.health;
+    # for MGP it is the tau cumprod overflow watch)
+    tau_log_max: jax.Array
     ps_min: jax.Array
     ps_max: jax.Array
     # Effective rank (active loading columns per shard) at chunk end; equals
@@ -50,6 +57,10 @@ class ChainStats(NamedTuple):
     rank_min: jax.Array
     rank_max: jax.Array
     rank_mean: jax.Array
+    # Total (iteration, shard) pairs whose post-sweep state contained a
+    # non-finite value - a failed K x K Cholesky propagates NaN into Lambda,
+    # so this is the Cholesky-failure/NaN counter.  0 on a healthy chain.
+    nonfinite_count: jax.Array
 
 
 def effective_ranks(state: SamplerState) -> jax.Array:
@@ -60,29 +71,33 @@ def effective_ranks(state: SamplerState) -> jax.Array:
     return jnp.sum((state.active > 0).astype(jnp.float32), axis=-1)
 
 
-def _health_now(state: SamplerState) -> jax.Array:
-    """(Gl, 3) health snapshot of one state."""
-    prior = state.prior
-    if isinstance(prior, dict) and "delta" in prior:
-        log_tau = jnp.cumsum(jnp.log(prior["delta"]), axis=-1)   # (Gl, K)
-        tau_log = jnp.max(jnp.abs(log_tau), axis=-1)
-    else:
-        tau_log = jnp.zeros(state.ps.shape[0], state.ps.dtype)
+def _health_now(state: SamplerState, prior: Prior) -> jax.Array:
+    """(Gl, 4) health snapshot of one state."""
+    shrink_log = jax.vmap(prior.health)(state.prior)             # (Gl,)
+    # Non-finite watch per shard: a failed Cholesky poisons Lambda (and via
+    # eta the residual precisions); the shared X is charged to every shard.
+    bad = jnp.logical_not(
+        jnp.isfinite(state.Lambda).all(axis=(1, 2))
+        & jnp.isfinite(state.ps).all(axis=1)
+        & jnp.isfinite(state.X).all()
+        & jnp.isfinite(shrink_log)).astype(state.ps.dtype)       # (Gl,)
     return jnp.stack(
-        [tau_log, jnp.min(state.ps, axis=-1), jnp.max(state.ps, axis=-1)],
-        axis=-1)
+        [shrink_log, jnp.min(state.ps, axis=-1),
+         jnp.max(state.ps, axis=-1), bad], axis=-1)
 
 
 def _health_init(num_local_shards: int, dtype) -> jax.Array:
     return jnp.broadcast_to(
-        jnp.asarray([0.0, jnp.inf, 0.0], dtype), (num_local_shards, 3))
+        jnp.asarray([0.0, jnp.inf, 0.0, 0.0], dtype),
+        (num_local_shards, 4))
 
 
 def _health_update(running: jax.Array, now: jax.Array) -> jax.Array:
     return jnp.stack([
         jnp.maximum(running[:, 0], now[:, 0]),
         jnp.minimum(running[:, 1], now[:, 1]),
-        jnp.maximum(running[:, 2], now[:, 2])], axis=-1)
+        jnp.maximum(running[:, 2], now[:, 2]),
+        running[:, 3] + now[:, 3]], axis=-1)
 
 
 # Names of the per-iteration scalar chain summaries emitted by run_chunk's
@@ -161,7 +176,9 @@ def init_chain(
     sigma_acc = jnp.zeros((Gl, num_global_shards, P, P), dtype)
     return ChainCarry(state=state, sigma_acc=sigma_acc,
                       iteration=jnp.zeros((), jnp.int32),
-                      health=_health_init(Gl, dtype))
+                      health=_health_init(Gl, dtype),
+                      sigma_sq_acc=(jnp.zeros_like(sigma_acc)
+                                    if cfg.posterior_sd else None))
 
 
 def run_chunk(
@@ -205,7 +222,8 @@ def run_chunk(
         if cfg.rank_adapt:
             state = adapt_rank(it_key, state, it, burnin, cfg)
 
-        def accumulate(acc):
+        def accumulate(accs):
+            acc, acc_sq = accs
             Lam_all = gather_fn(state.Lambda)
             if cfg.estimator == "scaled":
                 eta = (jnp.sqrt(cfg.rho) * state.X[None]
@@ -218,14 +236,21 @@ def run_chunk(
                 eta_local=eta, eta_all=eta_all,
                 compute_dtype=(jnp.bfloat16
                                if cfg.combine_dtype == "bfloat16" else None))
-            return acc + blocks * inv_eff
+            acc = acc + blocks * inv_eff
+            if acc_sq is not None:
+                acc_sq = acc_sq + (blocks * blocks) * inv_eff
+            return acc, acc_sq
 
         save = jnp.logical_and(it > burnin, (it - burnin) % thin == 0)
-        sigma_acc = lax.cond(save, accumulate, lambda a: a, carry.sigma_acc)
-        health = _health_update(carry.health, _health_now(state))
-        trace = _trace_now(state, reduce_fn, carry.sigma_acc.shape[1],
-                           cfg.rho)
-        return ChainCarry(state, sigma_acc, it, health), trace
+        with jax.named_scope("combine"):
+            sigma_acc, sigma_sq_acc = lax.cond(
+                save, accumulate, lambda a: a,
+                (carry.sigma_acc, carry.sigma_sq_acc))
+        with jax.named_scope("health_trace"):
+            health = _health_update(carry.health, _health_now(state, prior))
+            trace = _trace_now(state, reduce_fn, carry.sigma_acc.shape[1],
+                               cfg.rho)
+        return ChainCarry(state, sigma_acc, it, health, sigma_sq_acc), trace
 
     keys = jax.vmap(lambda i: jax.random.fold_in(key, i))(
         carry.iteration + jnp.arange(num_iters))
@@ -239,5 +264,6 @@ def run_chunk(
         rank_min=jnp.min(ranks),
         rank_max=jnp.max(ranks),
         rank_mean=jnp.mean(ranks),
+        nonfinite_count=jnp.sum(carry.health[:, 3]),
     )
     return carry, stats, trace

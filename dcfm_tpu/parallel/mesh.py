@@ -29,6 +29,38 @@ def make_mesh(num_devices: int = 0, devices=None) -> Mesh:
     return Mesh(np.array(devices), (SHARD_AXIS,))
 
 
+def initialize_multihost(coordinator_address=None, num_processes=None,
+                         process_id=None) -> Mesh:
+    """Join a multi-host run and return the global shard mesh (DCN path).
+
+    The reference has no distributed backend at all (SURVEY.md section 2:
+    "no MPI/NCCL/Gloo/parpool"); here multi-host is the same XLA-collective
+    design stretched over DCN: each host calls this once at startup, the
+    JAX distributed runtime wires the hosts together, and the returned mesh
+    spans every chip in the slice.  ``build_mesh_chain`` then works
+    unchanged - the X update's psum and the combine's all_gather ride ICI
+    within a host and DCN across hosts, inserted by XLA from the same
+    ``shard_map`` program that the tests pin on the virtual mesh.
+
+    Under a TPU slice launched through a cluster scheduler (GKE/Borg-style),
+    all three arguments auto-detect; pass them explicitly elsewhere.  Data
+    feeding at multi-host scale: give each process only its own row-panel
+    of shards and build the global array with
+    ``jax.make_array_from_process_local_data`` over
+    ``NamedSharding(mesh, shard_spec())`` instead of ``place_sharded``
+    (which assumes the full (g, n, P) array is host-local).
+
+    Single-process calls (the only case testable on this box) skip the
+    distributed init and return the local mesh.
+    """
+    if num_processes is not None and num_processes > 1 or (
+            coordinator_address is not None):
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes, process_id=process_id)
+    return make_mesh(0, jax.devices())
+
+
 def shards_per_device(num_shards: int, mesh: Mesh) -> int:
     d = mesh.shape[SHARD_AXIS]
     if num_shards % d != 0:

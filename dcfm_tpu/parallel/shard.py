@@ -66,6 +66,7 @@ def build_mesh_chain(
     *,
     num_iters: int,
     num_chains: int = 1,
+    compiler_options: Optional[dict] = None,
 ):
     """Returns jitted (init_fn, chunk_fn) operating on mesh-sharded arrays.
 
@@ -79,6 +80,13 @@ def build_mesh_chain(
     each device runs all chains for its local shards), with per-chain keys
     folded from the chain index exactly as the single-device layout does,
     so mesh and vmap runs stay chain-for-chain identical.
+
+    ``compiler_options`` passes XLA DebugOptions to both jits.  The one that
+    matters on a *virtual* (host-platform) mesh at heavy per-device shapes:
+    ``xla_cpu_collective_call_terminate_timeout_seconds`` - device threads
+    timeshare the host cores, so the slowest can reach an all-reduce long
+    after the first, and XLA's default 40 s rendezvous termination kills
+    the process (scripts/pod_scale_demo.py raises it).
     """
     g = cfg.num_shards
     gl = shards_per_device(g, mesh)
@@ -96,7 +104,8 @@ def build_mesh_chain(
                                   prior=jax.tree.map(lambda _: sh_c, prior_leaf_tree),
                                   active=sh_c if cfg.rank_adapt else None)
         return ChainCarry(state=state_spec, sigma_acc=sh_c, iteration=rep,
-                          health=sh_c)
+                          health=sh_c,
+                          sigma_sq_acc=sh_c if cfg.posterior_sd else None)
 
     # Build a template of the prior pytree structure to spec it out.
     import jax.numpy as jnp  # noqa: F811
@@ -139,19 +148,20 @@ def build_mesh_chain(
             rank_min=lax.pmin(stats.rank_min, SHARD_AXIS),
             rank_max=lax.pmax(stats.rank_max, SHARD_AXIS),
             # devices hold equal shard counts, so the mean of means is exact
-            rank_mean=lax.pmean(stats.rank_mean, SHARD_AXIS))
+            rank_mean=lax.pmean(stats.rank_mean, SHARD_AXIS),
+            nonfinite_count=lax.psum(stats.nonfinite_count, SHARD_AXIS))
         return carry, stats, trace
 
     specs = carry_specs()
     init_fn = jax.jit(shard_map(
         _init, mesh=mesh,
         in_specs=(rep, sh),
-        out_specs=specs))
+        out_specs=specs), compiler_options=compiler_options)
     chunk_fn = jax.jit(shard_map(
         _chunk, mesh=mesh,
         in_specs=(rep, sh, specs, rep),
         out_specs=(specs, ChainStats(*([rep] * len(ChainStats._fields))),
-                   rep)))
+                   rep)), compiler_options=compiler_options)
     return init_fn, chunk_fn
 
 

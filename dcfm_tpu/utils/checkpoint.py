@@ -36,7 +36,10 @@ from dcfm_tpu.config import (
     AdaptConfig, BackendConfig, DLConfig, FitConfig, HorseshoeConfig,
     MGPConfig, ModelConfig, RunConfig)
 
-_FORMAT_VERSION = 1
+# v2: the carried health panel grew from (Gl, 3) to (Gl, 4) (non-finite
+# counter); v1 checkpoints refuse with a version message rather than a
+# confusing leaf-shape error.
+_FORMAT_VERSION = 2
 
 
 def data_fingerprint(data: np.ndarray) -> str:

@@ -22,19 +22,37 @@ Total ~1.3 GB/device; a TPU v5e (16 GB HBM) holds it 12x over.  At p=100k
 (P=391) the panel is 5 GB/device - still fits; beyond that, shard P or
 stream panels per saved draw.
 
-Run:  python scripts/pod_scale_demo.py          (~2-4 min on 8 virtual CPUs)
+Run:  python scripts/pod_scale_demo.py          (~4-8 min on 8 virtual CPUs)
+
+Caveat for 1-core hosts: XLA CPU executes each device's big combine einsum
+to completion on the shared intra-op worker, so the 8 device threads reach
+each all-reduce serially; when the gap exceeds XLA's hard-coded 40 s
+rendezvous termination (rendezvous.cc), the process aborts by design.  At
+the full p=50k shape on one core this is a coin flip (observed 2-in-3
+pass); PODDEMO_P overrides the per-shard width (the layout - 256 shards,
+32/device, psum + all_gather - is identical at any P).  Real multi-core /
+multi-chip meshes do not hit this.
 """
 
 import os
 import sys
 import time
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 # Virtual 8-device CPU platform, forced before backend init (same recipe as
-# tests/conftest.py; on a real 8-chip TPU host, drop these two lines).
+# tests/conftest.py; on a real 8-chip TPU host, drop these lines).  The
+# collective timeouts matter at THIS scale on a virtual mesh: 8 device
+# threads timeshare the host cores, so the slowest thread can reach an
+# all-reduce tens of seconds after the first - XLA's default 40 s
+# termination timeout then kills the process by design ("Exiting to ensure
+# a consistent program state").  Real multi-chip meshes don't need this.
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
+    flags += " --xla_force_host_platform_device_count=8"
+if "collective_timeout" not in flags:
+    flags += " --xla_cpu_collective_timeout_seconds=1200"
+os.environ["XLA_FLAGS"] = flags.strip()
 
 import jax  # noqa: E402
 
@@ -70,7 +88,13 @@ def run_demo(g=256, n_devices=8, P=196, n=16, K=2, iters=3, seed=0,
               f"{p * p * 4 / 1e9:.1f} GB never on one device)")
 
     t0 = time.perf_counter()
-    init_fn, chunk_fn = build_mesh_chain(mesh, cfg, prior, num_iters=iters)
+    # Raise the collective rendezvous timeouts: on the 1-core virtual mesh
+    # the 8 device threads reach each all-reduce up to minutes apart (see
+    # build_mesh_chain docstring); XLA's 40 s default aborts the process.
+    opts = {"xla_cpu_collective_call_warn_stuck_seconds": "600",
+            "xla_cpu_collective_call_terminate_timeout_seconds": "3600"}
+    init_fn, chunk_fn = build_mesh_chain(mesh, cfg, prior, num_iters=iters,
+                                         compiler_options=opts)
     Yd = place_sharded(Y, mesh)
     key = jax.random.key(seed)
     carry = init_fn(key, Yd)
@@ -111,5 +135,5 @@ import jax.numpy as jnp  # noqa: E402
 
 
 if __name__ == "__main__":
-    run_demo()
+    run_demo(P=int(os.environ.get("PODDEMO_P", 196)))
     sys.exit(0)

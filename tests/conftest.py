@@ -25,6 +25,18 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_between_modules():
+    """Bound the number of live compiled executables in the long-running
+    suite process.  Without this, the accumulated compile/executable state
+    from ~95 tests makes a later XLA CPU *compilation* segfault
+    deterministically (observed at test_shard's 16-shard mesh program;
+    every file passes in isolation).  Clearing per module costs some
+    recompiles but keeps the process state bounded."""
+    yield
+    jax.clear_caches()
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(0)

@@ -183,6 +183,90 @@ class _CarryLike(NamedTuple):
     iteration: np.ndarray
 
 
+def test_resume_auto_elastic_recovery(tmp_path, monkeypatch, data):
+    """resume="auto": a re-launched crashed job picks up from its own
+    checkpoint; with no checkpoint (first launch) or an incompatible one it
+    starts fresh instead of refusing - the elastic-recovery contract."""
+    import dcfm_tpu.api as api
+
+    ck = str(tmp_path / "auto.npz")
+    cfg_auto = dataclasses.replace(_cfg(), checkpoint_path=ck, resume="auto")
+
+    # first launch: no checkpoint -> fresh run, no error
+    res_fresh = fit(data, cfg_auto)
+    res_full = fit(data, _cfg())
+    np.testing.assert_array_equal(res_fresh.sigma_blocks,
+                                  res_full.sigma_blocks)
+
+    # crash mid-run, re-launch with the SAME config -> resumes
+    real_save = api.save_checkpoint
+    calls = {"n": 0}
+
+    def killing_save(*args, **kwargs):
+        real_save(*args, **kwargs)
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise Killed("boom")
+
+    import os
+
+    os.unlink(ck)
+    monkeypatch.setattr(api, "save_checkpoint", killing_save)
+    with pytest.raises(Killed):
+        fit(data, cfg_auto)
+    monkeypatch.setattr(api, "save_checkpoint", real_save)
+    _, meta = load_checkpoint_meta(ck)
+    assert meta["iteration"] == 8
+    res_resumed = fit(data, cfg_auto)
+    np.testing.assert_array_equal(res_resumed.sigma_blocks,
+                                  res_full.sigma_blocks)
+    assert res_resumed.config.resume == "auto"
+
+    # incompatible checkpoint (different seed) -> auto falls back to fresh
+    cfg_other_seed = dataclasses.replace(
+        _cfg(seed=99), checkpoint_path=ck, resume="auto")
+    res_other = fit(data, cfg_other_seed)
+    assert res_other.iters_per_sec > 0     # ran all 32 iters fresh
+    # strict resume=True must still refuse the mismatch (now seed 99's ckpt)
+    with pytest.raises(ValueError, match="refusing to resume"):
+        fit(data, dataclasses.replace(_cfg(), checkpoint_path=ck,
+                                      resume=True))
+
+
+def test_resume_auto_survives_bad_checkpoint(tmp_path, data):
+    """Elastic recovery must not crash-loop on an unreadable or old-format
+    checkpoint: auto falls back to fresh; strict resume=True still raises."""
+    import json
+
+    ck = str(tmp_path / "bad.npz")
+    # a corrupt file
+    with open(ck, "wb") as f:
+        f.write(b"not an npz at all")
+    cfg_auto = dataclasses.replace(_cfg(), checkpoint_path=ck, resume="auto")
+    res = fit(data, cfg_auto)          # no raise; fresh run (overwrites ck)
+    assert res.iters_per_sec > 0
+
+    # an old-format checkpoint: rewrite the saved meta to version 1
+    with np.load(ck) as z:
+        entries = {k: z[k] for k in z.files}
+    meta = json.loads(bytes(entries["__meta__"]).decode())
+    meta["version"] = 1
+    entries["__meta__"] = np.frombuffer(
+        json.dumps(meta).encode(), dtype=np.uint8)
+    np.savez(ck, **entries)
+    res = fit(data, cfg_auto)          # auto: fresh again, no raise
+    assert res.iters_per_sec > 0
+    with np.load(ck) as z:             # restore v1 marker for the strict case
+        entries = {k: z[k] for k in z.files}
+    meta = json.loads(bytes(entries["__meta__"]).decode())
+    meta["version"] = 1
+    entries["__meta__"] = np.frombuffer(
+        json.dumps(meta).encode(), dtype=np.uint8)
+    np.savez(ck, **entries)
+    with pytest.raises(ValueError, match="format"):
+        fit(data, dataclasses.replace(cfg_auto, resume=True))
+
+
 def test_save_load_roundtrip_and_fingerprint(tmp_path):
     """Unit: leaves round-trip exactly; fingerprint is content-sensitive."""
     carry = _CarryLike(a=np.arange(12.0).reshape(3, 4),

@@ -1,0 +1,91 @@
+"""RNG key-lineage tests (SURVEY.md section 5 "Race detection" analogue,
+section 7 "Hard parts: RNG discipline").
+
+The TPU analogue of a data race is PRNG-key reuse: two sites (or two
+shards, or two iterations, or two chains) drawing from the same stream
+silently correlates what the model assumes independent.  These tests pin
+the key-derivation contract directly, complementing the mesh==vmap
+equivalence tests that pin it end-to-end.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dcfm_tpu.models.conditionals import (
+    _SITE_LAM, _SITE_PRIOR, _SITE_PS, _SITE_X, _SITE_Z, _shard_keys)
+from dcfm_tpu.models.adapt import _SITE_ADAPT
+from dcfm_tpu.models.sampler import chain_keys
+
+
+def _key_data(k):
+    return np.asarray(jax.random.key_data(k)).reshape(-1)
+
+
+def test_site_ids_are_distinct():
+    sites = [_SITE_Z, _SITE_X, _SITE_LAM, _SITE_PRIOR, _SITE_PS, _SITE_ADAPT]
+    assert len(set(sites)) == len(sites)
+
+
+def test_site_keys_differ_per_site_and_shard():
+    key = jax.random.key(0)
+    seen = set()
+    for site in (_SITE_Z, _SITE_X, _SITE_LAM, _SITE_PRIOR, _SITE_PS,
+                 _SITE_ADAPT):
+        site_key = jax.random.fold_in(key, site)
+        seen.add(tuple(_key_data(site_key)))
+        shard_keys = _shard_keys(site_key, 0, 4)
+        for g in range(4):
+            seen.add(tuple(_key_data(shard_keys[g])))
+    # 6 site keys + 6*4 shard keys, all distinct
+    assert len(seen) == 6 + 6 * 4
+
+
+def test_shard_keys_depend_on_global_not_local_index():
+    """Device d's local shard i must draw the stream of GLOBAL shard
+    offset+i: the mesh layout derives identical streams to the vmap layout."""
+    site_key = jax.random.fold_in(jax.random.key(7), _SITE_Z)
+    all_keys = _shard_keys(site_key, 0, 8)          # vmap layout: shards 0-7
+    dev1_keys = _shard_keys(site_key, 4, 4)         # mesh device 1: shards 4-7
+    np.testing.assert_array_equal(
+        jax.random.key_data(all_keys[4:]), jax.random.key_data(dev1_keys))
+
+
+def test_iteration_keys_derive_from_global_index():
+    """run_chunk folds the chunk key with the GLOBAL iteration index, so
+    chunking/resume cannot change the chain (test_chunked_run_matches_
+    single_scan pins this end-to-end; here: the streams really differ per
+    iteration and match across chunk boundaries)."""
+    key = jax.random.key(3)
+    # chunk A covering iterations 0..9, chunk B covering 5..14
+    a = jax.vmap(lambda i: jax.random.fold_in(key, i))(jnp.arange(0, 10))
+    b = jax.vmap(lambda i: jax.random.fold_in(key, i))(jnp.arange(5, 15))
+    np.testing.assert_array_equal(
+        jax.random.key_data(a[5:]), jax.random.key_data(b[:5]))
+    flat = np.asarray(jax.random.key_data(a)).reshape(10, -1)
+    assert len({tuple(r) for r in flat}) == 10
+
+
+def test_chain_keys_distinct_and_shared_across_layouts():
+    key = jax.random.key(11)
+    ks = chain_keys(key, 4)
+    flat = np.asarray(jax.random.key_data(ks)).reshape(4, -1)
+    assert len({tuple(r) for r in flat}) == 4
+    # the derivation is fold_in(key, c) - the contract both the local vmap
+    # path and the mesh path rely on for chain-for-chain equality
+    for c in range(4):
+        np.testing.assert_array_equal(
+            jax.random.key_data(ks[c]),
+            jax.random.key_data(jax.random.fold_in(key, c)))
+
+
+def test_x_site_key_is_shard_independent():
+    """The shared factor X must be drawn from the UNFOLDED site key so every
+    device samples the identical replicated X (conditionals.py docstring);
+    pin that the X site stream differs from every per-shard stream."""
+    key = jax.random.key(0)
+    x_key = _key_data(jax.random.fold_in(key, _SITE_X))
+    for site in (_SITE_Z, _SITE_LAM, _SITE_PRIOR, _SITE_PS):
+        sk = jax.random.fold_in(key, site)
+        for g in range(4):
+            assert tuple(_key_data(_shard_keys(sk, 0, 4)[g])) != tuple(x_key)
