@@ -226,9 +226,16 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
             if reason is not None and not auto:
                 raise ValueError(f"refusing to resume: {reason}")
             if reason is None:
-                template = jax.eval_shape(init_fn, k_init, Yd)
-                carry, meta = load_checkpoint(cfg.checkpoint_path, template)
-                return carry, int(meta["iteration"])
+                # the payload load can fail on its own (corrupt leaf data
+                # behind a healthy meta entry) - same auto-mode fallback
+                try:
+                    template = jax.eval_shape(init_fn, k_init, Yd)
+                    carry, meta = load_checkpoint(
+                        cfg.checkpoint_path, template)
+                    return carry, int(meta["iteration"])
+                except Exception:
+                    if not auto:
+                        raise
         elif cfg.resume and not auto:
             raise FileNotFoundError(
                 f"resume=True but no checkpoint at {cfg.checkpoint_path}")

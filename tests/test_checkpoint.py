@@ -267,6 +267,25 @@ def test_resume_auto_survives_bad_checkpoint(tmp_path, data):
         fit(data, dataclasses.replace(cfg_auto, resume=True))
 
 
+def test_resume_auto_survives_corrupt_payload(tmp_path, data):
+    """Healthy meta + corrupt leaf payload: auto still falls back to fresh
+    (the load itself raises, not just the compat check)."""
+    ck = str(tmp_path / "half.npz")
+    cfg_ck = dataclasses.replace(_cfg(), checkpoint_path=ck)
+    fit(data, cfg_ck)                       # writes a good checkpoint
+    with np.load(ck) as z:
+        entries = {k: z[k] for k in z.files}
+    entries["leaf_0"] = np.zeros((3, 3), np.float32)   # wrong shape
+    np.savez(ck, **entries)
+    res = fit(data, dataclasses.replace(cfg_ck, resume="auto"))
+    assert res.iters_per_sec > 0            # fresh run, no raise
+    # strict mode still surfaces the error
+    entries["leaf_0"] = np.zeros((3, 3), np.float32)
+    np.savez(ck, **entries)
+    with pytest.raises(ValueError, match="shape"):
+        fit(data, dataclasses.replace(cfg_ck, resume=True))
+
+
 def test_save_load_roundtrip_and_fingerprint(tmp_path):
     """Unit: leaves round-trip exactly; fingerprint is content-sensitive."""
     carry = _CarryLike(a=np.arange(12.0).reshape(3, 4),

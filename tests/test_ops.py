@@ -62,6 +62,53 @@ def test_batched_sampler_moments(rng):
         np.testing.assert_allclose(np.cov(draws[:, j].T), cov_expect, atol=0.05)
 
 
+def test_batched_sampler_unrolled_matches_lax_linalg(rng):
+    """The statically-unrolled small-K path and the lax.linalg fallback are
+    the same sampler: identical keys must give (float-tolerance) identical
+    draws.  Pins both branches - the suite's model tests only ever exercise
+    K <= _UNROLL_MAX_K."""
+    from dcfm_tpu.ops import gaussian
+
+    for K in (1, 2, 8, gaussian._UNROLL_MAX_K):
+        P = 40
+        Qs = np.stack([_random_spd(rng, K, 2.0 + i % 3) for i in range(P)])
+        bs = rng.normal(size=(P, K))
+        key = jax.random.key(5)
+        fast = np.asarray(sample_mvn_precision_batched(
+            key, jnp.asarray(Qs, jnp.float32), jnp.asarray(bs, jnp.float32)))
+        # force the lax.linalg branch by lowering the threshold
+        orig = gaussian._UNROLL_MAX_K
+        try:
+            gaussian._UNROLL_MAX_K = 0
+            ref = np.asarray(sample_mvn_precision_batched(
+                key, jnp.asarray(Qs, jnp.float32),
+                jnp.asarray(bs, jnp.float32)))
+        finally:
+            gaussian._UNROLL_MAX_K = orig
+        np.testing.assert_allclose(fast, ref, rtol=2e-4, atol=2e-5)
+
+
+def test_batched_sampler_large_k_fallback_moments(rng):
+    """K above the unroll threshold exercises the lax.linalg branch
+    end-to-end (factors_per_shard > 16 is a legal config)."""
+    from dcfm_tpu.ops.gaussian import _UNROLL_MAX_K
+
+    K, P = _UNROLL_MAX_K + 2, 2
+    Qs = np.stack([_random_spd(rng, K, 3.0) for _ in range(P)])
+    bs = rng.normal(size=(P, K))
+    reps = 4000
+    keys = jax.random.split(jax.random.key(9), reps)
+    draws = np.asarray(jax.vmap(
+        lambda k: sample_mvn_precision_batched(
+            k, jnp.asarray(Qs, jnp.float32), jnp.asarray(bs, jnp.float32))
+    )(keys))
+    for j in range(P):
+        mean_expect = np.linalg.solve(Qs[j], bs[j])
+        se = np.sqrt(np.diag(np.linalg.inv(Qs[j])) / reps)
+        np.testing.assert_allclose(draws[:, j].mean(0), mean_expect,
+                                   atol=float(5 * se.max()) + 0.02)
+
+
 def test_gamma_rate_convention():
     """Gamma(shape, rate): mean = shape/rate, var = shape/rate^2 (quirk Q8)."""
     shape, rate = 2.5, 4.0
