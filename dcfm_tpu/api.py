@@ -44,7 +44,7 @@ from dcfm_tpu.utils.checkpoint import (
     checkpoint_compatible, data_fingerprint, load_checkpoint,
     read_checkpoint_meta, save_checkpoint)
 from dcfm_tpu.utils.estimate import (
-    extract_upper_blocks, full_blocks_from_upper, posterior_covariance)
+    assemble_from_upper, extract_upper_blocks, full_blocks_from_upper)
 from dcfm_tpu.utils.preprocess import PreprocessResult, preprocess
 
 
@@ -53,8 +53,11 @@ class FitResult:
     Sigma: np.ndarray              # (p, p) posterior-mean covariance in the
                                    # caller's coordinates (de-permuted,
                                    # de-standardized, zero cols reinserted)
-    sigma_blocks: np.ndarray       # (g, g, P, P) raw block accumulator,
-                                   # averaged over chains when num_chains > 1
+    # (g(g+1)/2, P, P) upper-triangle block panels as fetched from the
+    # device (chain-averaged); the dense (g, g, P, P) grid is derived
+    # lazily via .sigma_blocks - at p=50k the grid is ~10 GB that most
+    # callers never need.
+    upper_panels: np.ndarray
     preprocess: PreprocessResult
     state: Any                     # final SamplerState (host pytree); leaves
                                    # gain a leading chain axis if num_chains>1
@@ -74,13 +77,27 @@ class FitResult:
     # (p, p) entrywise posterior standard deviation of the covariance, in
     # the caller's coordinates; set when ModelConfig.posterior_sd is on.
     Sigma_sd: Optional[np.ndarray] = None
-    # (g, g, P, P) raw entrywise-SD blocks (shard coordinates), for
-    # posterior_sd() with custom coordinate options.
-    sigma_sd_blocks: Optional[np.ndarray] = None
+    # (g(g+1)/2, P, P) entrywise-SD upper panels (shard coordinates); the
+    # dense grid is derived lazily via .sigma_sd_blocks.
+    sd_upper_panels: Optional[np.ndarray] = None
+
+    @functools.cached_property
+    def sigma_blocks(self) -> np.ndarray:
+        """(g, g, P, P) dense block accumulator, derived from the upper
+        panels on first access (chain-averaged when num_chains > 1)."""
+        return full_blocks_from_upper(self.upper_panels,
+                                      self.config.model.num_shards)
+
+    @functools.cached_property
+    def sigma_sd_blocks(self) -> Optional[np.ndarray]:
+        if self.sd_upper_panels is None:
+            return None
+        return full_blocks_from_upper(self.sd_upper_panels,
+                                      self.config.model.num_shards)
 
     def covariance(self, *, destandardize=True, reinsert_zero_cols=False):
-        return posterior_covariance(
-            self.sigma_blocks, self.preprocess,
+        return assemble_from_upper(
+            self.upper_panels, self.preprocess,
             destandardize=destandardize,
             reinsert_zero_cols=reinsert_zero_cols)
 
@@ -88,13 +105,12 @@ class FitResult:
         """Entrywise posterior SD with the same coordinate options as
         covariance() - de-standardization is entrywise-linear, so it maps
         an SD exactly like a covariance entry."""
-        if self.sigma_sd_blocks is None:
+        if self.sd_upper_panels is None:
             raise ValueError("run with ModelConfig(posterior_sd=True)")
-        return posterior_covariance(
-            self.sigma_sd_blocks, self.preprocess,
+        return assemble_from_upper(
+            self.sd_upper_panels, self.preprocess,
             destandardize=destandardize,
-            reinsert_zero_cols=reinsert_zero_cols,
-            assume_symmetric=True)
+            reinsert_zero_cols=reinsert_zero_cols)
 
 
 @functools.lru_cache(maxsize=32)
@@ -334,15 +350,14 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
 
     upper = _fetch_upper(carry.sigma_acc)
     state = jax.device_get(carry.state)  # stats is already host NumPy
-    sigma_blocks = full_blocks_from_upper(upper, m.num_shards)
     # reinsert_zero_cols=True: Sigma is (p, p) in the caller's coordinates,
     # with zero rows/cols for all-zero input columns (variance of a constant
     # is 0) - indices never shift (the reference's Q7 drops them silently).
-    # assume_symmetric: the upper-blocks round trip makes it exact.
-    Sigma = posterior_covariance(sigma_blocks, pre, reinsert_zero_cols=True,
-                                 assume_symmetric=True)
+    # assemble_from_upper: the native one-pass conquer assembler (NumPy
+    # fallback inside).
+    Sigma = assemble_from_upper(upper, pre, reinsert_zero_cols=True)
 
-    Sigma_sd = sd_blocks = None
+    Sigma_sd = sd_upper = None
     if carry.sigma_sq_acc is not None:
         # entrywise posterior SD from the accumulated first/second moments,
         # Bessel-corrected over the pooled draw count; de-standardization
@@ -353,14 +368,14 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
         var_u = np.maximum(upper_sq - upper * upper, 0.0)
         if n_draws > 1:
             var_u *= n_draws / (n_draws - 1)
-        sd_blocks = full_blocks_from_upper(np.sqrt(var_u), m.num_shards)
-        Sigma_sd = posterior_covariance(
-            sd_blocks, pre, reinsert_zero_cols=True, assume_symmetric=True)
+        sd_upper = np.sqrt(var_u)
+        Sigma_sd = assemble_from_upper(sd_upper, pre,
+                                       reinsert_zero_cols=True)
     seconds = time.perf_counter() - t0
 
     return FitResult(
         Sigma=Sigma,
-        sigma_blocks=sigma_blocks,
+        upper_panels=upper,
         preprocess=pre,
         state=state,
         stats=stats,
@@ -373,7 +388,7 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
         diagnostics=diagnostics,
         chunk_seconds=chunk_secs,
         Sigma_sd=Sigma_sd,
-        sigma_sd_blocks=sd_blocks,
+        sd_upper_panels=sd_upper,
     )
 
 

@@ -1,0 +1,130 @@
+"""Native (C++) host-side kernels, built on demand and loaded via ctypes.
+
+The TPU compute path is JAX/XLA; the host-side runtime around it is native
+where it is hot: the final covariance assembly (utils/estimate.py) is a
+memory-bound O(p^2) scatter that NumPy needs four passes for and this
+extension does in one (see assemble.cpp).
+
+Build model: zero-dependency on-demand compilation.  pybind11 is not
+available in the image, so the extension is a plain ``extern "C"`` shared
+object compiled with g++ at first use (cached next to the source, rebuilt
+when the source is newer) and bound with ctypes.  Everything degrades
+gracefully: if no compiler is present or the build fails, callers fall
+back to the NumPy path (``assemble_covariance`` returns None).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+import threading
+from typing import Optional
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "assemble.cpp")
+_LIB = os.path.join(_DIR, "_assemble.so")
+
+_lock = threading.Lock()
+_lib = None
+_build_failed = False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _build_failed
+    with _lock:
+        if _lib is not None or _build_failed:
+            return _lib
+        try:
+            # a shipped prebuilt .so without the source stays usable; only
+            # rebuild when the source exists and is newer
+            stale = (os.path.exists(_SRC)
+                     and (not os.path.exists(_LIB)
+                          or os.path.getmtime(_LIB) < os.path.getmtime(_SRC)))
+            if stale:
+                # per-process temp name: concurrent builders (e.g. parallel
+                # test workers) must not clobber each other's half-written
+                # object before the atomic rename
+                fd, tmp = tempfile.mkstemp(dir=_DIR, suffix=".so.tmp")
+                os.close(fd)
+                try:
+                    subprocess.run(
+                        ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+                         "-o", tmp, _SRC],
+                        check=True, capture_output=True)
+                    os.replace(tmp, _LIB)
+                finally:
+                    if os.path.exists(tmp):
+                        os.unlink(tmp)
+            lib = ctypes.CDLL(_LIB)
+            fn = lib.assemble_covariance
+            fn.restype = None
+            fn.argtypes = [
+                ctypes.POINTER(ctypes.c_float),   # upper
+                ctypes.c_int64,                   # n_pairs
+                ctypes.c_int64,                   # P
+                ctypes.POINTER(ctypes.c_int32),   # r_idx
+                ctypes.POINTER(ctypes.c_int32),   # c_idx
+                ctypes.POINTER(ctypes.c_float),   # scale
+                ctypes.POINTER(ctypes.c_int64),   # map
+                ctypes.POINTER(ctypes.c_float),   # out
+                ctypes.c_int64,                   # p_out
+            ]
+            _lib = lib
+        except Exception:
+            _build_failed = True
+            _lib = None
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def _ptr(a: np.ndarray, ctype):
+    return a.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+def assemble_covariance(
+    upper: np.ndarray,
+    r_idx: np.ndarray,
+    c_idx: np.ndarray,
+    scale: np.ndarray,
+    out_map: np.ndarray,
+    p_out: int,
+) -> Optional[np.ndarray]:
+    """One-pass upper-panels -> final (p_out, p_out) covariance.
+
+    Returns None when the native library is unavailable (callers fall back
+    to the NumPy path).  See assemble.cpp for the argument contract.
+    """
+    lib = _load()
+    if lib is None:
+        return None
+    n_pairs, P, P2 = upper.shape
+    if P != P2:
+        raise ValueError(f"upper blocks must be square, got {upper.shape}")
+    g = int(r_idx.max()) + 1 if n_pairs else 0
+    if n_pairs != g * (g + 1) // 2:
+        raise ValueError(
+            f"{n_pairs} pairs is not a full upper triangle (g={g})")
+    upper = np.ascontiguousarray(upper, np.float32)
+    r_idx = np.ascontiguousarray(r_idx, np.int32)
+    c_idx = np.ascontiguousarray(c_idx, np.int32)
+    scale = np.ascontiguousarray(scale, np.float32)
+    out_map = np.ascontiguousarray(out_map, np.int64)
+    if scale.shape != (g * P,) or out_map.shape != (g * P,):
+        raise ValueError(
+            f"scale/map must be ({g * P},), got {scale.shape}/{out_map.shape}")
+    if out_map.max() >= p_out:
+        raise ValueError("map index beyond p_out")
+    out = np.zeros((p_out, p_out), np.float32)
+    lib.assemble_covariance(
+        _ptr(upper, ctypes.c_float), n_pairs, P,
+        _ptr(r_idx, ctypes.c_int32), _ptr(c_idx, ctypes.c_int32),
+        _ptr(scale, ctypes.c_float), _ptr(out_map, ctypes.c_int64),
+        _ptr(out, ctypes.c_float), p_out)
+    return out

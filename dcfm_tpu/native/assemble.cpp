@@ -1,0 +1,78 @@
+// Native host-side "conquer" assembler.
+//
+// The combine step's final hop (SURVEY.md section 0.2: the only place the
+// full p x p covariance is materialized, reference divideconquer.m:180-196)
+// is host-bound: the device hands back g(g+1)/2 upper-triangle block panels
+// and the host must unpack them into the dense matrix, undo the feature
+// permutation (quirk Q5), undo the per-column standardization, and
+// re-insert zero columns (quirk Q7).  In NumPy that is four O(p^2)
+// memory-bound passes (mirror, transpose-stitch, scale, gather/scatter) -
+// ~6 s at p=10k on this host.  This translation unit does all of it in ONE
+// pass over the fetched panels: each upper block entry is read once,
+// scaled, and scattered (with its symmetric mirror) straight into its
+// final position.
+//
+// Shapes/contracts (all row-major, caller-validated in native/__init__.py):
+//   upper:  (n_pairs, P, P) float32, pair k holds block (r_idx[k], c_idx[k])
+//           with r_idx[k] <= c_idx[k] (jnp.triu_indices order).
+//   scale:  (g*P,) float32 per-shard-coordinate de-standardization scales
+//           (all ones when destandardize is off).
+//   map:    (g*P,) int64: shard coordinate -> output row/col, -1 = dropped
+//           (padding columns, quirk Q6).
+//   out:    (p_out, p_out) float32, pre-zeroed by the caller.
+//
+// Diagonal blocks (r == c) are averaged with their transpose so the output
+// is exactly symmetric (the reference re-symmetrizes every accumulation,
+// divideconquer.m:195; here symmetry is by construction).
+
+#include <cstdint>
+
+extern "C" {
+
+void assemble_covariance(
+    const float* upper,
+    int64_t n_pairs,
+    int64_t P,
+    const int32_t* r_idx,
+    const int32_t* c_idx,
+    const float* scale,
+    const int64_t* map,
+    float* out,
+    int64_t p_out) {
+  const int64_t PP = P * P;
+  for (int64_t k = 0; k < n_pairs; ++k) {
+    const float* blk = upper + k * PP;
+    const int64_t br = static_cast<int64_t>(r_idx[k]) * P;
+    const int64_t bc = static_cast<int64_t>(c_idx[k]) * P;
+    const bool diag = r_idx[k] == c_idx[k];
+    for (int64_t i = 0; i < P; ++i) {
+      const int64_t mi = map[br + i];
+      if (mi < 0) continue;
+      const float si = scale[br + i];
+      const float* row = blk + i * P;
+      float* out_row = out + mi * p_out;
+      if (diag) {
+        // upper triangle of the block only; average with the transpose so
+        // float-level einsum asymmetry cannot leak into the output
+        for (int64_t j = i; j < P; ++j) {
+          const int64_t mj = map[bc + j];
+          if (mj < 0) continue;
+          const float v =
+              0.5f * (row[j] + blk[j * P + i]) * si * scale[bc + j];
+          out_row[mj] = v;
+          out[mj * p_out + mi] = v;
+        }
+      } else {
+        for (int64_t j = 0; j < P; ++j) {
+          const int64_t mj = map[bc + j];
+          if (mj < 0) continue;
+          const float v = row[j] * si * scale[bc + j];
+          out_row[mj] = v;
+          out[mj * p_out + mi] = v;
+        }
+      }
+    }
+  }
+}
+
+}  // extern "C"
