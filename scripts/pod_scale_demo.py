@@ -63,7 +63,7 @@ import numpy as np  # noqa: E402
 
 
 def run_demo(g=256, n_devices=8, P=196, n=16, K=2, iters=3, seed=0,
-             verbose=True):
+             prior="mgp", rank_adapt=False, verbose=True):
     from dcfm_tpu.config import ModelConfig, RunConfig
     from dcfm_tpu.models.priors import make_prior
     from dcfm_tpu.models.sampler import schedule_array
@@ -71,7 +71,10 @@ def run_demo(g=256, n_devices=8, P=196, n=16, K=2, iters=3, seed=0,
     from dcfm_tpu.parallel.shard import build_mesh_chain, place_sharded
 
     p = g * P
-    cfg = ModelConfig(num_shards=g, factors_per_shard=K, rho=0.9)
+    # BASELINE config 5 pairs this shape with the horseshoe prior and
+    # adaptive rank truncation - both are plain config knobs here.
+    cfg = ModelConfig(num_shards=g, factors_per_shard=K, rho=0.9,
+                      prior=prior, rank_adapt=rank_adapt)
     run = RunConfig(burnin=iters - 1, mcmc=1, thin=1, seed=seed)
     prior = make_prior(cfg)
 
@@ -123,7 +126,8 @@ def run_demo(g=256, n_devices=8, P=196, n=16, K=2, iters=3, seed=0,
 
     if verbose:
         print(f"compile+init {t_init:.1f}s, {iters} Gibbs iterations + "
-              f"1 saved draw {t_run:.1f}s")
+              f"1 saved draw {t_run:.1f}s "
+              f"(prior={prior}, rank_adapt={rank_adapt})")
         print(f"accumulator shape {tuple(blocks.shape)}, finite, "
               f"tr(Sigma_00) = {tr0:.1f}")
         print("OK")
@@ -135,5 +139,7 @@ import jax.numpy as jnp  # noqa: E402
 
 
 if __name__ == "__main__":
-    run_demo(P=int(os.environ.get("PODDEMO_P", 196)))
+    run_demo(P=int(os.environ.get("PODDEMO_P", 196)),
+             prior=os.environ.get("PODDEMO_PRIOR", "mgp"),
+             rank_adapt=bool(int(os.environ.get("PODDEMO_ADAPT", "0"))))
     sys.exit(0)
