@@ -176,8 +176,16 @@ class BackendConfig:
     # accumulator is the biggest device->host artifact of a run (p^2/2
     # floats); on a bandwidth-constrained link "float16"/"bfloat16" halve
     # the transfer at ~5e-4 relative rounding on the *reported* Sigma only -
-    # on-device accumulation stays float32.
-    fetch_dtype: str = "float32"  # "float32" | "bfloat16" | "float16"
+    # on-device accumulation stays float32.  "quant8" quarters it: int8
+    # entries with one float32 scale per P x P block panel (max-abs
+    # quantization, ~4e-3 of the panel max per entry - still far below
+    # Monte Carlo error; see tests/test_observability.py quantization test).
+    fetch_dtype: str = "float32"  # "float32" | "bfloat16" | "float16" | "quant8"
+    # Dtype Y crosses the host->device link in.  The sampler always computes
+    # in float32 (the device casts back on arrival); "float16" halves the
+    # upload of standardized data at ~5e-4 relative rounding of the inputs,
+    # below the residual noise by orders of magnitude.
+    upload_dtype: str = "float32"  # "float32" | "float16" | "bfloat16"
     # If set, fit() wraps the chain in a jax.profiler trace and writes
     # XProf/Perfetto dumps here (open with tensorboard or ui.perfetto.dev).
     # The per-conditional named_scope labels (z_update, x_update,
@@ -252,15 +260,26 @@ def validate(cfg: FitConfig, n: int, p: int) -> None:
             f"resume must be False, True, or 'auto', got {cfg.resume!r}")
     if cfg.resume and not cfg.checkpoint_path:
         raise ValueError("resume requires checkpoint_path")
-    if cfg.backend.fetch_dtype not in ("float32", "bfloat16", "float16"):
+    if cfg.backend.fetch_dtype not in ("float32", "bfloat16", "float16",
+                                       "quant8"):
         raise ValueError(
             f"unknown fetch_dtype {cfg.backend.fetch_dtype!r} "
-            "(float32 | bfloat16 | float16)")
+            "(float32 | bfloat16 | float16 | quant8)")
+    if cfg.backend.upload_dtype not in ("float32", "float16", "bfloat16"):
+        raise ValueError(
+            f"unknown upload_dtype {cfg.backend.upload_dtype!r} "
+            "(float32 | float16 | bfloat16)")
     if cfg.backend.fetch_dtype == "float16" and not cfg.standardize:
         raise ValueError(
             "fetch_dtype='float16' requires standardize=True: raw-scale "
             "covariance entries can exceed float16's 65504 max and would "
-            "silently saturate to inf (bfloat16 keeps float32 range)")
+            "silently saturate to inf (bfloat16 keeps float32 range, "
+            "quant8's per-panel scale adapts to any range)")
+    if cfg.backend.upload_dtype == "float16" and not cfg.standardize:
+        raise ValueError(
+            "upload_dtype='float16' requires standardize=True: raw-scale "
+            "data entries can exceed float16's 65504 max and would reach "
+            "the sampler as inf (bfloat16 keeps float32 range)")
     if m.rank_adapt:
         a = m.adapt
         if a.a1 >= 0:

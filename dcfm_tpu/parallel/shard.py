@@ -157,11 +157,14 @@ def build_mesh_chain(
         _init, mesh=mesh,
         in_specs=(rep, sh),
         out_specs=specs), compiler_options=compiler_options)
+    # donate the carry (arg 2): the sharded accumulator is the dominant
+    # per-device buffer; in-place update instead of old + new per chunk.
     chunk_fn = jax.jit(shard_map(
         _chunk, mesh=mesh,
         in_specs=(rep, sh, specs, rep),
         out_specs=(specs, ChainStats(*([rep] * len(ChainStats._fields))),
-                   rep)), compiler_options=compiler_options)
+                   rep)), donate_argnums=(2,),
+        compiler_options=compiler_options)
     return init_fn, chunk_fn
 
 

@@ -76,7 +76,7 @@ def run_demo(g=256, n_devices=8, P=196, n=16, K=2, iters=3, seed=0,
     cfg = ModelConfig(num_shards=g, factors_per_shard=K, rho=0.9,
                       prior=prior, rank_adapt=rank_adapt)
     run = RunConfig(burnin=iters - 1, mcmc=1, thin=1, seed=seed)
-    prior = make_prior(cfg)
+    prior_triple = make_prior(cfg)
 
     mesh = make_mesh(n_devices)
     gl = shards_per_device(g, mesh)
@@ -96,7 +96,7 @@ def run_demo(g=256, n_devices=8, P=196, n=16, K=2, iters=3, seed=0,
     # build_mesh_chain docstring); XLA's 40 s default aborts the process.
     opts = {"xla_cpu_collective_call_warn_stuck_seconds": "600",
             "xla_cpu_collective_call_terminate_timeout_seconds": "3600"}
-    init_fn, chunk_fn = build_mesh_chain(mesh, cfg, prior, num_iters=iters,
+    init_fn, chunk_fn = build_mesh_chain(mesh, cfg, prior_triple, num_iters=iters,
                                          compiler_options=opts)
     Yd = place_sharded(Y, mesh)
     key = jax.random.key(seed)
@@ -127,7 +127,7 @@ def run_demo(g=256, n_devices=8, P=196, n=16, K=2, iters=3, seed=0,
     if verbose:
         print(f"compile+init {t_init:.1f}s, {iters} Gibbs iterations + "
               f"1 saved draw {t_run:.1f}s "
-              f"(prior={prior}, rank_adapt={rank_adapt})")
+              f"(prior={cfg.prior}, rank_adapt={rank_adapt})")
         print(f"accumulator shape {tuple(blocks.shape)}, finite, "
               f"tr(Sigma_00) = {tr0:.1f}")
         print("OK")

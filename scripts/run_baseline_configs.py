@@ -97,10 +97,22 @@ def run_config5():
     env["PODDEMO_PRIOR"] = "horseshoe"
     env["PODDEMO_ADAPT"] = "1"
     t0 = time.perf_counter()
-    proc = subprocess.run(
-        [sys.executable, os.path.join(_REPO, "scripts", "pod_scale_demo.py")],
-        env=env, cwd=_REPO, capture_output=True, text=True, timeout=1800)
-    ok = proc.returncode == 0 and "OK" in proc.stdout
+    try:
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(_REPO, "scripts", "pod_scale_demo.py")],
+            env=env, cwd=_REPO, capture_output=True, text=True, timeout=1800)
+        ok = proc.returncode == 0 and "OK" in proc.stdout
+        out_tail, err_tail = proc.stdout[-1500:], proc.stderr[-1500:]
+    except subprocess.TimeoutExpired as e:
+        # a hung demo must still produce the structured report, not a
+        # traceback (the tails are what diagnose the hang)
+        ok = False
+        out_tail = (e.stdout or b"").decode(errors="replace")[-1500:] \
+            if isinstance(e.stdout, bytes) else (e.stdout or "")[-1500:]
+        err_tail = "TimeoutExpired after 1800s; " + (
+            (e.stderr or b"").decode(errors="replace")[-1500:]
+            if isinstance(e.stderr, bytes) else (e.stderr or "")[-1500:])
     print(json.dumps({
         "config": "5: pod-scale horseshoe + adaptive rank (virtual mesh)",
         "p": 256 * int(env["PODDEMO_P"]), "g": 256,
@@ -109,7 +121,7 @@ def run_config5():
         "ok": ok,
     }))
     if not ok:
-        print(proc.stdout[-1500:], proc.stderr[-1500:], file=sys.stderr)
+        print(out_tail, err_tail, file=sys.stderr)
     return ok
 
 
