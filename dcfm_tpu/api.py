@@ -43,8 +43,10 @@ from dcfm_tpu.parallel.shard import build_mesh_chain, place_sharded
 from dcfm_tpu.utils.checkpoint import (
     checkpoint_compatible, data_fingerprint, load_checkpoint,
     read_checkpoint_meta, save_checkpoint)
+from dcfm_tpu import native
 from dcfm_tpu.utils.estimate import (
-    assemble_from_upper, extract_upper_blocks, full_blocks_from_upper)
+    assemble_from_upper, assembly_maps, extract_upper_blocks,
+    full_blocks_from_upper, upper_pair_indices)
 from dcfm_tpu.utils.preprocess import PreprocessResult, preprocess
 
 
@@ -190,6 +192,48 @@ def _upload_host_array(data: np.ndarray, upload_dtype: str) -> np.ndarray:
         return data.astype(np.float16)
     import ml_dtypes  # jax dependency, always present
     return data.astype(ml_dtypes.bfloat16)
+
+
+def _quant8_fetch_assemble(q_dev, scale_dev, g: int, pre: PreprocessResult,
+                           n_slices: int = 8):
+    """Streamed quantized fetch: dequantize to the float32 upper panels
+    (the FitResult contract) and scatter each slice into the final
+    covariance while later slices are still crossing the link.
+
+    The device->host transfer is the wall-clock bottleneck of a real fit
+    (the panels are ~p^2/2 entries); slicing the quantized array and
+    issuing ``copy_to_host_async`` for every slice up front lets the native
+    int8 assembler (dcfm_tpu/native: dequant folded into the one-pass
+    scatter) run entirely in the transfer's shadow.
+
+    Returns (upper_f32, Sigma-or-None); None means the native library is
+    unavailable and the caller should assemble from ``upper_f32``.
+    """
+    scales = np.asarray(scale_dev)                   # (n_pairs,) tiny
+    n_pairs, P, _ = q_dev.shape
+    bounds = np.linspace(0, n_pairs, min(n_slices, n_pairs) + 1).astype(int)
+    slices = [q_dev[a:b] for a, b in zip(bounds[:-1], bounds[1:]) if b > a]
+    for s in slices:
+        s.copy_to_host_async()
+    r, c = upper_pair_indices(g)
+    upper = np.empty((n_pairs, P, P), np.float32)
+    out = None
+    if native.available():
+        col_scale, out_map, p_out = assembly_maps(
+            pre, g, P, destandardize=True, reinsert_zero_cols=True)
+        out = np.zeros((p_out, p_out), np.float32)
+    ok = out is not None
+    pos = 0
+    for s in slices:
+        qh = np.asarray(s)                           # waits for this slice
+        a, b = pos, pos + qh.shape[0]
+        sc = scales[a:b]
+        upper[a:b] = qh.astype(np.float32) * (sc[:, None, None] / 127.0)
+        if ok:
+            ok = native.assemble_q8_partial(
+                qh, sc, r[a:b], c[a:b], col_scale, out_map, out)
+        pos = b
+    return upper, (out if ok else None)
 
 
 def _diagnose(trace_arr: np.ndarray, done: int, run: RunConfig) -> dict:
@@ -388,21 +432,28 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
     fetch_mode = "float32" if m.posterior_sd else cfg.backend.fetch_dtype
 
     def _fetch_upper(acc):
+        # non-quant8 modes only; the quant8 fetch goes through the streamed
+        # _quant8_fetch_assemble path below (single home for the dequant).
         out = _fetch_jit(m.num_shards, C, fetch_mode)(acc)
-        if fetch_mode == "quant8":
-            q, scale = jax.device_get(out)
-            return (q.astype(np.float32)
-                    * (scale.astype(np.float32)[:, None, None] / 127.0))
         return np.asarray(out).astype(np.float32, copy=False)
 
-    upper = _fetch_upper(carry.sigma_acc)
-    state = jax.device_get(carry.state)  # stats is already host NumPy
     # reinsert_zero_cols=True: Sigma is (p, p) in the caller's coordinates,
     # with zero rows/cols for all-zero input columns (variance of a constant
     # is 0) - indices never shift (the reference's Q7 drops them silently).
     # assemble_from_upper: the native one-pass conquer assembler (NumPy
-    # fallback inside).
-    Sigma = assemble_from_upper(upper, pre, reinsert_zero_cols=True)
+    # fallback inside).  The quant8 path streams: assembly of slice k runs
+    # while slice k+1 is still on the device->host link.
+    if fetch_mode == "quant8":
+        q_dev, scale_dev = _fetch_jit(m.num_shards, C, "quant8")(
+            carry.sigma_acc)
+        upper, Sigma = _quant8_fetch_assemble(
+            q_dev, scale_dev, m.num_shards, pre)
+        if Sigma is None:
+            Sigma = assemble_from_upper(upper, pre, reinsert_zero_cols=True)
+    else:
+        upper = _fetch_upper(carry.sigma_acc)
+        Sigma = assemble_from_upper(upper, pre, reinsert_zero_cols=True)
+    state = jax.device_get(carry.state)  # stats is already host NumPy
 
     Sigma_sd = sd_upper = None
     if carry.sigma_sq_acc is not None:

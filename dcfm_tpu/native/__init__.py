@@ -73,6 +73,26 @@ def _load() -> Optional[ctypes.CDLL]:
                 ctypes.POINTER(ctypes.c_float),   # out
                 ctypes.c_int64,                   # p_out
             ]
+            # q8 symbol in its own try: a prebuilt .so from before the
+            # quantized path must keep the float32 assembler usable - only
+            # the q8 entry degrades to the NumPy fallback.
+            try:
+                fnq = lib.assemble_covariance_q8
+                fnq.restype = None
+                fnq.argtypes = [
+                    ctypes.POINTER(ctypes.c_int8),    # upper (quantized)
+                    ctypes.POINTER(ctypes.c_float),   # panel_scale
+                    ctypes.c_int64,                   # n_pairs
+                    ctypes.c_int64,                   # P
+                    ctypes.POINTER(ctypes.c_int32),   # r_idx
+                    ctypes.POINTER(ctypes.c_int32),   # c_idx
+                    ctypes.POINTER(ctypes.c_float),   # scale
+                    ctypes.POINTER(ctypes.c_int64),   # map
+                    ctypes.POINTER(ctypes.c_float),   # out
+                    ctypes.c_int64,                   # p_out
+                ]
+            except AttributeError:
+                pass
             _lib = lib
         except Exception:
             _build_failed = True
@@ -128,3 +148,58 @@ def assemble_covariance(
         _ptr(scale, ctypes.c_float), _ptr(out_map, ctypes.c_int64),
         _ptr(out, ctypes.c_float), p_out)
     return out
+
+
+def assemble_q8_partial(
+    q_panels: np.ndarray,
+    panel_scale: np.ndarray,
+    r_idx: np.ndarray,
+    c_idx: np.ndarray,
+    scale: np.ndarray,
+    out_map: np.ndarray,
+    out: np.ndarray,
+) -> bool:
+    """Scatter a SUBSET of int8-quantized panels into a caller-owned output.
+
+    Streaming building block: api.fit fetches the quantized accumulator in
+    slices and assembles each while the next is still on the link.  The
+    dequantization (entry * panel_scale/127) folds into the same pass.
+    ``out`` must be a pre-zeroed C-contiguous (p_out, p_out) float32 array,
+    shared across calls.  Returns False when the native library is
+    unavailable (caller falls back to the NumPy path).
+    """
+    lib = _load()
+    if lib is None or not hasattr(lib, "assemble_covariance_q8"):
+        return False
+    n_pairs, P, P2 = q_panels.shape
+    if P != P2:
+        raise ValueError(f"panels must be square, got {q_panels.shape}")
+    if q_panels.dtype != np.int8:
+        raise ValueError(f"expected int8 panels, got {q_panels.dtype}")
+    if not (out.flags.c_contiguous and out.dtype == np.float32
+            and out.ndim == 2 and out.shape[0] == out.shape[1]):
+        raise ValueError("out must be C-contiguous square float32")
+    if panel_scale.shape != (n_pairs,):
+        raise ValueError(
+            f"panel_scale must be ({n_pairs},), got {panel_scale.shape}")
+    if len(r_idx) != n_pairs or len(c_idx) != n_pairs:
+        raise ValueError("r_idx/c_idx must have one entry per panel")
+    q_panels = np.ascontiguousarray(q_panels, np.int8)
+    panel_scale = np.ascontiguousarray(panel_scale, np.float32)
+    r_idx = np.ascontiguousarray(r_idx, np.int32)
+    c_idx = np.ascontiguousarray(c_idx, np.int32)
+    scale = np.ascontiguousarray(scale, np.float32)
+    out_map = np.ascontiguousarray(out_map, np.int64)
+    g = int(max(r_idx.max(), c_idx.max())) + 1 if n_pairs else 0
+    if scale.shape[0] < g * P or out_map.shape[0] < g * P:
+        raise ValueError(
+            f"scale/map too short for shard index {g - 1} at P={P}")
+    if out_map.max() >= out.shape[0]:
+        raise ValueError("map index beyond out")
+    lib.assemble_covariance_q8(
+        _ptr(q_panels, ctypes.c_int8), _ptr(panel_scale, ctypes.c_float),
+        n_pairs, P,
+        _ptr(r_idx, ctypes.c_int32), _ptr(c_idx, ctypes.c_int32),
+        _ptr(scale, ctypes.c_float), _ptr(out_map, ctypes.c_int64),
+        _ptr(out, ctypes.c_float), out.shape[0])
+    return True

@@ -29,6 +29,60 @@
 
 extern "C" {
 
+// int8 variant: panels arrive max-abs quantized from the device (one
+// float32 scale per panel, entries in [-127, 127] - see api._fetch_jit).
+// Dequantization folds into the same single pass: entry * panel_scale/127
+// * row_scale * col_scale, so the quantized fetch never needs a separate
+// host-side dequant sweep before assembly.  Callable on any subset of
+// pairs (streaming: overlap link transfer of slice k+1 with assembly of
+// slice k); `out` is caller-allocated and pre-zeroed once.
+void assemble_covariance_q8(
+    const int8_t* upper,
+    const float* panel_scale,
+    int64_t n_pairs,
+    int64_t P,
+    const int32_t* r_idx,
+    const int32_t* c_idx,
+    const float* scale,
+    const int64_t* map,
+    float* out,
+    int64_t p_out) {
+  const int64_t PP = P * P;
+  for (int64_t k = 0; k < n_pairs; ++k) {
+    const int8_t* blk = upper + k * PP;
+    const float pscale = panel_scale[k] / 127.0f;
+    const int64_t br = static_cast<int64_t>(r_idx[k]) * P;
+    const int64_t bc = static_cast<int64_t>(c_idx[k]) * P;
+    const bool diag = r_idx[k] == c_idx[k];
+    for (int64_t i = 0; i < P; ++i) {
+      const int64_t mi = map[br + i];
+      if (mi < 0) continue;
+      const float si = scale[br + i] * pscale;
+      const int8_t* row = blk + i * P;
+      float* out_row = out + mi * p_out;
+      if (diag) {
+        for (int64_t j = i; j < P; ++j) {
+          const int64_t mj = map[bc + j];
+          if (mj < 0) continue;
+          const float v = 0.5f *
+              (static_cast<float>(row[j]) + static_cast<float>(blk[j * P + i]))
+              * si * scale[bc + j];
+          out_row[mj] = v;
+          out[mj * p_out + mi] = v;
+        }
+      } else {
+        for (int64_t j = 0; j < P; ++j) {
+          const int64_t mj = map[bc + j];
+          if (mj < 0) continue;
+          const float v = static_cast<float>(row[j]) * si * scale[bc + j];
+          out_row[mj] = v;
+          out[mj * p_out + mi] = v;
+        }
+      }
+    }
+  }
+}
+
 void assemble_covariance(
     const float* upper,
     int64_t n_pairs,

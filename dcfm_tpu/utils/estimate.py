@@ -68,6 +68,34 @@ def stitch_blocks(sigma_blocks: np.ndarray, *,
     return 0.5 * (S + S.T) if symmetrize else S
 
 
+def assembly_maps(
+    pre: PreprocessResult,
+    g: int,
+    P: int,
+    *,
+    destandardize: bool = True,
+    reinsert_zero_cols: bool = False,
+) -> tuple[np.ndarray, np.ndarray, int]:
+    """(col_scale, out_map, p_out) for one-pass native assembly.
+
+    ``col_scale`` is the per-shard-coordinate de-standardization factor,
+    ``out_map`` sends each shard coordinate to its output row/col (-1 =
+    dropped padding), and ``p_out`` is the output dimension.
+    """
+    p_used = pre.p_used
+    p_kept = p_used - pre.n_pad
+    if g * P != p_used:
+        raise ValueError(f"g={g} blocks of width {P} != p_used {p_used}")
+    scale = (pre.col_scale.reshape(-1).astype(np.float32) if destandardize
+             else np.ones(p_used, np.float32))
+    out_map = np.full(p_used, -1, np.int64)
+    dest = (pre.kept_cols if reinsert_zero_cols
+            else np.arange(p_kept, dtype=np.int64))
+    out_map[pre.inv_perm[:p_kept]] = dest
+    p_out = pre.p_original if reinsert_zero_cols else p_kept
+    return scale, out_map, p_out
+
+
 def assemble_from_upper(
     upper: np.ndarray,
     pre: PreprocessResult,
@@ -85,23 +113,17 @@ def assemble_from_upper(
     """
     n_pairs, P, _ = upper.shape
     g = int(round((np.sqrt(8 * n_pairs + 1) - 1) / 2))
-    p_used = pre.p_used
-    p_kept = p_used - pre.n_pad
-    if g * P != p_used:
-        raise ValueError(f"{n_pairs} pairs of {P}x{P} blocks != p_used "
-                         f"{p_used}")
     if native.available():
         r, c = upper_pair_indices(g)
-        scale = (pre.col_scale.reshape(-1) if destandardize
-                 else np.ones(p_used, np.float32))
-        out_map = np.full(p_used, -1, np.int64)
-        dest = (pre.kept_cols if reinsert_zero_cols
-                else np.arange(p_kept, dtype=np.int64))
-        out_map[pre.inv_perm[:p_kept]] = dest
-        p_out = pre.p_original if reinsert_zero_cols else p_kept
+        scale, out_map, p_out = assembly_maps(
+            pre, g, P, destandardize=destandardize,
+            reinsert_zero_cols=reinsert_zero_cols)
         out = native.assemble_covariance(upper, r, c, scale, out_map, p_out)
         if out is not None:
             return out
+    if g * P != pre.p_used:
+        raise ValueError(f"{n_pairs} pairs of {P}x{P} blocks != p_used "
+                         f"{pre.p_used}")
     return restore_covariance(
         stitch_blocks(full_blocks_from_upper(upper, g), symmetrize=False),
         pre, destandardize=destandardize,
