@@ -120,6 +120,13 @@ class ModelConfig:
     # Gibbs sweep itself always runs float32 (K x K Cholesky in bf16 is
     # unusable - SURVEY.md section 7 "Numerics").
     combine_dtype: str = "float32"  # "float32" | "bfloat16"
+    # Implementation of the Lambda-update batched K x K Cholesky sampler
+    # (the hot kernel, SURVEY.md C10).  "auto" picks the statically-unrolled
+    # elementwise XLA path for K <= 16 and lax.linalg beyond; "pallas" uses
+    # the fused TPU kernel (ops/pallas_gaussian.py, interpreter mode
+    # off-TPU); "unrolled"/"lax" force those paths.  See
+    # scripts/bench_lambda_kernel.py for the measured comparison.
+    lambda_kernel: str = "auto"
     # Adaptive rank truncation (see AdaptConfig).  Off by default: the
     # reference model has a fixed per-shard factor budget.
     rank_adapt: bool = False
@@ -251,6 +258,15 @@ def validate(cfg: FitConfig, n: int, p: int) -> None:
             f"unknown estimator {m.estimator!r} (expected 'plain' or "
             "'scaled'; a typo would otherwise silently fall back to the "
             "plain reference combine rule)")
+    if m.lambda_kernel not in ("auto", "unrolled", "lax", "pallas"):
+        raise ValueError(
+            f"unknown lambda_kernel {m.lambda_kernel!r} "
+            "(auto | unrolled | lax | pallas)")
+    if m.lambda_kernel == "pallas" and m.factors_per_shard > 16:
+        raise ValueError(
+            f"lambda_kernel='pallas' supports factors_per_shard <= 16 "
+            f"(statically-unrolled recurrence), got {m.factors_per_shard}; "
+            "use lambda_kernel='auto' (lax.linalg handles large K)")
     if m.combine_dtype not in ("float32", "bfloat16"):
         raise ValueError(
             f"unknown combine_dtype {m.combine_dtype!r} "

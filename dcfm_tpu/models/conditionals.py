@@ -142,17 +142,37 @@ def gibbs_sweep(
     # coordinates draw from their (irrelevant) prior and are re-zeroed.
     eta_lam = eta if state.active is None else eta * state.active[:, None, :]
 
-    def lam_update(kg, Ym, eta_m, ps, plam_m):
+    def lam_terms(Ym, eta_m, ps, plam_m):
         E = eta_m.T @ eta_m                                     # (K, K)
         EY = eta_m.T @ Ym                                       # (K, P)
         Q = (jax.vmap(jnp.diag)(plam_m)
              + ps[:, None, None] * E[None])                     # (P, K, K)
         B = ps[:, None] * EY.T                                  # (P, K)
-        return sample_mvn_precision_batched(kg, Q, B)
+        return Q, B
+
+    def lam_update(kg, Ym, eta_m, ps, plam_m):
+        Q, B = lam_terms(Ym, eta_m, ps, plam_m)
+        return sample_mvn_precision_batched(kg, Q, B,
+                                            impl=cfg.lambda_kernel)
 
     with jax.named_scope("lambda_update"):
         kl = _shard_keys(jax.random.fold_in(key, _SITE_LAM), shard_offset, Gl)
-        Lam = jax.vmap(lam_update)(kl, Y, eta_lam, state.ps, plam)
+        if cfg.lambda_kernel == "pallas":
+            # Flatten shards x rows into ONE kernel batch: under vmap the
+            # pallas batching rule would instead pad each shard's P rows to
+            # the lane tile separately (~3x wasted lanes at P=157).  The
+            # noise is still drawn per shard from the per-shard key -
+            # identical draws to the unrolled path (results then agree to
+            # float reassociation, not bitwise).
+            from dcfm_tpu.ops.pallas_gaussian import chol_sample_batched_pallas
+            Q, B = jax.vmap(lam_terms)(Y, eta_lam, state.ps, plam)
+            Zn = jax.vmap(
+                lambda k, b: jax.random.normal(k, b.shape, b.dtype))(kl, B)
+            Lam = chol_sample_batched_pallas(
+                Q.reshape(Gl * P, K, K), B.reshape(Gl * P, K),
+                Zn.reshape(Gl * P, K)).reshape(Gl, P, K)
+        else:
+            Lam = jax.vmap(lam_update)(kl, Y, eta_lam, state.ps, plam)
         if state.active is not None:
             Lam = Lam * state.active[:, None, :]
 

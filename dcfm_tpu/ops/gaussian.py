@@ -124,6 +124,8 @@ def sample_mvn_precision_batched(
     key: jax.Array,
     Q: jax.Array,
     B: jax.Array,
+    *,
+    impl: str = "auto",
 ) -> jax.Array:
     """Draw x_j ~ N(Q_j^{-1} b_j, Q_j^{-1}) for *per-row* precisions.
 
@@ -131,6 +133,9 @@ def sample_mvn_precision_batched(
       key: PRNG key.
       Q: (P, K, K) SPD precisions, one per row.
       B: (P, K) linear terms.
+      impl: "auto" (unrolled elementwise for K <= _UNROLL_MAX_K, else
+        lax.linalg), "unrolled", "lax", or "pallas" (the fused TPU kernel,
+        ops/pallas_gaussian.py; interpreter mode off-TPU).
 
     Returns:
       (P, K) samples (the Lambda-update hot kernel, C10).  For K up to
@@ -141,7 +146,10 @@ def sample_mvn_precision_batched(
     """
     K = Q.shape[-1]
     Zn = jax.random.normal(key, B.shape, B.dtype)
-    if K <= _UNROLL_MAX_K:
+    if impl == "pallas":
+        from dcfm_tpu.ops.pallas_gaussian import chol_sample_batched_pallas
+        return chol_sample_batched_pallas(Q, B, Zn)
+    if impl == "unrolled" or (impl == "auto" and K <= _UNROLL_MAX_K):
         cols = _chol_unrolled(Q)
         V = _fwd_solve_unrolled(cols, B)
         M = _bwd_solve_unrolled(cols, V)

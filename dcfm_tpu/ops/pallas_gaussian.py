@@ -1,0 +1,156 @@
+"""Pallas TPU kernel for the batched small-K precision-Gaussian sampler.
+
+This is the Lambda-update hot op (SURVEY.md C10, reference
+``divideconquer.m:136-146``): draw x_j ~ N(Q_j^{-1} b_j, Q_j^{-1}) for ~10^4
+independent K x K precisions per sweep, K ~ 8.  XLA's stock lowering of
+batched ``lax.linalg.cholesky`` at this shape runs a generic loop at vector
+pace (measured at 86% of the whole sweep before ops/gaussian.py replaced it
+with statically-unrolled elementwise steps).  This kernel goes one step
+further than the unrolled XLA version: the whole factor-solve-sample chain
+runs in one fused Pallas program with the *batch on the lane dimension* -
+every (i, j) entry of the Cholesky factor is a (1, TILE_B) lane vector, so
+each of the K(K+1)/2 recurrence steps is a full-width VPU op, and no
+intermediate ever round-trips through HBM.
+
+Layout: inputs arrive transposed to batch-minor, Q as (K, K, B) and b/z as
+(K, B); the grid tiles B.  Sequential depth is the K-step recurrence
+(statically unrolled - K <= 16), parallel width is the lane tile.
+
+Used via ``ModelConfig(lambda_kernel="pallas")`` / ops.gaussian's ``impl``
+switch; correctness is pinned against the unrolled path in
+tests/test_pallas_kernel.py (interpret mode on CPU, compiled on TPU), and
+scripts/bench_lambda_kernel.py measures all three implementations at the
+bench shape.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# Lane-tile width over the batch axis.  512 lanes = 4 VPU registers per
+# recurrence vector; large enough to amortize the K^2/2 sequential steps,
+# small enough that Q's (K, K, TILE_B) block stays far under VMEM.
+_TILE_B = 512
+
+_MAX_K = 16  # statically-unrolled recurrence; matches gaussian._UNROLL_MAX_K
+
+
+def _chol_sample_kernel(q_ref, b_ref, z_ref, out_ref, *, K: int):
+    """One B-tile: lower-Cholesky factor Q, then the Rue (2001) sampler
+    m + y with L L' m = b and L' y = z, all as (1, TILE_B) lane vectors.
+
+    cols[j] holds rows j..K-1 of Cholesky column j as a (K-j, TILE_B) slab;
+    row extraction cols[j][i-j] is a static sublane slice.
+    """
+    # ---- Cholesky: K outer-product steps ------------------------------
+    # q_ref is column-major over the K x K matrix: q_ref[j] is column j as a
+    # (K, TILE_B) slab, so every slice below is leading-index + contiguous
+    # (Mosaic rejects strided middle-dimension slices like q[j:, j, :]).
+    cols = []               # cols[j]: (K - j, TILE_B)
+    for j in range(K):
+        s = q_ref[j, j:, :]                          # (K-j, TILE_B)
+        for t in range(j):
+            # subtract col t's contribution: L[j:, t] * L[j, t]
+            s = s - cols[t][j - t:, :] * cols[t][j - t:j - t + 1, :]
+        d = jnp.sqrt(s[:1, :])                       # (1, TILE_B) = L_jj
+        if K - j > 1:
+            cols.append(jnp.concatenate([d, s[1:, :] / d], axis=0))
+        else:
+            cols.append(d)   # last column: no sub-diagonal (Mosaic rejects
+                             # the 0-row slice the general branch would take)
+
+    # ---- forward solve L v = b ----------------------------------------
+    v = []
+    for j in range(K):
+        acc = b_ref[j:j + 1, :]                      # (1, TILE_B)
+        for t in range(j):
+            acc = acc - cols[t][j - t:j - t + 1, :] * v[t]
+        v.append(acc / cols[j][:1, :])
+
+    # ---- two backward solves L' m = v and L' y = z, fused -------------
+    m = [None] * K
+    y = [None] * K
+    for j in reversed(range(K)):
+        acc_m = v[j]
+        acc_y = z_ref[j:j + 1, :]
+        for i in range(j + 1, K):
+            lij = cols[j][i - j:i - j + 1, :]
+            acc_m = acc_m - lij * m[i]
+            acc_y = acc_y - lij * y[i]
+        inv = 1.0 / cols[j][:1, :]
+        m[j] = acc_m * inv
+        y[j] = acc_y * inv
+
+    for j in range(K):
+        out_ref[j:j + 1, :] = m[j] + y[j]
+
+
+def chol_sample_batched_pallas(
+    Q: jax.Array,
+    B: jax.Array,
+    Zn: jax.Array,
+    *,
+    interpret: "bool | None" = None,
+) -> jax.Array:
+    """Draw x_j = Q_j^{-1} b_j + L_j^{-T} z_j for per-row K x K precisions.
+
+    Args:
+      Q: (P, K, K) SPD precision matrices.
+      B: (P, K) linear terms.
+      Zn: (P, K) standard-normal draws (passed in so the RNG stays in the
+        caller's key discipline).
+      interpret: run the kernel in interpreter mode; None (default)
+        auto-detects - compiled on TPU, interpreted elsewhere (Mosaic only
+        lowers for TPU).
+
+    Returns: (P, K) samples, bitwise-independent of the batch padding.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _chol_sample_jit(Q, B, Zn, interpret=bool(interpret))
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _chol_sample_jit(Q, B, Zn, interpret):
+    P, K = B.shape
+    if K > _MAX_K:
+        raise ValueError(f"K={K} exceeds the unrolled kernel bound {_MAX_K}")
+    dtype = B.dtype
+    n_tiles = max((P + _TILE_B - 1) // _TILE_B, 1)
+    Pp = n_tiles * _TILE_B
+    if Pp != P:
+        # pad with identity precisions / zero rhs: the padded lanes compute
+        # sqrt(1) and solves over zeros - no NaN, discarded on slice-out
+        pad = Pp - P
+        eyeK = jnp.broadcast_to(jnp.eye(K, dtype=dtype), (pad, K, K))
+        Q = jnp.concatenate([Q, eyeK], axis=0)
+        B = jnp.concatenate([B, jnp.zeros((pad, K), dtype)], axis=0)
+        Zn = jnp.concatenate([Zn, jnp.zeros((pad, K), dtype)], axis=0)
+
+    # batch-minor, COLUMN-major over (i, j): Qt[j, i, b] = Q[b, i, j]
+    Qt = jnp.transpose(Q, (2, 1, 0))                 # (K, K, Pp)
+    Bt = B.T                                         # (K, Pp)
+    Zt = Zn.T
+    out = pl.pallas_call(
+        functools.partial(_chol_sample_kernel, K=K),
+        grid=(n_tiles,),
+        in_specs=[
+            pl.BlockSpec((K, K, _TILE_B), lambda i: (0, 0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((K, _TILE_B), lambda i: (0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((K, _TILE_B), lambda i: (0, i),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((K, _TILE_B), lambda i: (0, i),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((K, Pp), dtype),
+        interpret=interpret,
+    )(Qt, Bt, Zt)
+    return out[:, :P].T

@@ -1,0 +1,79 @@
+"""Micro-benchmark: Lambda-update batched Cholesky sampler implementations.
+
+Compares the three ``sample_mvn_precision_batched`` paths at the north-star
+bench shape (g=64 shards x P=157 rows, K=8, vmapped over shards exactly as
+gibbs_sweep runs it) on whatever accelerator is visible:
+
+  lax       - lax.linalg batched Cholesky + triangular solves (XLA stock)
+  unrolled  - statically-unrolled elementwise steps (ops/gaussian.py)
+  pallas    - fused TPU kernel, batch on lanes (ops/pallas_gaussian.py)
+
+Run:  python scripts/bench_lambda_kernel.py
+"""
+
+import os
+import sys
+import time
+import json
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from dcfm_tpu.ops.gaussian import sample_mvn_precision_batched
+
+G = int(os.environ.get("LB_G", 64))
+P = int(os.environ.get("LB_P", 157))
+K = int(os.environ.get("LB_K", 8))
+REPS = int(os.environ.get("LB_REPS", 50))
+
+
+def main():
+    rng = np.random.default_rng(0)
+    A = rng.standard_normal((G, P, K, K)).astype(np.float32)
+    Q = jnp.asarray(A @ np.transpose(A, (0, 1, 3, 2))
+                    + 2.0 * np.eye(K, dtype=np.float32))
+    B = jnp.asarray(rng.standard_normal((G, P, K)).astype(np.float32))
+    keys = jax.vmap(lambda i: jax.random.fold_in(jax.random.key(0), i))(
+        jnp.arange(G))
+
+    results = {}
+    for impl in ("lax", "unrolled", "pallas"):
+        if impl == "pallas":
+            # gibbs_sweep flattens shards x rows into one kernel batch
+            # (models/conditionals.py) - measure that call shape
+            from dcfm_tpu.ops.pallas_gaussian import chol_sample_batched_pallas
+
+            def fn(keys, Q, B, _f=chol_sample_batched_pallas):
+                Zn = jax.vmap(
+                    lambda k, b: jax.random.normal(k, b.shape, b.dtype))(
+                        keys, B)
+                return _f(Q.reshape(G * P, K, K), B.reshape(G * P, K),
+                          Zn.reshape(G * P, K)).reshape(G, P, K)
+            fn = jax.jit(fn)
+        else:
+            fn = jax.jit(jax.vmap(
+                lambda k, q, b, _i=impl: sample_mvn_precision_batched(
+                    k, q, b, impl=_i)))
+        try:
+            out = fn(keys, Q, B)
+            jax.block_until_ready(out)
+        except Exception as e:  # pallas may not lower on some backends
+            results[impl] = {"error": str(e)[:200]}
+            continue
+        t0 = time.perf_counter()
+        for _ in range(REPS):
+            out = fn(keys, Q, B)
+        jax.block_until_ready(out)
+        dt = (time.perf_counter() - t0) / REPS
+        results[impl] = {"us_per_call": round(dt * 1e6, 1),
+                         "rows_per_sec": round(G * P / dt / 1e6, 2)}
+    print(json.dumps({"shape": {"G": G, "P": P, "K": K},
+                      "device": str(jax.devices()[0]),
+                      "results": results}))
+
+
+if __name__ == "__main__":
+    main()
