@@ -158,11 +158,17 @@ def _mesh_fns(mesh, model: ModelConfig, num_iters: int, num_chains: int = 1):
 
 
 @functools.lru_cache(maxsize=64)
-def _fetch_jit(g: int, num_chains: int, mode: str):
+def _fetch_jit(g: int, num_chains: int, mode: str, mesh=None):
     """Jitted device-side fetch prep: chain-average, upper-triangle panel
     extraction, and the down-cast/quantization for the link.  Cached on
-    (g, chains, mode) so repeated fit() calls reuse the compilation (a fresh
-    ``jax.jit(lambda ...)`` per call would re-trace every time)."""
+    (g, chains, mode, mesh) so repeated fit() calls reuse the compilation
+    (a fresh ``jax.jit(lambda ...)`` per call would re-trace every time);
+    single- and multi-process fits therefore compile separately, and the
+    cached entry keeps its Mesh alive.
+
+    ``mesh`` (multi-process runs only): replicate the output over the mesh
+    so every process can materialize it on host - XLA inserts the
+    cross-host all-gather inside the jit."""
     def prep(acc):
         u = extract_upper_blocks(
             acc.mean(axis=0) if num_chains > 1 else acc, g=g)
@@ -175,7 +181,19 @@ def _fetch_jit(g: int, num_chains: int, mode: str):
             q = jnp.round(u * (127.0 / safe)).astype(jnp.int8)
             return q, scale
         return u.astype(jnp.dtype(mode))
-    return jax.jit(prep)
+    if mesh is None:
+        return jax.jit(prep)
+    from jax.sharding import NamedSharding, PartitionSpec
+    return jax.jit(prep, out_shardings=NamedSharding(mesh, PartitionSpec()))
+
+
+@functools.lru_cache(maxsize=8)
+def _replicate_jit(mesh):
+    """Identity jit that replicates a (sharded) pytree over the mesh -
+    the multi-process path uses it to make small outputs host-fetchable."""
+    from jax.sharding import NamedSharding, PartitionSpec
+    return jax.jit(lambda x: x,
+                   out_shardings=NamedSharding(mesh, PartitionSpec()))
 
 
 @functools.lru_cache(maxsize=4)
@@ -288,6 +306,30 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
             f"mesh_devices={n_mesh} but only {len(devices)} devices visible "
             "(no silent fallback; set mesh_devices=0 for single-device vmap)")
     use_mesh = n_mesh > 1
+    multiproc = jax.process_count() > 1
+    if multiproc:
+        # Multi-host SPMD run (parallel/multihost.py): every process runs
+        # this same fit() call; the mesh must span all processes' devices
+        # and data placement / result fetch go through the cross-process
+        # paths below.
+        if cfg.checkpoint_path:
+            raise NotImplementedError(
+                "checkpoint/resume is single-process for now: the sharded "
+                "carry would need a cross-host gather per save")
+        n_mesh = n_mesh or len(devices)
+        if n_mesh != len(devices):
+            raise ValueError(
+                f"multi-process runs must span all {len(devices)} global "
+                f"devices (got mesh_devices={n_mesh}); partial multi-host "
+                "meshes would leave idle processes deadlocked in collectives")
+        use_mesh = True
+    if m.lambda_kernel == "pallas" and devices[0].platform != "tpu":
+        # Mosaic only lowers for TPU: compile the kernel in interpreter mode
+        # when the RESOLVED execution platform is anything else (the default
+        # backend may still be TPU, e.g. backend="jax_cpu" on a TPU host).
+        # The internal name keys the jit caches, so switching backends
+        # between fit() calls re-traces instead of reusing a stale lowering.
+        m = dataclasses.replace(m, lambda_kernel="pallas-interpret")
 
     # Chunk schedule: full chunks + one remainder chunk (exactly total_iters;
     # per-iteration RNG keys are derived from the *global* iteration index in
@@ -366,8 +408,12 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
         if use_mesh:
             mesh = make_mesh(n_mesh, devices)
             shards_per_device(m.num_shards, mesh)  # validates divisibility
-            Yd = place_sharded(
-                _upload_host_array(pre.data, cfg.backend.upload_dtype), mesh)
+            Y_up = _upload_host_array(pre.data, cfg.backend.upload_dtype)
+            if multiproc:
+                from dcfm_tpu.parallel.multihost import place_sharded_global
+                Yd = place_sharded_global(Y_up, mesh)
+            else:
+                Yd = place_sharded(Y_up, mesh)
             if Yd.dtype != jnp.float32:
                 Yd = _cast_f32_jit()(Yd)  # jit preserves the sharding
             carry, stats, executed, traces, chunk_secs, done = _run_chain(
@@ -430,11 +476,14 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
     # moments cancel catastrophically (fetch rounding is benign only for a
     # value reported directly, not for a variance-by-differences).
     fetch_mode = "float32" if m.posterior_sd else cfg.backend.fetch_dtype
+    # multi-process: replicate fetch outputs over the mesh (cross-host
+    # all-gather inside the jit) so every process can materialize them
+    fetch_mesh = mesh if multiproc else None
 
     def _fetch_upper(acc):
         # non-quant8 modes only; the quant8 fetch goes through the streamed
         # _quant8_fetch_assemble path below (single home for the dequant).
-        out = _fetch_jit(m.num_shards, C, fetch_mode)(acc)
+        out = _fetch_jit(m.num_shards, C, fetch_mode, fetch_mesh)(acc)
         return np.asarray(out).astype(np.float32, copy=False)
 
     # reinsert_zero_cols=True: Sigma is (p, p) in the caller's coordinates,
@@ -444,7 +493,7 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
     # fallback inside).  The quant8 path streams: assembly of slice k runs
     # while slice k+1 is still on the device->host link.
     if fetch_mode == "quant8":
-        q_dev, scale_dev = _fetch_jit(m.num_shards, C, "quant8")(
+        q_dev, scale_dev = _fetch_jit(m.num_shards, C, "quant8", fetch_mesh)(
             carry.sigma_acc)
         upper, Sigma = _quant8_fetch_assemble(
             q_dev, scale_dev, m.num_shards, pre)
@@ -453,7 +502,10 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
     else:
         upper = _fetch_upper(carry.sigma_acc)
         Sigma = assemble_from_upper(upper, pre, reinsert_zero_cols=True)
-    state = jax.device_get(carry.state)  # stats is already host NumPy
+    # final state for FitResult: small next to the accumulator; replicated
+    # first on multi-process runs (sharded leaves are not host-fetchable)
+    state = jax.device_get(_replicate_jit(mesh)(carry.state)
+                           if multiproc else carry.state)
 
     Sigma_sd = sd_upper = None
     if carry.sigma_sq_acc is not None:

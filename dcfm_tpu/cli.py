@@ -82,6 +82,12 @@ def main(argv=None) -> int:
     from dcfm_tpu.config import (
         BackendConfig, FitConfig, ModelConfig, RunConfig)
     from dcfm_tpu.api import fit
+    from dcfm_tpu.parallel.multihost import initialize_from_env
+
+    # Multi-host rendezvous when DCFM_COORDINATOR / DCFM_NUM_PROCESSES /
+    # DCFM_PROCESS_ID are set (one process per host, same CLI invocation
+    # everywhere); a no-op otherwise.
+    initialize_from_env()
 
     Y = _load(args.data)
     if args.factors % args.shards:
@@ -109,15 +115,22 @@ def main(argv=None) -> int:
     res = fit(Y, cfg)
     Sigma = (res.covariance(destandardize=False)
              if args.raw_coords else res.Sigma)
-    np.save(args.out, Sigma)
+    # Multi-host runs compute the identical Sigma on every process; only
+    # process 0 writes, so concurrent processes on a shared filesystem
+    # cannot race on the same output file.
+    import jax
+    write_files = jax.process_index() == 0
+    if write_files:
+        np.save(args.out, Sigma)
     sd_out = None
     if res.Sigma_sd is not None:
         root, ext = os.path.splitext(args.out)
         sd_out = f"{root}_sd{ext or '.npy'}"
         # same coordinate convention as the mean output (--raw-coords must
         # apply to both files or sd/mean ratios silently mix units)
-        np.save(sd_out, res.posterior_sd(destandardize=False)
-                if args.raw_coords else res.Sigma_sd)
+        if write_files:
+            np.save(sd_out, res.posterior_sd(destandardize=False)
+                    if args.raw_coords else res.Sigma_sd)
     print(json.dumps({
         "out": args.out,
         "sd_out": sd_out,

@@ -157,7 +157,7 @@ def gibbs_sweep(
 
     with jax.named_scope("lambda_update"):
         kl = _shard_keys(jax.random.fold_in(key, _SITE_LAM), shard_offset, Gl)
-        if cfg.lambda_kernel == "pallas":
+        if cfg.lambda_kernel.startswith("pallas"):
             # Flatten shards x rows into ONE kernel batch: under vmap the
             # pallas batching rule would instead pad each shard's P rows to
             # the lane tile separately (~3x wasted lanes at P=157).  The
@@ -168,9 +168,13 @@ def gibbs_sweep(
             Q, B = jax.vmap(lam_terms)(Y, eta_lam, state.ps, plam)
             Zn = jax.vmap(
                 lambda k, b: jax.random.normal(k, b.shape, b.dtype))(kl, B)
+            # "pallas-interpret" is the api-internal name fit() substitutes
+            # when the resolved execution platform is not TPU; bare "pallas"
+            # leaves interpret=None (the wrapper auto-detects)
+            interp = True if cfg.lambda_kernel == "pallas-interpret" else None
             Lam = chol_sample_batched_pallas(
                 Q.reshape(Gl * P, K, K), B.reshape(Gl * P, K),
-                Zn.reshape(Gl * P, K)).reshape(Gl, P, K)
+                Zn.reshape(Gl * P, K), interpret=interp).reshape(Gl, P, K)
         else:
             Lam = jax.vmap(lam_update)(kl, Y, eta_lam, state.ps, plam)
         if state.active is not None:

@@ -145,10 +145,16 @@ def sample_mvn_precision_batched(
       lax.linalg's batched kernels.
     """
     K = Q.shape[-1]
+    if impl not in ("auto", "unrolled", "lax", "pallas", "pallas-interpret"):
+        raise ValueError(
+            f"unknown impl {impl!r} (auto | unrolled | lax | pallas); a "
+            "typo would otherwise silently fall back to the slow lax path")
     Zn = jax.random.normal(key, B.shape, B.dtype)
-    if impl == "pallas":
+    if impl in ("pallas", "pallas-interpret"):
         from dcfm_tpu.ops.pallas_gaussian import chol_sample_batched_pallas
-        return chol_sample_batched_pallas(Q, B, Zn)
+        return chol_sample_batched_pallas(
+            Q, B, Zn,
+            interpret=True if impl == "pallas-interpret" else None)
     if impl == "unrolled" or (impl == "auto" and K <= _UNROLL_MAX_K):
         cols = _chol_unrolled(Q)
         V = _fwd_solve_unrolled(cols, B)

@@ -44,14 +44,14 @@ def initialize_multihost(coordinator_address=None, num_processes=None,
 
     Under a TPU slice launched through a cluster scheduler (GKE/Borg-style),
     all three arguments auto-detect; pass them explicitly elsewhere.  Data
-    feeding at multi-host scale: give each process only its own row-panel
-    of shards and build the global array with
-    ``jax.make_array_from_process_local_data`` over
-    ``NamedSharding(mesh, shard_spec())`` instead of ``place_sharded``
-    (which assumes the full (g, n, P) array is host-local).
+    feeding at multi-host scale goes through
+    ``parallel.multihost.place_sharded_global`` (every process passes the
+    identical full host array; each device receives only its slice) - the
+    path ``fit()`` takes automatically when ``jax.process_count() > 1``.
 
-    Single-process calls (the only case testable on this box) skip the
-    distributed init and return the local mesh.
+    Single-process calls skip the distributed init and return the local
+    mesh; multi-process execution is exercised end-to-end by
+    scripts/multihost_demo.py (2 processes over Gloo).
     """
     if num_processes is not None and num_processes > 1 or (
             coordinator_address is not None):

@@ -72,6 +72,14 @@ def mvn_mean_precision_batched_ref(Q, B):
     return M[..., 0]
 
 
+def test_unknown_impl_raises():
+    rng = np.random.default_rng(0)
+    Q = jnp.asarray(_random_spd(rng, 4, 3))
+    B = jnp.asarray(rng.standard_normal((4, 3)).astype(np.float32))
+    with pytest.raises(ValueError, match="unknown impl"):
+        sample_mvn_precision_batched(jax.random.key(0), Q, B, impl="unroled")
+
+
 def test_fit_with_pallas_kernel():
     # end-to-end: the whole chain runs with lambda_kernel="pallas"
     from dcfm_tpu import FitConfig, ModelConfig, RunConfig, fit
