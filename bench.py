@@ -58,7 +58,9 @@ def main():
     # into burn-in.
     mcmc = max(((ITERS - ITERS // 2) // thin) * thin, thin)
     burnin = ITERS - mcmc
-    chunk = max(ITERS // 10, 1)
+    # each chunk is a host round-trip over the tunnel (~0.2 s dispatch +
+    # trace fetch); 4 chunks balances that against progress granularity
+    chunk = max(ITERS // 4, 1)
     cfg = FitConfig(
         model=ModelConfig(num_shards=G, factors_per_shard=K_TOTAL // G,
                           rho=0.9,
