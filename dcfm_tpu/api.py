@@ -39,6 +39,7 @@ from dcfm_tpu.models.sampler import (
     run_chunk, schedule_array)
 from dcfm_tpu.utils.diagnostics import ess, split_rhat
 from dcfm_tpu.parallel.mesh import make_mesh, shards_per_device
+from dcfm_tpu.parallel.multihost import place_sharded_global
 from dcfm_tpu.parallel.shard import build_mesh_chain, place_sharded
 from dcfm_tpu.utils.checkpoint import (
     checkpoint_compatible, data_fingerprint, load_checkpoint,
@@ -409,11 +410,8 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
             mesh = make_mesh(n_mesh, devices)
             shards_per_device(m.num_shards, mesh)  # validates divisibility
             Y_up = _upload_host_array(pre.data, cfg.backend.upload_dtype)
-            if multiproc:
-                from dcfm_tpu.parallel.multihost import place_sharded_global
-                Yd = place_sharded_global(Y_up, mesh)
-            else:
-                Yd = place_sharded(Y_up, mesh)
+            Yd = (place_sharded_global(Y_up, mesh) if multiproc
+                  else place_sharded(Y_up, mesh))
             if Yd.dtype != jnp.float32:
                 Yd = _cast_f32_jit()(Yd)  # jit preserves the sharding
             carry, stats, executed, traces, chunk_secs, done = _run_chain(
