@@ -83,6 +83,13 @@ class FitResult:
     # (g(g+1)/2, P, P) entrywise-SD upper panels (shard coordinates); the
     # dense grid is derived lazily via .sigma_sd_blocks.
     sd_upper_panels: Optional[np.ndarray] = None
+    # Thinned posterior draws (RunConfig.store_draws): {"Lambda": (S, g, P,
+    # K), "ps": (S, g, P), "X": (S, n, K)} in shard coordinates (permuted /
+    # standardized; use .preprocess to map back), with a leading chain axis
+    # when num_chains > 1.  eta/Z draws are not stored (see
+    # models.sampler.DrawBuffers), so draw-level covariance reconstruction
+    # uses the plain rule.
+    draws: Optional[dict] = None
 
     @functools.cached_property
     def sigma_blocks(self) -> np.ndarray:
@@ -117,12 +124,15 @@ class FitResult:
 
 
 @functools.lru_cache(maxsize=32)
-def _local_fns(model: ModelConfig, num_iters: int, num_chains: int = 1):
+def _local_fns(model: ModelConfig, num_iters: int, num_chains: int = 1,
+               num_stored_draws: int = 0):
     """Jitted single-device init/chunk functions, cached on the frozen model
     config and scan length so repeated fit() calls (warm-up, chunked
     schedules, notebooks) reuse compilations instead of re-tracing per call.
     The chain schedule enters as traced values (schedule_array), so any
-    burnin/mcmc/thin combination hits the same compilation.
+    burnin/mcmc/thin combination hits the same compilation -
+    ``num_stored_draws`` (RunConfig.store_draws) is the one schedule-derived
+    static, since draw-buffer shapes must be known at trace time.
 
     With ``num_chains`` > 1 the whole chain machinery is vmapped over a
     leading chain axis with per-chain keys folded from the chain index
@@ -131,7 +141,8 @@ def _local_fns(model: ModelConfig, num_iters: int, num_chains: int = 1):
     prior = make_prior(model)
     init_one = functools.partial(
         init_chain, cfg=model, prior=prior,
-        num_global_shards=model.num_shards)
+        num_global_shards=model.num_shards,
+        num_stored_draws=num_stored_draws)
     chunk_one = functools.partial(
         run_chunk, cfg=model, prior=prior, num_iters=num_iters)
     # donate the carry: the accumulator is the biggest buffer on the device
@@ -152,10 +163,12 @@ def _local_fns(model: ModelConfig, num_iters: int, num_chains: int = 1):
 
 
 @functools.lru_cache(maxsize=32)
-def _mesh_fns(mesh, model: ModelConfig, num_iters: int, num_chains: int = 1):
+def _mesh_fns(mesh, model: ModelConfig, num_iters: int, num_chains: int = 1,
+              num_stored_draws: int = 0):
     prior = make_prior(model)
     return build_mesh_chain(mesh, model, prior, num_iters=num_iters,
-                            num_chains=num_chains)
+                            num_chains=num_chains,
+                            num_stored_draws=num_stored_draws)
 
 
 @functools.lru_cache(maxsize=64)
@@ -401,6 +414,8 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
         return carry, stats, executed, traces, chunk_secs, done
 
     C = run.num_chains
+    # static draw-buffer size (0 = feature off); see RunConfig.store_draws
+    S_draws = run.num_saved if run.store_draws else 0
     sched = schedule_array(run)
     profile_ctx = (jax.profiler.trace(cfg.backend.profile_dir)
                    if cfg.backend.profile_dir else contextlib.nullcontext())
@@ -415,8 +430,8 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
             if Yd.dtype != jnp.float32:
                 Yd = _cast_f32_jit()(Yd)  # jit preserves the sharding
             carry, stats, executed, traces, chunk_secs, done = _run_chain(
-                _mesh_fns(mesh, m, chunk, C)[0],
-                lambda ni: _mesh_fns(mesh, m, ni, C)[1], Yd)
+                _mesh_fns(mesh, m, chunk, C, S_draws)[0],
+                lambda ni: _mesh_fns(mesh, m, ni, C, S_draws)[1], Yd)
         else:
             with jax.default_device(devices[0]):
                 Yd = jax.device_put(
@@ -430,10 +445,10 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
                 # jit with the committed Yd) would present a different
                 # sharding signature and trigger a full recompile of the
                 # chunk function (~7s at the p=10k bench shape).
-                init_fn = _local_fns(m, chunk, C)[0]
+                init_fn = _local_fns(m, chunk, C, S_draws)[0]
                 carry, stats, executed, traces, chunk_secs, done = _run_chain(
                     lambda k, Y: jax.device_put(init_fn(k, Y), devices[0]),
-                    lambda ni: _local_fns(m, ni, C)[1], Yd)
+                    lambda ni: _local_fns(m, ni, C, S_draws)[1], Yd)
     if stats is None:
         # resumed from a finished checkpoint: recompute the diagnostics
         # from the carried running-health panel.
@@ -504,6 +519,12 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
     # first on multi-process runs (sharded leaves are not host-fetchable)
     state = jax.device_get(_replicate_jit(mesh)(carry.state)
                            if multiproc else carry.state)
+    draws = None
+    if carry.draws is not None:
+        d = jax.device_get(_replicate_jit(mesh)(carry.draws)
+                           if multiproc else carry.draws)
+        draws = {"Lambda": np.asarray(d.Lambda), "ps": np.asarray(d.ps),
+                 "X": np.asarray(d.X)}
 
     Sigma_sd = sd_upper = None
     if carry.sigma_sq_acc is not None:
@@ -537,6 +558,7 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
         chunk_seconds=chunk_secs,
         Sigma_sd=Sigma_sd,
         sd_upper_panels=sd_upper,
+        draws=draws,
     )
 
 

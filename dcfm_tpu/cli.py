@@ -68,6 +68,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="output .npy for the covariance estimate")
     f.add_argument("--raw-coords", action="store_true",
                    help="skip de-standardization (correlation-scale output)")
+    f.add_argument("--draws-out", default=None, metavar="PATH",
+                   help="also retain every thinned post-burn-in draw of "
+                        "(Lambda, ps, X) and write them to this .npz "
+                        "(shard coordinates; costs num_saved x state-size "
+                        "device memory)")
     f.add_argument("--checkpoint", default=None, metavar="PATH",
                    help="write the chain state here at every chunk boundary "
                         "(--chunk-size is the cadence)")
@@ -106,7 +111,8 @@ def main(argv=None) -> int:
             rank_adapt=args.rank_adapt, posterior_sd=args.posterior_sd),
         run=RunConfig(burnin=args.burnin, mcmc=args.mcmc, thin=args.thin,
                       seed=args.seed, chunk_size=args.chunk_size,
-                      num_chains=args.chains),
+                      num_chains=args.chains,
+                      store_draws=args.draws_out is not None),
         backend=BackendConfig(backend=args.backend,
                               mesh_devices=args.mesh_devices),
         checkpoint_path=args.checkpoint,
@@ -122,6 +128,8 @@ def main(argv=None) -> int:
     write_files = jax.process_index() == 0
     if write_files:
         np.save(args.out, Sigma)
+    if args.draws_out and write_files:
+        np.savez(args.draws_out, **res.draws)
     sd_out = None
     if res.Sigma_sd is not None:
         root, ext = os.path.splitext(args.out)
@@ -134,6 +142,7 @@ def main(argv=None) -> int:
     print(json.dumps({
         "out": args.out,
         "sd_out": sd_out,
+        "draws_out": args.draws_out,
         "shape": list(Sigma.shape),
         "seconds": round(res.seconds, 3),
         "iters_per_sec": round(res.iters_per_sec, 2),

@@ -159,6 +159,14 @@ class RunConfig:
     # over chains and split-R-hat/ESS diagnostics come for free (> 1 chain
     # enables R-hat).
     num_chains: int = 1
+    # Retain every thinned post-burn-in draw of (Lambda, ps, X) on device
+    # and return them in FitResult.draws - the per-draw quantities the
+    # posterior-mean-only reference throws away (``divideconquer.m:194``),
+    # enabling arbitrary posterior functionals (entrywise credible
+    # intervals, loading structure, ...).  Costs num_saved x (state size)
+    # device memory and, because buffer shapes are static, a compilation
+    # per schedule (the default path is schedule-agnostic).
+    store_draws: bool = False
 
     @property
     def total_iters(self) -> int:
@@ -251,6 +259,10 @@ def validate(cfg: FitConfig, n: int, p: int) -> None:
             f"num_chains must be >= 1, got {cfg.run.num_chains}")
     if cfg.run.mcmc % cfg.run.thin != 0:
         raise ValueError("mcmc must be divisible by thin")
+    if cfg.run.store_draws and cfg.run.num_saved < 1:
+        raise ValueError(
+            "store_draws=True but the schedule saves no draws "
+            f"(mcmc={cfg.run.mcmc}, thin={cfg.run.thin})")
     if m.prior not in ("mgp", "horseshoe", "dl"):
         raise ValueError(f"unknown prior {m.prior!r}")
     if m.estimator not in ("plain", "scaled"):

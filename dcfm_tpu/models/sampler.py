@@ -31,6 +31,24 @@ from dcfm_tpu.models.priors import Prior
 from dcfm_tpu.models.state import SamplerState, init_state
 
 
+class DrawBuffers(NamedTuple):
+    """Thinned post-burn-in posterior draws (RunConfig.store_draws).
+
+    The reference discards everything but the running covariance mean
+    (``divideconquer.m:194``); these buffers retain the per-draw sampler
+    quantities that define it, enabling arbitrary posterior functionals
+    (credible intervals for covariance entries, loading structure, ...).
+    eta/Z draws are deliberately NOT stored - (S, Gl, n, K) is the one
+    buffer that would not fit at scale - so draw-level covariance
+    reconstruction uses the plain rule (Lambda, ps, rho); the "scaled"
+    estimator's empirical factor moments exist only in the accumulated
+    mean.
+    """
+    Lambda: jax.Array        # (S, Gl, P, K)
+    ps: jax.Array            # (S, Gl, P)
+    X: jax.Array             # (S, n, K) - replicated, like state.X
+
+
 class ChainCarry(NamedTuple):
     state: SamplerState
     sigma_acc: jax.Array      # (Gl, G, P, P) running mean of Sigma row-panel
@@ -42,6 +60,8 @@ class ChainCarry(NamedTuple):
     # posterior-SD estimation, or None when ModelConfig.posterior_sd is off
     # (None keeps the default pytree structure unchanged).
     sigma_sq_acc: Optional[jax.Array] = None
+    # Thinned draw ring (see DrawBuffers), or None when store_draws is off.
+    draws: Optional[DrawBuffers] = None
 
 
 class ChainStats(NamedTuple):
@@ -166,7 +186,13 @@ def init_chain(
     num_global_shards: int,
     shard_offset=0,
     dtype=jnp.float32,
+    num_stored_draws: int = 0,
 ) -> ChainCarry:
+    """``num_stored_draws``: static size of the thinned-draw buffers
+    (RunConfig.num_saved when store_draws is on; 0 = no storage).  Static
+    because buffer shapes must be known at trace time - enabling draw
+    storage therefore compiles per schedule, unlike the schedule-agnostic
+    default path."""
     Gl, n, P = Y.shape
     K = cfg.factors_per_shard
     state = init_state(
@@ -174,11 +200,18 @@ def init_chain(
         as_=cfg.as_, bs=cfg.bs, shard_offset=shard_offset,
         rank_adapt=cfg.rank_adapt, dtype=dtype)
     sigma_acc = jnp.zeros((Gl, num_global_shards, P, P), dtype)
+    draws = None
+    if num_stored_draws:
+        draws = DrawBuffers(
+            Lambda=jnp.zeros((num_stored_draws, Gl, P, K), dtype),
+            ps=jnp.zeros((num_stored_draws, Gl, P), dtype),
+            X=jnp.zeros((num_stored_draws, n, K), dtype))
     return ChainCarry(state=state, sigma_acc=sigma_acc,
                       iteration=jnp.zeros((), jnp.int32),
                       health=_health_init(Gl, dtype),
                       sigma_sq_acc=(jnp.zeros_like(sigma_acc)
-                                    if cfg.posterior_sd else None))
+                                    if cfg.posterior_sd else None),
+                      draws=draws)
 
 
 def run_chunk(
@@ -223,7 +256,7 @@ def run_chunk(
             state = adapt_rank(it_key, state, it, burnin, cfg)
 
         def accumulate(accs):
-            acc, acc_sq = accs
+            acc, acc_sq, draws = accs
             Lam_all = gather_fn(state.Lambda)
             if cfg.estimator == "scaled":
                 eta = (jnp.sqrt(cfg.rho) * state.X[None]
@@ -239,18 +272,30 @@ def run_chunk(
             acc = acc + blocks * inv_eff
             if acc_sq is not None:
                 acc_sq = acc_sq + (blocks * blocks) * inv_eff
-            return acc, acc_sq
+            if draws is not None:
+                # 0-based index of this saved draw; clamped by
+                # dynamic_update_slice if a resumed schedule ever overran
+                idx = (it - burnin) // thin - 1
+                draws = DrawBuffers(
+                    Lambda=lax.dynamic_update_slice_in_dim(
+                        draws.Lambda, state.Lambda[None], idx, axis=0),
+                    ps=lax.dynamic_update_slice_in_dim(
+                        draws.ps, state.ps[None], idx, axis=0),
+                    X=lax.dynamic_update_slice_in_dim(
+                        draws.X, state.X[None], idx, axis=0))
+            return acc, acc_sq, draws
 
         save = jnp.logical_and(it > burnin, (it - burnin) % thin == 0)
         with jax.named_scope("combine"):
-            sigma_acc, sigma_sq_acc = lax.cond(
+            sigma_acc, sigma_sq_acc, draw_bufs = lax.cond(
                 save, accumulate, lambda a: a,
-                (carry.sigma_acc, carry.sigma_sq_acc))
+                (carry.sigma_acc, carry.sigma_sq_acc, carry.draws))
         with jax.named_scope("health_trace"):
             health = _health_update(carry.health, _health_now(state, prior))
             trace = _trace_now(state, reduce_fn, carry.sigma_acc.shape[1],
                                cfg.rho)
-        return ChainCarry(state, sigma_acc, it, health, sigma_sq_acc), trace
+        return ChainCarry(state, sigma_acc, it, health, sigma_sq_acc,
+                          draw_bufs), trace
 
     keys = jax.vmap(lambda i: jax.random.fold_in(key, i))(
         carry.iteration + jnp.arange(num_iters))

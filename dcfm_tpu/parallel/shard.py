@@ -38,7 +38,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from dcfm_tpu.config import ModelConfig, RunConfig
 from dcfm_tpu.models.priors import Prior
 from dcfm_tpu.models.sampler import (
-    ChainCarry, ChainStats, chain_keys, init_chain, run_chunk)
+    ChainCarry, ChainStats, DrawBuffers, chain_keys, init_chain, run_chunk)
 from dcfm_tpu.parallel.mesh import (
     SHARD_AXIS, replicated_spec, shard_spec, shards_per_device)
 
@@ -66,6 +66,7 @@ def build_mesh_chain(
     *,
     num_iters: int,
     num_chains: int = 1,
+    num_stored_draws: int = 0,
     compiler_options: Optional[dict] = None,
 ):
     """Returns jitted (init_fn, chunk_fn) operating on mesh-sharded arrays.
@@ -96,6 +97,9 @@ def build_mesh_chain(
     rep = replicated_spec()
     # under a chain axis, the shard axis moves to position 1
     sh_c = P(None, SHARD_AXIS) if C > 1 else sh
+    # draw buffers carry a leading draw axis before the shard axis (plus
+    # the chain axis when C > 1); X draws are replicated like state.X
+    sh_d = P(None, None, SHARD_AXIS) if C > 1 else P(None, SHARD_AXIS)
 
     def carry_specs() -> ChainCarry:
         # Every SamplerState leaf is shard-major except the replicated X.
@@ -103,9 +107,12 @@ def build_mesh_chain(
         state_spec = SamplerState(Lambda=sh_c, Z=sh_c, X=rep, ps=sh_c,
                                   prior=jax.tree.map(lambda _: sh_c, prior_leaf_tree),
                                   active=sh_c if cfg.rank_adapt else None)
+        draws_spec = (DrawBuffers(Lambda=sh_d, ps=sh_d, X=rep)
+                      if num_stored_draws else None)
         return ChainCarry(state=state_spec, sigma_acc=sh_c, iteration=rep,
                           health=sh_c,
-                          sigma_sq_acc=sh_c if cfg.posterior_sd else None)
+                          sigma_sq_acc=sh_c if cfg.posterior_sd else None,
+                          draws=draws_spec)
 
     # Build a template of the prior pytree structure to spec it out.
     import jax.numpy as jnp  # noqa: F811
@@ -117,7 +124,8 @@ def build_mesh_chain(
         return init_chain(
             key, Y, cfg, prior,
             num_global_shards=g,
-            shard_offset=_shard_offset(gl))
+            shard_offset=_shard_offset(gl),
+            num_stored_draws=num_stored_draws)
 
     def _chunk_one(key, Y, carry, sched):
         return run_chunk(

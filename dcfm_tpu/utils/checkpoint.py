@@ -164,6 +164,10 @@ def checkpoint_compatible(
     if saved.run.num_chains != cfg.run.num_chains:
         return (f"num_chains changed: {saved.run.num_chains} != "
                 f"{cfg.run.num_chains} (the carry has a per-chain axis)")
+    if saved.run.store_draws != cfg.run.store_draws:
+        return (f"store_draws changed: {saved.run.store_draws} != "
+                f"{cfg.run.store_draws} (the carry gains/loses the "
+                "draw-buffer leaves)")
     if meta["fingerprint"] != fingerprint:
         return "data fingerprint mismatch - resuming on different data"
     return None

@@ -46,6 +46,21 @@ def test_fit_npy_to_sigma(tmp_path, capsys, data_npy):
     assert err < 0.8
 
 
+def test_fit_draws_out(tmp_path, capsys, data_npy):
+    path, _, _ = data_npy
+    out = str(tmp_path / "sigma_d.npy")
+    draws_out = str(tmp_path / "draws.npz")
+    rc, meta = _run(capsys, [
+        "fit", path, "-g", "2", "-k", "4", "--burnin", "20", "--mcmc", "20",
+        "--thin", "2", "--out", out, "--draws-out", draws_out])
+    assert rc == 0
+    assert meta["draws_out"] == draws_out
+    d = np.load(draws_out)
+    assert d["Lambda"].shape == (10, 2, 12, 2)   # (S, g, P, K)
+    assert d["ps"].shape == (10, 2, 12)
+    assert np.isfinite(d["Lambda"]).all()
+
+
 def test_fit_multichain_reports_rhat(tmp_path, capsys, data_npy):
     path, _, _ = data_npy
     out = str(tmp_path / "sigma_chains.npy")
