@@ -131,25 +131,44 @@ def _health_update(running: jax.Array, now: jax.Array) -> jax.Array:
 #   resid_var_mean   - mean_j 1/ps_j
 #   sigma_diag_mean  - their sum: the mean marginal variance ("selected
 #                      Sigma entries" summary, SURVEY.md section 4)
-TRACE_SUMMARIES = ("signal_var_mean", "resid_var_mean", "sigma_diag_mean")
+#   avg_loglik       - per-observation-cell average Gaussian log-likelihood
+#                      log N(y_ij | (eta Lam')_ij, 1/ps_j), the standard
+#                      whole-model convergence functional (also identified:
+#                      the likelihood sees only eta Lam' and ps)
+TRACE_SUMMARIES = ("signal_var_mean", "resid_var_mean", "sigma_diag_mean",
+                   "avg_loglik")
 
 
-def _trace_now(state: SamplerState, reduce_fn: Callable,
+def _trace_now(Y: jax.Array, state: SamplerState, reduce_fn: Callable,
                num_global_shards: int, rho: float) -> jax.Array:
-    """(3,) per-iteration scalar summaries, globally reduced over shards."""
+    """(4,) per-iteration scalar summaries, globally reduced over shards."""
     P = state.ps.shape[-1]
     n = state.X.shape[0]
     p_total = num_global_shards * P
     eta = (jnp.sqrt(rho) * state.X[None]
            + jnp.sqrt(1.0 - rho) * state.Z)                  # (Gl, n, K)
     E = jnp.einsum("gnk,gnj->gkj", eta, eta) / n             # (Gl, K, K)
-    M = jnp.einsum("gpk,gkj->gpj", state.Lambda, E)
-    # one fused reduce (a single psum on a mesh) for both scalars
-    signal, resid = reduce_fn(jnp.stack(
-        [jnp.sum(M * state.Lambda, axis=(1, 2)),
-         jnp.sum(1.0 / state.ps, axis=1)], axis=-1))
-    return jnp.stack([signal / p_total, resid / p_total,
-                      (signal + resid) / p_total])
+    M = jnp.einsum("gpk,gkj->gpj", state.Lambda, E)          # (Gl, P, K)
+    sig_j = jnp.sum(M * state.Lambda, axis=-1)               # (Gl, P)
+    # sse via ||y||^2 - 2 y'm + ||m||^2: only (Gl, P, K) temporaries (the
+    # naive residual would materialize a data-sized (Gl, n, P) slab every
+    # iteration); sum(Y^2) is scan-invariant, hoisted by XLA.
+    YE = jnp.einsum("gnp,gnk->gpk", Y, eta)                  # (Gl, P, K)
+    sse_j = jnp.maximum(
+        jnp.sum(Y * Y, axis=1)
+        - 2.0 * jnp.sum(YE * state.Lambda, axis=-1)
+        + n * sig_j, 0.0)                                    # (Gl, P)
+    loglik = 0.5 * jnp.sum(
+        n * (jnp.log(state.ps) - jnp.log(2.0 * jnp.pi))
+        - state.ps * sse_j, axis=-1)                         # (Gl,)
+    # one fused reduce (a single psum on a mesh) for all three scalars
+    signal, rvar, ll = reduce_fn(jnp.stack(
+        [jnp.sum(sig_j, axis=-1),
+         jnp.sum(1.0 / state.ps, axis=1),
+         loglik], axis=-1))
+    return jnp.stack([signal / p_total, rvar / p_total,
+                      (signal + rvar) / p_total,
+                      ll / (p_total * n)])
 
 
 def chain_keys(key: jax.Array, num_chains: int) -> jax.Array:
@@ -292,7 +311,7 @@ def run_chunk(
                 (carry.sigma_acc, carry.sigma_sq_acc, carry.draws))
         with jax.named_scope("health_trace"):
             health = _health_update(carry.health, _health_now(state, prior))
-            trace = _trace_now(state, reduce_fn, carry.sigma_acc.shape[1],
+            trace = _trace_now(Y, state, reduce_fn, carry.sigma_acc.shape[1],
                                cfg.rho)
         return ChainCarry(state, sigma_acc, it, health, sigma_sq_acc,
                           draw_bufs), trace

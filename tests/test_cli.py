@@ -69,7 +69,7 @@ def test_fit_multichain_reports_rhat(tmp_path, capsys, data_npy):
         "--chains", "2", "--rank-adapt", "--out", out])
     assert rc == 0
     assert set(meta["rhat"]) == {"signal_var_mean", "resid_var_mean",
-                                 "sigma_diag_mean"}
+                                 "sigma_diag_mean", "avg_loglik"}
     # a 40-draw toy run is not converged - the pin is that real finite
     # diagnostics flow through to the report, not their values
     assert all(np.isfinite(v) and v > 0.8 for v in meta["rhat"].values())

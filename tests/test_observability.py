@@ -23,6 +23,32 @@ def _fit(Y, prior="mgp", **kw):
         run=RunConfig(burnin=20, mcmc=20, thin=1, seed=0), **kw))
 
 
+def test_trace_avg_loglik_matches_numpy():
+    # the 4th chain summary is the per-cell average Gaussian log-likelihood
+    # of the CURRENT state; pin it against a direct NumPy computation
+    import jax.numpy as jnp
+    from dcfm_tpu.models.conditionals import local_sum
+    from dcfm_tpu.models.sampler import TRACE_SUMMARIES, _trace_now
+    from dcfm_tpu.models.state import SamplerState
+
+    rng = np.random.default_rng(0)
+    Gl, n, P, K, rho = 3, 7, 5, 2, 0.7
+    Y = rng.standard_normal((Gl, n, P)).astype(np.float32)
+    Lam = rng.standard_normal((Gl, P, K)).astype(np.float32)
+    Z = rng.standard_normal((Gl, n, K)).astype(np.float32)
+    X = rng.standard_normal((n, K)).astype(np.float32)
+    ps = rng.uniform(0.5, 2.0, (Gl, P)).astype(np.float32)
+    state = SamplerState(Lambda=jnp.asarray(Lam), Z=jnp.asarray(Z),
+                         X=jnp.asarray(X), ps=jnp.asarray(ps), prior=None)
+    tr = np.asarray(_trace_now(jnp.asarray(Y), state, local_sum, Gl, rho))
+    eta = np.sqrt(rho) * X[None] + np.sqrt(1 - rho) * Z
+    mean = np.einsum("gnk,gpk->gnp", eta, Lam)
+    var = (1.0 / ps)[:, None, :]
+    cell_ll = -0.5 * (np.log(2 * np.pi * var) + (Y - mean) ** 2 / var)
+    idx = TRACE_SUMMARIES.index("avg_loglik")
+    np.testing.assert_allclose(tr[idx], cell_ll.mean(), rtol=1e-5)
+
+
 def test_nonfinite_counter_zero_on_healthy_chain():
     Y, _ = make_synthetic(50, 24, 2, seed=71)
     res = _fit(Y)
