@@ -299,6 +299,29 @@ def _resolve_devices(backend: BackendConfig):
 
 
 def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
+    """Fit the divide-and-conquer Bayesian factor model to (n, p) data.
+
+    The config-first entry point (the reference's 7-positional-arg contract
+    lives in :func:`divideconquer`).  Pipeline: host preprocessing (zero-
+    column filter, optional permutation, sharding, standardization - all
+    inverted in the returned Sigma), jitted Gibbs chain on the selected
+    backend (single-device vmap, N-device ``shard_map`` mesh via
+    ``BackendConfig.mesh_devices``, or multi-host SPMD when the JAX
+    distributed runtime is up - see parallel/multihost.py), on-device
+    covariance-panel accumulation, and a bandwidth-optimized fetch +
+    native host assembly.
+
+    Returns a :class:`FitResult`: the (p, p) posterior-mean covariance in
+    the CALLER's coordinates, plus state, health stats, per-iteration chain
+    summaries with split-R-hat/ESS, optional entrywise posterior SD
+    (``ModelConfig.posterior_sd``) and optional thinned posterior draws
+    (``RunConfig.store_draws``).
+
+    Checkpoint/resume: with ``cfg.checkpoint_path`` the full chain state is
+    persisted at every chunk boundary; ``resume=True`` continues a
+    compatible run bitwise-identically, ``resume="auto"`` is the elastic
+    mode (resume if compatible, fresh start otherwise).
+    """
     Y = np.asarray(Y)
     if Y.ndim != 2:
         raise ValueError(f"Y must be an (n, p) matrix, got shape {Y.shape}")
