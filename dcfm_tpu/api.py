@@ -43,7 +43,8 @@ from dcfm_tpu.parallel.multihost import place_sharded_global
 from dcfm_tpu.parallel.shard import build_mesh_chain, place_sharded
 from dcfm_tpu.utils.checkpoint import (
     checkpoint_compatible, data_fingerprint, load_checkpoint,
-    read_checkpoint_meta, save_checkpoint)
+    load_checkpoint_multiprocess, proc_path, read_checkpoint_meta,
+    save_checkpoint, save_checkpoint_multiprocess)
 from dcfm_tpu import native
 from dcfm_tpu.utils.estimate import (
     assemble_from_upper, assembly_maps, extract_upper_blocks,
@@ -346,13 +347,10 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
     multiproc = jax.process_count() > 1
     if multiproc:
         # Multi-host SPMD run (parallel/multihost.py): every process runs
-        # this same fit() call; the mesh must span all processes' devices
-        # and data placement / result fetch go through the cross-process
-        # paths below.
-        if cfg.checkpoint_path:
-            raise NotImplementedError(
-                "checkpoint/resume is single-process for now: the sharded "
-                "carry would need a cross-host gather per save")
+        # this same fit() call; the mesh must span all processes' devices,
+        # data placement / result fetch go through the cross-process paths
+        # below, and checkpoints are per-process shard-local files
+        # (utils/checkpoint.py save/load_checkpoint_multiprocess).
         n_mesh = n_mesh or len(devices)
         if n_mesh != len(devices):
             raise ValueError(
@@ -420,8 +418,65 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
                 f"resume=True but no checkpoint at {cfg.checkpoint_path}")
         return init_fn(k_init, Yd), 0
 
+    def _resume_state_multiproc(init_fn, Yd):
+        """Multi-host resume: each process loads its own shard-local file
+        (utils/checkpoint.proc_path) into the shardings of a fresh init.
+
+        The resume decision is COLLECTIVE and iteration-exact: every
+        process reports the iteration its file holds (-1 = not loadable)
+        and the chain resumes only if ALL processes report the SAME
+        iteration - a kill can land between two processes' saves, leaving
+        files one chunk apart, and resuming from mismatched iterations
+        would deadlock the SPMD collectives.  No process raises before the
+        gather (a pre-collective raise would hang the peers inside it);
+        strict-mode failures surface as a local error after it.
+        """
+        auto = cfg.resume == "auto"
+        carry0 = init_fn(k_init, Yd)
+        loaded, failure = None, None
+        my_path = proc_path(cfg.checkpoint_path, jax.process_index(),
+                            jax.process_count())
+        if cfg.resume and os.path.exists(my_path):
+            try:
+                meta = read_checkpoint_meta(my_path)
+                reason = checkpoint_compatible(meta, cfg, fingerprint)
+                if reason is not None:
+                    failure = f"refusing to resume: {reason}"
+                else:
+                    # free the init buffers before the load materializes
+                    # the checkpointed copies - no doubled accumulator peak
+                    template = jax.tree.map(
+                        lambda a: jax.ShapeDtypeStruct(
+                            a.shape, a.dtype, sharding=a.sharding), carry0)
+                    jax.tree.map(lambda a: a.delete(), carry0)
+                    carry0 = None
+                    loaded = load_checkpoint_multiprocess(
+                        cfg.checkpoint_path, template)
+            except Exception as e:
+                failure = f"checkpoint unreadable: {e}"
+        elif cfg.resume:
+            failure = f"no checkpoint at {my_path}"
+
+        from jax.experimental import multihost_utils
+        my_iter = int(loaded[1]["iteration"]) if loaded is not None else -1
+        all_iters = multihost_utils.process_allgather(
+            np.asarray([my_iter], np.int64)).reshape(-1)
+        agree = my_iter >= 0 and bool(np.all(all_iters == my_iter))
+        if agree:
+            return loaded[0], my_iter
+        if cfg.resume and not auto:
+            raise ValueError(
+                failure or "resume=True but the per-process checkpoints "
+                f"disagree on the iteration ({all_iters.tolist()}) - a "
+                "crash between two processes' saves; delete the files or "
+                "use resume='auto' to restart fresh")
+        if carry0 is None:   # init was freed for a load that was discarded
+            carry0 = init_fn(k_init, Yd)
+        return carry0, 0
+
     def _run_chain(init_fn, get_chunk_fn, Yd):
-        carry, done = _resume_state(init_fn, Yd)
+        carry, done = (_resume_state_multiproc if multiproc
+                       else _resume_state)(init_fn, Yd)
         stats = None
         traces = []
         chunk_secs = []
@@ -432,8 +487,9 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
             traces.append(np.asarray(trace))
             chunk_secs.append(time.perf_counter() - tc)
             if cfg.checkpoint_path:
-                save_checkpoint(cfg.checkpoint_path, carry, cfg,
-                                fingerprint=fingerprint)
+                (save_checkpoint_multiprocess if multiproc
+                 else save_checkpoint)(cfg.checkpoint_path, carry, cfg,
+                                       fingerprint=fingerprint)
         return carry, stats, executed, traces, chunk_secs, done
 
     C = run.num_chains
@@ -474,9 +530,13 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
                     lambda ni: _local_fns(m, ni, C, S_draws)[1], Yd)
     if stats is None:
         # resumed from a finished checkpoint: recompute the diagnostics
-        # from the carried running-health panel.
-        h = np.asarray(carry.health)
-        ranks = np.asarray(effective_ranks(carry.state))
+        # from the carried running-health panel (replicated first on
+        # multi-process runs - sharded leaves are not host-fetchable).
+        src_h, src_state = ((carry.health, carry.state) if not multiproc
+                            else jax.device_get(_replicate_jit(mesh)(
+                                (carry.health, carry.state))))
+        h = np.asarray(src_h)
+        ranks = np.asarray(effective_ranks(src_state))
         stats = ChainStats(tau_log_max=h[..., 0].max(),
                            ps_min=h[..., 1].min(), ps_max=h[..., 2].max(),
                            rank_min=ranks.min(), rank_max=ranks.max(),

@@ -76,6 +76,26 @@ def _config_from_json(d: dict) -> FitConfig:
     )
 
 
+def _atomic_savez(target: str, meta: dict, payload: dict) -> None:
+    """Atomic npz write (tmp + rename): a crash mid-save never corrupts the
+    previous checkpoint.  One home for the durability semantics."""
+    d = os.path.dirname(os.path.abspath(target)) or "."
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".npz.tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez(
+                f,
+                __meta__=np.frombuffer(
+                    json.dumps(meta).encode(), dtype=np.uint8),
+                **payload,
+            )
+        os.replace(tmp, target)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+
 def save_checkpoint(
     path: str,
     carry: Any,
@@ -95,21 +115,8 @@ def save_checkpoint(
         "iteration": int(np.asarray(carry.iteration).reshape(-1)[0]),
         "fingerprint": fingerprint,
     }
-    d = os.path.dirname(os.path.abspath(path)) or "."
-    os.makedirs(d, exist_ok=True)
-    fd, tmp = tempfile.mkstemp(dir=d, suffix=".npz.tmp")
-    try:
-        with os.fdopen(fd, "wb") as f:
-            np.savez(
-                f,
-                __meta__=np.frombuffer(
-                    json.dumps(meta).encode(), dtype=np.uint8),
-                **{f"leaf_{i}": np.asarray(l) for i, l in enumerate(leaves)},
-            )
-        os.replace(tmp, path)
-    finally:
-        if os.path.exists(tmp):
-            os.unlink(tmp)
+    _atomic_savez(path, meta,
+                  {f"leaf_{i}": np.asarray(l) for i, l in enumerate(leaves)})
 
 
 def read_checkpoint_meta(path: str) -> dict:
@@ -146,6 +153,111 @@ def load_checkpoint(path: str, carry_template: Any) -> Tuple[Any, dict]:
                     f"{np.shape(tl)} - config/data mismatch?")
             leaves.append(arr)
         return jax.tree.unflatten(treedef, leaves), meta
+
+
+def proc_path(path: str, process_index: int, process_count: int) -> str:
+    """Per-process checkpoint filename for multi-host runs."""
+    return f"{path}.proc{process_index}-of-{process_count}"
+
+
+def save_checkpoint_multiprocess(
+    path: str,
+    carry: Any,
+    cfg: FitConfig,
+    *,
+    fingerprint: str,
+) -> None:
+    """Multi-host checkpoint: process k atomically writes its own
+    ``path.prock-of-N`` with exactly the shard data its devices own - no
+    cross-host gather, so the save cost stays p^2/n_processes per host.
+
+    Replicated leaves (X, iteration, ...) are stored whole in every file
+    (cheap; keeps each file self-contained).  Sharded leaves store one
+    entry per addressable shard, keyed by the shard's global offsets, so
+    reload is layout-exact and fails loudly on a device->process layout
+    change rather than silently permuting shards.
+    """
+    leaves, treedef = jax.tree.flatten(carry)
+    payload, leaf_meta = {}, []
+    for i, leaf in enumerate(leaves):
+        if not isinstance(leaf, jax.Array) or leaf.is_fully_replicated:
+            payload[f"leaf_{i}"] = np.asarray(jax.device_get(leaf))
+            leaf_meta.append({"mode": "replicated"})
+        else:
+            offsets = []
+            for j, s in enumerate(leaf.addressable_shards):
+                payload[f"leaf_{i}_s{j}"] = np.asarray(s.data)
+                offsets.append([int(sl.start or 0) for sl in s.index])
+            leaf_meta.append({"mode": "sharded", "offsets": offsets})
+    meta = {
+        "version": _FORMAT_VERSION,
+        "config": _config_to_json(cfg),
+        "treedef": str(treedef),
+        "iteration": int(np.asarray(
+            jax.device_get(carry.iteration)).reshape(-1)[0]),
+        "fingerprint": fingerprint,
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "leaf_meta": leaf_meta,
+    }
+    _atomic_savez(proc_path(path, jax.process_index(), jax.process_count()),
+                  meta, payload)
+
+
+def load_checkpoint_multiprocess(path: str, carry_like: Any) -> Tuple[Any, dict]:
+    """Load this process's shard-local checkpoint into concrete global arrays.
+
+    ``carry_like`` supplies each leaf's shape/dtype AND target sharding -
+    either a concrete carry or (cheaper) a pytree of
+    ``jax.ShapeDtypeStruct(..., sharding=...)`` derived from one - because
+    unlike the single-process loader, host numpy leaves cannot simply be
+    fed back into the jitted chunk here: a multi-process jit cannot
+    consume non-addressable full arrays.  Each sharded leaf is rebuilt
+    with ``jax.make_array_from_callback``, looking shards up by their
+    saved global offsets.
+    """
+    target = proc_path(path, jax.process_index(), jax.process_count())
+    with np.load(target) as z:
+        meta = json.loads(bytes(z["__meta__"]).decode())
+        if meta["version"] != _FORMAT_VERSION:
+            raise ValueError(
+                f"checkpoint format v{meta['version']} != v{_FORMAT_VERSION}")
+        if meta["process_count"] != jax.process_count():
+            raise ValueError(
+                f"checkpoint written by {meta['process_count']} processes, "
+                f"resuming with {jax.process_count()}")
+        leaves_like, treedef = jax.tree.flatten(carry_like)
+        lm = meta["leaf_meta"]
+        if len(lm) != len(leaves_like):
+            raise ValueError(
+                f"checkpoint has {len(lm)} leaves, carry has "
+                f"{len(leaves_like)} - config mismatch?")
+        out = []
+        for i, tpl in enumerate(leaves_like):
+            if lm[i]["mode"] == "replicated":
+                arr = z[f"leaf_{i}"]
+                if tuple(arr.shape) != tuple(np.shape(tpl)):
+                    raise ValueError(
+                        f"checkpoint leaf {i} shape {arr.shape} != expected "
+                        f"{np.shape(tpl)}")
+                sh = getattr(tpl, "sharding", None)
+                out.append(jax.device_put(arr, sh) if sh is not None else arr)
+            else:
+                blocks = {tuple(off): z[f"leaf_{i}_s{j}"]
+                          for j, off in enumerate(lm[i]["offsets"])}
+
+                def cb(idx, _blocks=blocks, _i=i):
+                    start = tuple(int(sl.start or 0) for sl in idx)
+                    b = _blocks.get(start)
+                    if b is None:
+                        raise ValueError(
+                            f"checkpoint leaf {_i}: no saved shard at "
+                            f"offset {start} - device layout changed?")
+                    return b
+
+                out.append(jax.make_array_from_callback(
+                    tpl.shape, tpl.sharding, cb))
+        return jax.tree.unflatten(treedef, out), meta
 
 
 def checkpoint_compatible(

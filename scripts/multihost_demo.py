@@ -72,6 +72,109 @@ def child(process_id: int) -> None:
     }), flush=True)
 
 
+def child_ck(process_id: int) -> None:
+    """Multi-host elastic recovery: crash after the first per-process
+    checkpoint save, resume="auto", and verify the recovered chain is
+    identical to an uninterrupted run; then resume from the finished
+    checkpoint and verify the no-op contract."""
+    os.environ["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={DEVS_PER_PROC}")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from dcfm_tpu.parallel import multihost
+    multihost.initialize(f"127.0.0.1:{PORT}", NPROC, process_id)
+
+    import numpy as np
+    import dcfm_tpu.api as api
+    from dcfm_tpu import BackendConfig, FitConfig, ModelConfig, RunConfig
+    rng = np.random.default_rng(SEED)
+    p = G * P_SHARD
+    Y = rng.standard_normal((N, p)).astype(np.float32)
+    model = ModelConfig(num_shards=G, factors_per_shard=K, rho=0.9)
+    run = RunConfig(burnin=4, mcmc=2, thin=1, seed=SEED, chunk_size=2)
+    ckpath = os.path.join(os.environ["MULTIHOST_DEMO_DIR"], "chain.ck")
+
+    def cfg(resume):
+        return FitConfig(model=model, run=run,
+                         backend=BackendConfig(mesh_devices=0),
+                         checkpoint_path=ckpath, resume=resume)
+
+    ref = api.fit(Y, FitConfig(model=model, run=run,
+                               backend=BackendConfig(mesh_devices=0)))
+
+    real = api.save_checkpoint_multiprocess
+    calls = {"n": 0}
+
+    def killing(*a, **k):
+        real(*a, **k)
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("simulated crash mid-chain")
+
+    api.save_checkpoint_multiprocess = killing
+    try:
+        api.fit(Y, cfg(False))
+        raise SystemExit("simulated crash did not fire")
+    except RuntimeError:
+        pass
+    api.save_checkpoint_multiprocess = real
+
+    res = api.fit(Y, cfg("auto"))            # elastic resume mid-chain
+    diff = float(np.abs(res.Sigma - ref.Sigma).max())
+    res2 = api.fit(Y, cfg(True))             # finished checkpoint: no-op
+    noop = res2.iters_per_sec == 0.0
+    diff2 = float(np.abs(res2.Sigma - res.Sigma).max())
+    print("CHILD_CK " + json.dumps({
+        "pid": process_id, "resumed_vs_uninterrupted_maxdiff": diff,
+        "finished_resume_noop": noop, "noop_maxdiff": diff2,
+    }), flush=True)
+
+
+def parent_ck() -> int:
+    t0 = time.perf_counter()
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [_REPO] + [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+                   if p])
+    import numpy as np
+    with tempfile.TemporaryDirectory() as tmp:
+        env["MULTIHOST_DEMO_DIR"] = tmp
+        procs = [subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--child-ck", str(i)],
+            env=env, cwd=_REPO, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True) for i in range(NPROC)]
+        results = {}
+        try:
+            for i, proc in enumerate(procs):
+                out, _ = proc.communicate(timeout=480)
+                if proc.returncode != 0:
+                    print(f"ck child {i} rc={proc.returncode}\n{out[-2000:]}",
+                          file=sys.stderr)
+                    return 1
+                for line in out.splitlines():
+                    if line.startswith("CHILD_CK "):
+                        results[i] = json.loads(line[len("CHILD_CK "):])
+        finally:
+            for proc in procs:
+                if proc.poll() is None:
+                    proc.kill()
+                    proc.wait()
+    if len(results) != NPROC:
+        print("missing CHILD_CK results", file=sys.stderr)
+        return 1
+    ok = all(r["resumed_vs_uninterrupted_maxdiff"] <= 1e-6
+             and r["finished_resume_noop"]
+             and r["noop_maxdiff"] <= 1e-6 for r in results.values())
+    print(json.dumps({
+        "demo": "multihost elastic recovery: crash + resume, 2 procs",
+        "seconds": round(time.perf_counter() - t0, 1),
+        "results": results[0],
+        "ok": ok,
+    }))
+    return 0 if ok else 1
+
+
 def parent() -> int:
     t0 = time.perf_counter()
     env = dict(os.environ)
@@ -152,5 +255,9 @@ def parent() -> int:
 if __name__ == "__main__":
     if len(sys.argv) > 2 and sys.argv[1] == "--child":
         child(int(sys.argv[2]))
+    elif len(sys.argv) > 2 and sys.argv[1] == "--child-ck":
+        child_ck(int(sys.argv[2]))
+    elif len(sys.argv) > 1 and sys.argv[1] == "--ck":
+        sys.exit(parent_ck())
     else:
         sys.exit(parent())

@@ -30,6 +30,24 @@ def test_multihost_demo_end_to_end():
     assert '"ok": true' in proc.stdout
 
 
+def test_multihost_elastic_recovery():
+    # crash after the first per-process checkpoint save, resume="auto",
+    # and require the recovered chain to match the uninterrupted run
+    # bitwise; then a finished-checkpoint resume must be a no-op.
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [_REPO] + [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+                   if p])
+    env["MULTIHOST_DEMO_PORT"] = "29851"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "scripts", "multihost_demo.py"),
+         "--ck"],
+        env=env, cwd=_REPO, capture_output=True, text=True, timeout=560)
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    assert '"ok": true' in proc.stdout
+
+
 def test_initialize_from_env_noop_without_vars():
     # in-process check of the no-op contract (no coordinator set)
     env_backup = {k: os.environ.pop(k, None)
