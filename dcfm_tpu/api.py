@@ -36,7 +36,7 @@ from dcfm_tpu.config import (
 from dcfm_tpu.models.priors import make_prior
 from dcfm_tpu.models.sampler import (
     TRACE_SUMMARIES, ChainStats, chain_keys, effective_ranks, init_chain,
-    run_chunk, schedule_array)
+    num_saved_draws, run_chunk, schedule_array)
 from dcfm_tpu.utils.diagnostics import ess, split_rhat
 from dcfm_tpu.parallel.mesh import make_mesh, shards_per_device
 from dcfm_tpu.parallel.multihost import place_sharded_global
@@ -183,10 +183,14 @@ def _fetch_jit(g: int, num_chains: int, mode: str, mesh=None):
 
     ``mesh`` (multi-process runs only): replicate the output over the mesh
     so every process can materialize it on host - XLA inserts the
-    cross-host all-gather inside the jit."""
-    def prep(acc):
+    cross-host all-gather inside the jit.
+
+    ``inv_count`` (traced): 1/saved-draw-count - the accumulators are raw
+    sums over saved draws (models.sampler.ChainCarry), so the posterior
+    mean is formed here, on device, before any down-cast/quantization."""
+    def prep(acc, inv_count):
         u = extract_upper_blocks(
-            acc.mean(axis=0) if num_chains > 1 else acc, g=g)
+            acc.mean(axis=0) if num_chains > 1 else acc, g=g) * inv_count
         if mode == "quant8":
             # Max-abs int8 per panel: one float32 scale per P x P block.
             # Entry error <= scale/254, ~4e-3 of the panel max - far below
@@ -575,11 +579,17 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
     # multi-process: replicate fetch outputs over the mesh (cross-host
     # all-gather inside the jit) so every process can materialize them
     fetch_mesh = mesh if multiproc else None
+    # The accumulators hold raw sums over saved draws; the division by the
+    # actual saved count happens on device at fetch (which is what lets a
+    # resumed run extend the chain - the count is only known at the end).
+    n_saved = num_saved_draws(done + executed, run.burnin, run.thin)
+    inv_count = np.float32(1.0 / max(n_saved, 1))
 
     def _fetch_upper(acc):
         # non-quant8 modes only; the quant8 fetch goes through the streamed
         # _quant8_fetch_assemble path below (single home for the dequant).
-        out = _fetch_jit(m.num_shards, C, fetch_mode, fetch_mesh)(acc)
+        out = _fetch_jit(m.num_shards, C, fetch_mode, fetch_mesh)(
+            acc, inv_count)
         return np.asarray(out).astype(np.float32, copy=False)
 
     # reinsert_zero_cols=True: Sigma is (p, p) in the caller's coordinates,
@@ -590,7 +600,7 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
     # while slice k+1 is still on the device->host link.
     if fetch_mode == "quant8":
         q_dev, scale_dev = _fetch_jit(m.num_shards, C, "quant8", fetch_mesh)(
-            carry.sigma_acc)
+            carry.sigma_acc, inv_count)
         upper, Sigma = _quant8_fetch_assemble(
             q_dev, scale_dev, m.num_shards, pre)
         if Sigma is None:
@@ -615,7 +625,7 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
         # Bessel-corrected over the pooled draw count; de-standardization
         # scales an SD exactly like a covariance entry (linear in the
         # scale product), so the same restore path applies.
-        n_draws = max(run.num_saved * C, 1)
+        n_draws = max(n_saved * C, 1)
         upper_sq = _fetch_upper(carry.sigma_sq_acc)
         var_u = np.maximum(upper_sq - upper * upper, 0.0)
         if n_draws > 1:

@@ -51,12 +51,17 @@ class DrawBuffers(NamedTuple):
 
 class ChainCarry(NamedTuple):
     state: SamplerState
-    sigma_acc: jax.Array      # (Gl, G, P, P) running mean of Sigma row-panel
+    sigma_acc: jax.Array      # (Gl, G, P, P) running SUM of Sigma row-panels
+                              # over saved draws; divide by num_saved_draws()
+                              # at fetch.  Raw sums (not 1/num_saved-weighted
+                              # means) so a resumed run may extend the chain:
+                              # the weight is applied once, at the end, with
+                              # the actual saved count.
     iteration: jax.Array      # scalar int32 - global Gibbs iteration count
     health: jax.Array         # (Gl, 4) running [max |log shrink-scale|,
                               # min ps, max ps, #iterations with non-finite
                               # state] over every iteration seen
-    # (Gl, G, P, P) running mean of Sigma**2 (elementwise second moment) for
+    # (Gl, G, P, P) running SUM of Sigma**2 (elementwise second moment) for
     # posterior-SD estimation, or None when ModelConfig.posterior_sd is off
     # (None keeps the default pytree structure unchanged).
     sigma_sq_acc: Optional[jax.Array] = None
@@ -183,8 +188,11 @@ def chain_keys(key: jax.Array, num_chains: int) -> jax.Array:
 
 
 def schedule_array(run: RunConfig) -> jax.Array:
-    """Pack (burnin, thin, 1/num_saved) as a traced float32 triple so the
-    jitted chunk function is schedule-agnostic (no recompile per RunConfig).
+    """Pack (burnin, thin) as a traced float32 pair so the jitted chunk
+    function is schedule-agnostic (no recompile per RunConfig).  The
+    accumulators are raw sums, so the schedule no longer carries a
+    1/num_saved weight - the division happens once, at fetch, with the
+    actual saved-draw count (:func:`num_saved_draws`).
 
     burnin/thin round-trip through float32, exact only below 2**24; a
     schedule that long would silently corrupt, so refuse it loudly."""
@@ -192,8 +200,14 @@ def schedule_array(run: RunConfig) -> jax.Array:
         raise ValueError(
             f"burnin={run.burnin}, thin={run.thin}: schedule entries must be "
             "< 2**24 (packed as float32 for the schedule-agnostic jit)")
-    eff = max(run.num_saved, 1)
-    return jnp.asarray([run.burnin, run.thin, 1.0 / eff], jnp.float32)
+    return jnp.asarray([run.burnin, run.thin], jnp.float32)
+
+
+def num_saved_draws(iteration: int, burnin: int, thin: int) -> int:
+    """Saved-draw count after ``iteration`` global Gibbs iterations under a
+    (burnin, thin) schedule - the divisor that turns the raw sum
+    accumulators (sigma_acc, sigma_sq_acc) into posterior means."""
+    return max(0, int(iteration) - burnin) // thin
 
 
 def init_chain(
@@ -250,13 +264,15 @@ def run_chunk(
 
     ``sched`` packs the chain schedule as traced values
     (see :func:`schedule_array`) so one compilation serves any
-    burnin/thin/num_saved - only ``num_iters`` (the scan length) and the
+    burnin/thin combination - only ``num_iters`` (the scan length) and the
     model config are compile-time static.
 
-    Accumulates Sigma row-panels on every thin-th post-burn-in draw with the
-    running-mean weight 1/num_saved (reference ``divideconquer.m:194``).
-    ``lax.cond`` skips the O(p^2 K / g) block work on non-saved iterations,
-    so burn-in costs only the sweep.
+    Accumulates raw SUMS of Sigma row-panels on every thin-th post-burn-in
+    draw; the caller divides by :func:`num_saved_draws` at fetch (the
+    reference folds the 1/effsamp weight into the accumulation,
+    ``divideconquer.m:194`` - summing instead is what makes chain
+    extension on resume exact).  ``lax.cond`` skips the O(p^2 K / g) block
+    work on non-saved iterations, so burn-in costs only the sweep.
 
     Returns (carry, stats, trace) with trace of shape
     (num_iters, len(TRACE_SUMMARIES)): per-iteration scalar chain summaries
@@ -264,7 +280,6 @@ def run_chunk(
     """
     burnin = sched[0].astype(jnp.int32)
     thin = sched[1].astype(jnp.int32)
-    inv_eff = sched[2]
 
     def body(carry: ChainCarry, it_key: jax.Array) -> tuple[ChainCarry, None]:
         state = gibbs_sweep(
@@ -288,9 +303,9 @@ def run_chunk(
                 eta_local=eta, eta_all=eta_all,
                 compute_dtype=(jnp.bfloat16
                                if cfg.combine_dtype == "bfloat16" else None))
-            acc = acc + blocks * inv_eff
+            acc = acc + blocks
             if acc_sq is not None:
-                acc_sq = acc_sq + (blocks * blocks) * inv_eff
+                acc_sq = acc_sq + blocks * blocks
             if draws is not None:
                 # 0-based index of this saved draw; clamped by
                 # dynamic_update_slice if a resumed schedule ever overran

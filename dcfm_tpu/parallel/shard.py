@@ -74,7 +74,7 @@ def build_mesh_chain(
     init_fn(key, Y_sharded) -> ChainCarry (leaves sharded over SHARD_AXIS,
     X replicated).  chunk_fn(key, Y_sharded, carry, sched) ->
     (carry, stats, trace) runs ``num_iters`` Gibbs iterations under the
-    (burnin, thin, 1/eff) schedule triple from models.sampler.schedule_array.
+    (burnin, thin) schedule pair from models.sampler.schedule_array.
 
     With ``num_chains`` > 1, every carry leaf gains a leading chain axis -
     chains are an inner vmap axis on each device (replicated over the mesh:

@@ -39,7 +39,11 @@ from dcfm_tpu.config import (
 # v2: the carried health panel grew from (Gl, 3) to (Gl, 4) (non-finite
 # counter); v1 checkpoints refuse with a version message rather than a
 # confusing leaf-shape error.
-_FORMAT_VERSION = 2
+# v3: sigma_acc/sigma_sq_acc hold raw SUMS over saved draws instead of
+# 1/num_saved-weighted running means (enables chain extension on resume);
+# resuming a v2 checkpoint would silently mis-scale the estimate, so the
+# version gate refuses it.
+_FORMAT_VERSION = 3
 
 
 def data_fingerprint(data: np.ndarray) -> str:
@@ -270,9 +274,17 @@ def checkpoint_compatible(
     if saved.run.seed != cfg.run.seed:
         return f"seed changed: {saved.run.seed} != {cfg.run.seed}"
     if (saved.run.burnin, saved.run.thin) != (cfg.run.burnin, cfg.run.thin):
-        return "burnin/thin changed (the accumulator weighting depends on them)"
-    if saved.run.mcmc != cfg.run.mcmc:
-        return "mcmc length changed (1/num_saved running-mean weight differs)"
+        return "burnin/thin changed (which draws count as saved depends on them)"
+    # The accumulators are raw sums, so a LONGER mcmc is a valid chain
+    # extension ("ran 1000, need 1000 more"); only shrinking below what
+    # already ran is unresumable (the extra draws cannot be un-summed).
+    if cfg.run.total_iters < meta["iteration"]:
+        return (f"checkpoint is at iteration {meta['iteration']} but the "
+                f"schedule ends at {cfg.run.total_iters} - a chain cannot "
+                "be shrunk (saved draws are already summed in)")
+    if saved.run.store_draws and saved.run.num_saved != cfg.run.num_saved:
+        return ("mcmc length changed with store_draws=True (the draw "
+                "buffers are statically sized by num_saved)")
     if saved.run.num_chains != cfg.run.num_chains:
         return (f"num_chains changed: {saved.run.num_chains} != "
                 f"{cfg.run.num_chains} (the carry has a per-chain axis)")

@@ -177,6 +177,50 @@ def test_mesh_resume_matches_mesh_uninterrupted(tmp_path, data):
         res_resumed.sigma_blocks, res_full.sigma_blocks)
 
 
+def test_chain_extension_matches_uninterrupted(tmp_path, data):
+    """"Ran 1000, need 1000 more": resume with a longer mcmc continues the
+    same chain, and the extended estimate equals an uninterrupted full-length
+    run bitwise.  Possible because the accumulators are raw sums (the
+    1/num_saved weight is applied once, at fetch, with the final count) -
+    the reference bakes 1/effsamp into every accumulation
+    (divideconquer.m:194) and cannot extend."""
+    ck = str(tmp_path / "ext.npz")
+    fit(data, dataclasses.replace(_cfg(), checkpoint_path=ck))  # mcmc=16
+
+    cfg_long = dataclasses.replace(
+        _cfg(), run=RunConfig(burnin=16, mcmc=32, thin=2, seed=3,
+                              chunk_size=8))
+    res_full = fit(data, cfg_long)
+    res_ext = fit(data, dataclasses.replace(
+        cfg_long, checkpoint_path=ck, resume=True))
+    assert res_ext.iters_per_sec > 0            # it actually ran the tail
+    np.testing.assert_array_equal(res_ext.sigma_blocks, res_full.sigma_blocks)
+    np.testing.assert_array_equal(res_ext.Sigma, res_full.Sigma)
+
+
+def test_resume_refuses_shrinking_chain(tmp_path, data):
+    ck = str(tmp_path / "shrink.npz")
+    fit(data, dataclasses.replace(_cfg(), checkpoint_path=ck))  # 32 iters
+    cfg_short = dataclasses.replace(
+        _cfg(), run=RunConfig(burnin=16, mcmc=8, thin=2, seed=3),
+        checkpoint_path=ck, resume=True)
+    with pytest.raises(ValueError, match="shrunk"):
+        fit(data, cfg_short)
+
+
+def test_extension_refused_with_store_draws(tmp_path, data):
+    """Draw buffers are statically sized by num_saved, so extension with
+    store_draws=True is a friendly refusal, not a leaf-shape crash."""
+    run_d = RunConfig(burnin=16, mcmc=16, thin=2, seed=3, chunk_size=8,
+                      store_draws=True)
+    ck = str(tmp_path / "draws.npz")
+    fit(data, dataclasses.replace(_cfg(), run=run_d, checkpoint_path=ck))
+    run_long = dataclasses.replace(run_d, mcmc=32)
+    with pytest.raises(ValueError, match="statically sized"):
+        fit(data, dataclasses.replace(
+            _cfg(), run=run_long, checkpoint_path=ck, resume=True))
+
+
 class _CarryLike(NamedTuple):
     a: np.ndarray
     b: np.ndarray

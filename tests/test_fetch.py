@@ -50,7 +50,7 @@ def test_quant8_zero_panel_safe():
     acc = np.zeros((g, g, P, P), np.float32)
     for i in range(g):
         acc[i, i] = np.eye(P) * (i + 1.0)   # only diagonal panels nonzero
-    q, scale = _fetch_jit(g, 1, "quant8")(acc)
+    q, scale = _fetch_jit(g, 1, "quant8")(acc, np.float32(1.0))
     q, scale = np.asarray(q), np.asarray(scale)
     deq = q.astype(np.float32) * scale[:, None, None] / 127.0
     assert np.isfinite(deq).all()
