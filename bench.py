@@ -85,6 +85,20 @@ def main():
                                   "BENCH_UPLOAD", "float16")),
     )
 
+    # Link-bandwidth probe: the axon tunnel's host<->device bandwidth
+    # fluctuates 2-25 MB/s day to day, and the panel fetch (~49 MB int8 at
+    # the north-star shape) rides it.  Measuring the raw link up front is
+    # what lets a reader of the JSON line attribute a seconds swing to the
+    # tunnel rather than to code (the phase split below does the rest).
+    probe_mb = 16.0
+    probe = jax.device_put(
+        np.zeros(int(probe_mb * 1e6 // 4), np.float32))
+    jax.block_until_ready(probe)
+    t = time.perf_counter()
+    np.asarray(probe)
+    tunnel_mbps = probe_mb / max(time.perf_counter() - t, 1e-9)
+    del probe
+
     # Warm-up: fit() caches jitted functions on (model, chunk_len) and the
     # schedule enters as traced values, so the timed run below reuses this
     # compilation exactly.  Two full chunks (not one: the second chunk-call
@@ -115,6 +129,17 @@ def main():
         # when the accuracy guard matters most.
         "rel_frob_err": round(err, 4) if np.isfinite(err) else None,
         "seconds": round(seconds, 2),
+        # Phase split (FitResult.phase_seconds): chain_s is the Gibbs
+        # compute (the code under test), fetch_s is the device->host panel
+        # transfer (rides the tunnel - see tunnel_MBps), assemble_s is host
+        # CPU that in quant8 mode runs inside the transfer's shadow.
+        # Round-over-round regressions should be judged on chain_s;
+        # fetch_s/upload_s swings track tunnel_MBps.
+        "chain_s": round(res.phase_seconds["chain_s"], 2),
+        "upload_s": round(res.phase_seconds["upload_s"], 2),
+        "fetch_s": round(res.phase_seconds["fetch_s"], 2),
+        "assemble_s": round(res.phase_seconds["assemble_s"], 2),
+        "tunnel_MBps": round(tunnel_mbps, 2),
     }
     print(json.dumps(result))
     # Accuracy guard: speed cannot be bought with a broken sampler.  The

@@ -78,6 +78,14 @@ class FitResult:
     # wall-clock per host-level chunk (SURVEY.md section 5 observability);
     # chunk_seconds[0] includes compilation.
     chunk_seconds: Optional[list] = None
+    # Phase-resolved wall-clock: {"upload_s", "chain_s", "fetch_s",
+    # "assemble_s"}.  On a tunneled device the fetch is usually the
+    # dominant term and fluctuates with link bandwidth; separating it from
+    # chain_s is what distinguishes a code regression from link weather.
+    # assemble_s is host CPU time only - in quant8 mode the native
+    # assembler runs inside the transfer's shadow, so it does not add to
+    # wall-clock on top of fetch_s.
+    phase_seconds: Optional[dict] = None
     # (p, p) entrywise posterior standard deviation of the covariance, in
     # the caller's coordinates; set when ModelConfig.posterior_sd is on.
     Sigma_sd: Optional[np.ndarray] = None
@@ -243,8 +251,11 @@ def _quant8_fetch_assemble(q_dev, scale_dev, g: int, pre: PreprocessResult,
     int8 assembler (dcfm_tpu/native: dequant folded into the one-pass
     scatter) run entirely in the transfer's shadow.
 
-    Returns (upper_f32, Sigma-or-None); None means the native library is
-    unavailable and the caller should assemble from ``upper_f32``.
+    Returns (upper_f32, Sigma-or-None, timing); None means the native
+    library is unavailable and the caller should assemble from
+    ``upper_f32``.  ``timing`` splits the drain into {"fetch_s"} (blocked
+    waiting on the link) and {"assemble_s"} (host CPU in dequant +
+    assembly, which runs in the next slice's transfer shadow).
     """
     scales = np.asarray(scale_dev)                   # (n_pairs,) tiny
     n_pairs, P, _ = q_dev.shape
@@ -261,16 +272,22 @@ def _quant8_fetch_assemble(q_dev, scale_dev, g: int, pre: PreprocessResult,
         out = np.zeros((p_out, p_out), np.float32)
     ok = out is not None
     pos = 0
+    fetch_s = assemble_s = 0.0
     for s in slices:
+        t = time.perf_counter()
         qh = np.asarray(s)                           # waits for this slice
+        fetch_s += time.perf_counter() - t
         a, b = pos, pos + qh.shape[0]
         sc = scales[a:b]
+        t = time.perf_counter()
         upper[a:b] = qh.astype(np.float32) * (sc[:, None, None] / 127.0)
         if ok:
             ok = native.assemble_q8_partial(
                 qh, sc, r[a:b], c[a:b], col_scale, out_map, out)
+        assemble_s += time.perf_counter() - t
         pos = b
-    return upper, (out if ok else None)
+    timing = {"fetch_s": fetch_s, "assemble_s": assemble_s}
+    return upper, (out if ok else None), timing
 
 
 def _diagnose(trace_arr: np.ndarray, done: int, run: RunConfig) -> dict:
@@ -502,26 +519,34 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
     sched = schedule_array(run)
     profile_ctx = (jax.profiler.trace(cfg.backend.profile_dir)
                    if cfg.backend.profile_dir else contextlib.nullcontext())
+    phase = {"upload_s": 0.0, "chain_s": 0.0, "fetch_s": 0.0,
+             "assemble_s": 0.0}
     t0 = time.perf_counter()
     with profile_ctx:
         if use_mesh:
             mesh = make_mesh(n_mesh, devices)
             shards_per_device(m.num_shards, mesh)  # validates divisibility
+            t_up = time.perf_counter()
             Y_up = _upload_host_array(pre.data, cfg.backend.upload_dtype)
             Yd = (place_sharded_global(Y_up, mesh) if multiproc
                   else place_sharded(Y_up, mesh))
             if Yd.dtype != jnp.float32:
                 Yd = _cast_f32_jit()(Yd)  # jit preserves the sharding
+            jax.block_until_ready(Yd)
+            phase["upload_s"] = time.perf_counter() - t_up
             carry, stats, executed, traces, chunk_secs, done = _run_chain(
                 _mesh_fns(mesh, m, chunk, C, S_draws)[0],
                 lambda ni: _mesh_fns(mesh, m, ni, C, S_draws)[1], Yd)
         else:
             with jax.default_device(devices[0]):
+                t_up = time.perf_counter()
                 Yd = jax.device_put(
                     jnp.asarray(_upload_host_array(
                         pre.data, cfg.backend.upload_dtype)), devices[0])
                 if Yd.dtype != jnp.float32:
                     Yd = _cast_f32_jit()(Yd)
+                jax.block_until_ready(Yd)
+                phase["upload_s"] = time.perf_counter() - t_up
                 # Commit the initial carry to the device explicitly: jit
                 # outputs are otherwise "uncommitted", so the second chunk
                 # call (whose carry IS committed, having flowed through a
@@ -601,13 +626,21 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
     if fetch_mode == "quant8":
         q_dev, scale_dev = _fetch_jit(m.num_shards, C, "quant8", fetch_mesh)(
             carry.sigma_acc, inv_count)
-        upper, Sigma = _quant8_fetch_assemble(
+        upper, Sigma, f_timing = _quant8_fetch_assemble(
             q_dev, scale_dev, m.num_shards, pre)
+        phase["fetch_s"] += f_timing["fetch_s"]
+        phase["assemble_s"] += f_timing["assemble_s"]
         if Sigma is None:
+            t_as = time.perf_counter()
             Sigma = assemble_from_upper(upper, pre, reinsert_zero_cols=True)
+            phase["assemble_s"] += time.perf_counter() - t_as
     else:
+        t_f = time.perf_counter()
         upper = _fetch_upper(carry.sigma_acc)
+        phase["fetch_s"] += time.perf_counter() - t_f
+        t_as = time.perf_counter()
         Sigma = assemble_from_upper(upper, pre, reinsert_zero_cols=True)
+        phase["assemble_s"] += time.perf_counter() - t_as
     # final state for FitResult: small next to the accumulator; replicated
     # first on multi-process runs (sharded leaves are not host-fetchable)
     state = jax.device_get(_replicate_jit(mesh)(carry.state)
@@ -626,7 +659,9 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
         # scales an SD exactly like a covariance entry (linear in the
         # scale product), so the same restore path applies.
         n_draws = max(n_saved * C, 1)
+        t_f = time.perf_counter()
         upper_sq = _fetch_upper(carry.sigma_sq_acc)
+        phase["fetch_s"] += time.perf_counter() - t_f
         var_u = np.maximum(upper_sq - upper * upper, 0.0)
         if n_draws > 1:
             var_u *= n_draws / (n_draws - 1)
@@ -634,6 +669,7 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
         Sigma_sd = assemble_from_upper(sd_upper, pre,
                                        reinsert_zero_cols=True)
     seconds = time.perf_counter() - t0
+    phase["chain_s"] = float(sum(chunk_secs))
 
     return FitResult(
         Sigma=Sigma,
@@ -649,6 +685,7 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
         traces=trace_arr,
         diagnostics=diagnostics,
         chunk_seconds=chunk_secs,
+        phase_seconds=phase,
         Sigma_sd=Sigma_sd,
         sd_upper_panels=sd_upper,
         draws=draws,

@@ -217,12 +217,15 @@ def make_dl(cfg: ModelConfig) -> Prior:
         return {"psi": psi, "phi": phi, "tau": tau}
 
     def update(key: jax.Array, state, Lam: jax.Array, active=None):
-        # Under rank adaptation, deactivated columns' |loadings| sit at the
-        # _DL_EPS floor below, so their shrinkage contributions are already
-        # negligible; the row-wise GIG shapes keep the static K (the DL
-        # prior is row-exchangeable in h, so this only perturbs tau_j's
-        # order parameter, not the active columns' conditionals).
-        del active
+        # Under rank adaptation the truncated model's row vector is the
+        # ACTIVE coordinates only, so (mirroring MGP/horseshoe) the mask
+        # enters every conditional: tau_j's GIG order counts active
+        # columns, its rate and phi's normalization sum over active
+        # coordinates only, and deactivated coordinates' psi/phi redraw
+        # from the prior (they carry no loading observation).  Inactive
+        # phi being prior draws (not ~0) keeps the Dirichlet well-defined
+        # on re-activation; the pin-to-zero of inactive loadings is
+        # enforced by the Lambda-update mask, not by the prior state.
         P, K = Lam.shape
         k_psi, k_tau, k_phi = jax.random.split(key, 3)
         absL = jnp.maximum(jnp.abs(Lam), _DL_EPS)
@@ -230,13 +233,38 @@ def make_dl(cfg: ModelConfig) -> Prior:
         tau = state["tau"]
 
         mu = phi * tau[:, None] / absL
-        psi = 1.0 / inverse_gaussian(k_psi, mu, 1.0)
+        psi_cond = 1.0 / inverse_gaussian(k_psi, mu, 1.0)
 
-        tau = gig(k_tau, K * (a - 1.0), 1.0,
-                  2.0 * jnp.sum(absL / phi, axis=-1))
+        if active is None:
+            psi = psi_cond
+            tau = gig(k_tau, K * (a - 1.0), 1.0,
+                      2.0 * jnp.sum(absL / phi, axis=-1))
+            T = gig(k_phi, a - 1.0, 1.0, 2.0 * absL)
+            phi = T / jnp.sum(T, axis=-1, keepdims=True)
+            return {"psi": psi, "phi": phi, "tau": tau}
+
+        act = active.astype(Lam.dtype)[None, :]                # (1, K)
+        n_act = jnp.sum(active)
+        # prior draw for deactivated coordinates: Exp(1/2) <=> 2*Exp(1)
+        psi_prior = 2.0 * jax.random.exponential(
+            jax.random.fold_in(k_psi, 1), (P, K), Lam.dtype)
+        psi = jnp.where(act > 0, psi_cond, psi_prior)
+
+        tau = gig(k_tau, n_act * (a - 1.0), 1.0,
+                  2.0 * jnp.sum(act * absL / phi, axis=-1))
 
         T = gig(k_phi, a - 1.0, 1.0, 2.0 * absL)
-        phi = T / jnp.sum(T, axis=-1, keepdims=True)
+        d_prior = gamma_rate(jax.random.fold_in(k_phi, 1), a, 1.0,
+                             sample_shape=(P, K))
+        T = jnp.where(act > 0, act * T, d_prior)
+        # active coordinates normalize over the active sum (the truncated
+        # Dirichlet); inactive ones over the inactive sum (a prior draw)
+        sum_act = jnp.sum(act * T, axis=-1, keepdims=True)
+        sum_inact = jnp.sum((1.0 - act) * T, axis=-1, keepdims=True)
+        phi = jnp.where(
+            act > 0,
+            T / jnp.maximum(sum_act, _DL_EPS),
+            T / jnp.maximum(sum_inact, _DL_EPS))
         return {"psi": psi, "phi": phi, "tau": tau}
 
     def row_precision(state):

@@ -106,6 +106,32 @@ def test_rank_adapt_shrinks_to_true_rank():
         assert np.all(Lam[m][:, act[m] == 0] == 0)
 
 
+def test_rank_adapt_dl_recovers_true_rank():
+    """DL prior + rank adaptation: the mask is threaded through every DL
+    conditional (tau's GIG order counts active columns, phi renormalizes
+    over them - models/priors.py make_dl), so the truncated model is
+    targeted exactly, mirroring MGP/horseshoe.  K = 2x true rank must
+    shrink toward truth with accuracy maintained."""
+    k_true = 2
+    Y, St = make_synthetic(200, 48, k_true, seed=41)
+    cfg = FitConfig(
+        model=ModelConfig(num_shards=2, factors_per_shard=2 * k_true, rho=0.9,
+                          prior="dl", rank_adapt=True,
+                          adapt=AdaptConfig(a0=-0.5, a1=-2e-3, eps=0.1,
+                                            prop=0.9)),
+        run=RunConfig(burnin=400, mcmc=200, thin=1, seed=0))
+    res = fit(Y, cfg)
+    assert res.stats.nonfinite_count == 0
+    assert res.stats.rank_max <= 2 * k_true
+    assert res.stats.rank_mean <= k_true + 1.0
+    assert res.stats.rank_min >= 1
+    assert _rel_frob(res.Sigma, St) < 0.35
+    act = np.asarray(res.state.active)
+    Lam = np.asarray(res.state.Lambda)
+    for m in range(act.shape[0]):
+        assert np.all(Lam[m][:, act[m] == 0] == 0)
+
+
 def test_rank_adapt_mesh_matches_vmap():
     """Adaptation is per-shard-local; the mesh layout must reproduce the
     single-device chain bitwise, mask included."""
