@@ -93,11 +93,13 @@ class FitResult:
     # dense grid is derived lazily via .sigma_sd_blocks.
     sd_upper_panels: Optional[np.ndarray] = None
     # Thinned posterior draws (RunConfig.store_draws): {"Lambda": (S, g, P,
-    # K), "ps": (S, g, P), "X": (S, n, K)} in shard coordinates (permuted /
-    # standardized; use .preprocess to map back), with a leading chain axis
-    # when num_chains > 1.  eta/Z draws are not stored (see
-    # models.sampler.DrawBuffers), so draw-level covariance reconstruction
-    # uses the plain rule.
+    # K), "ps": (S, g, P), "X": (S, n, K), "H": (S, g, g, K, K)} in shard
+    # coordinates (permuted / standardized; use .preprocess to map back),
+    # with a leading chain axis when num_chains > 1.  "H" holds the
+    # per-draw factor cross-moments eta_r'eta_c/n under the default
+    # estimator="scaled" (absent for "plain"), so draw-level covariance
+    # reconstruction uses the same rule as the accumulated mean - see
+    # covariance_credible_interval.
     draws: Optional[dict] = None
 
     @functools.cached_property
@@ -119,6 +121,46 @@ class FitResult:
             self.upper_panels, self.preprocess,
             destandardize=destandardize,
             reinsert_zero_cols=reinsert_zero_cols)
+
+    def covariance_credible_interval(self, rows, cols, *, alpha=0.05,
+                                     destandardize=True):
+        """Entrywise equal-tailed (1-alpha) posterior credible intervals
+        for covariance entries, from the stored draws
+        (``RunConfig(store_draws=True)``).
+
+        ``rows``/``cols`` are caller-coordinate column indices (the same
+        coordinates as ``.Sigma``).  Under the default
+        ``estimator="scaled"`` each draw's entry is the exact scaled-rule
+        value Lam_i' (eta_r'eta_c/n) Lam_j via the stored cross-moments
+        ``draws["H"]``; with ``estimator="plain"`` the reference rule
+        applies.  Chains are pooled.  Entries involving dropped all-zero
+        input columns return (0, 0) - their covariance is identically
+        zero.  Returns ``(lower, upper)`` arrays shaped like ``rows``.
+        """
+        if self.draws is None:
+            raise ValueError("run with RunConfig(store_draws=True)")
+        from dcfm_tpu.utils.estimate import draw_covariance_entries
+        from dcfm_tpu.utils.preprocess import caller_to_shard_index
+        rows = np.asarray(rows, np.int64)
+        cols = np.asarray(cols, np.int64)
+        rows, cols = np.broadcast_arrays(rows, cols)
+        shape = rows.shape
+        rows, cols = rows.reshape(-1), cols.reshape(-1)
+        sr = caller_to_shard_index(self.preprocess, rows)
+        sc = caller_to_shard_index(self.preprocess, cols)
+        valid = (sr >= 0) & (sc >= 0)
+        lo = np.zeros(rows.shape, np.float64)
+        hi = np.zeros(rows.shape, np.float64)
+        if valid.any():
+            vals = draw_covariance_entries(
+                self.draws, sr[valid], sc[valid],
+                rho=self.config.model.rho)
+            if destandardize:
+                s = np.asarray(self.preprocess.col_scale).reshape(-1)
+                vals = vals * (s[sr[valid]] * s[sc[valid]])[None, :]
+            q = np.quantile(vals, [alpha / 2, 1.0 - alpha / 2], axis=0)
+            lo[valid], hi[valid] = q[0], q[1]
+        return lo.reshape(shape), hi.reshape(shape)
 
     def posterior_sd(self, *, destandardize=True, reinsert_zero_cols=False):
         """Entrywise posterior SD with the same coordinate options as
@@ -651,6 +693,8 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
                            if multiproc else carry.draws)
         draws = {"Lambda": np.asarray(d.Lambda), "ps": np.asarray(d.ps),
                  "X": np.asarray(d.X)}
+        if d.H is not None:
+            draws["H"] = np.asarray(d.H)
 
     Sigma_sd = sd_upper = None
     if carry.sigma_sq_acc is not None:

@@ -130,6 +130,18 @@ class ModelConfig:
     # Adaptive rank truncation (see AdaptConfig).  Off by default: the
     # reference model has a fixed per-shard factor budget.
     rank_adapt: bool = False
+    # Split the per-saved-draw combine into this many column-chunks, with a
+    # cross-shard rendezvous (a tiny psum) between consecutive chunks.  The
+    # combine einsum is the one long collective-free stretch of the chain
+    # (O(p^2 K / devices) per saved draw); on meshes whose device threads
+    # timeshare cores (the 8-virtual-device CPU mesh used for pod-scale
+    # validation) the slowest thread can otherwise reach the next
+    # collective minutes after the first and trip XLA's rendezvous
+    # termination timeout.  Chunking bounds that gap to one chunk's
+    # compute.  1 = single-shot combine (default; right for real TPU
+    # meshes, where devices run truly concurrently).  Must divide
+    # num_shards.
+    combine_chunks: int = 1
     mgp: MGPConfig = MGPConfig()
     horseshoe: HorseshoeConfig = HorseshoeConfig()
     dl: DLConfig = DLConfig()
@@ -279,6 +291,10 @@ def validate(cfg: FitConfig, n: int, p: int) -> None:
             f"lambda_kernel='pallas' supports factors_per_shard <= 16 "
             f"(statically-unrolled recurrence), got {m.factors_per_shard}; "
             "use lambda_kernel='auto' (lax.linalg handles large K)")
+    if m.combine_chunks < 1 or m.num_shards % m.combine_chunks != 0:
+        raise ValueError(
+            f"combine_chunks={m.combine_chunks} must be >= 1 and divide "
+            f"num_shards={m.num_shards}")
     if m.combine_dtype not in ("float32", "bfloat16"):
         raise ValueError(
             f"unknown combine_dtype {m.combine_dtype!r} "

@@ -158,23 +158,26 @@ def gibbs_sweep(
     with jax.named_scope("lambda_update"):
         kl = _shard_keys(jax.random.fold_in(key, _SITE_LAM), shard_offset, Gl)
         if cfg.lambda_kernel.startswith("pallas"):
-            # Flatten shards x rows into ONE kernel batch: under vmap the
-            # pallas batching rule would instead pad each shard's P rows to
-            # the lane tile separately (~3x wasted lanes at P=157).  The
-            # noise is still drawn per shard from the per-shard key -
-            # identical draws to the unrolled path (results then agree to
-            # float reassociation, not bitwise).
-            from dcfm_tpu.ops.pallas_gaussian import chol_sample_batched_pallas
-            Q, B = jax.vmap(lam_terms)(Y, eta_lam, state.ps, plam)
+            # FUSED path (ops/pallas_gaussian.lam_update_pallas): only the
+            # two MXU einsums (eta'eta, eta'Y) run outside the kernel; the
+            # per-row precision Q_j = diag(plam_j) + ps_j E and the whole
+            # factor-solve-sample chain live inside it, so the (Gl, P, K,
+            # K) Q tensor never exists in HBM.  The noise is still drawn
+            # per shard from the per-shard key - identical draws to the
+            # unrolled path (results then agree to float reassociation,
+            # not bitwise).
+            from dcfm_tpu.ops.pallas_gaussian import lam_update_pallas
+            E = jnp.einsum("gnk,gnj->gkj", eta_lam, eta_lam)     # (Gl,K,K)
+            EYt = jnp.einsum("gnp,gnk->gpk", Y, eta_lam)         # (Gl,P,K)
             Zn = jax.vmap(
-                lambda k, b: jax.random.normal(k, b.shape, b.dtype))(kl, B)
+                lambda k, s: jax.random.normal(k, s.shape, s.dtype))(
+                    kl, state.Lambda)
             # "pallas-interpret" is the api-internal name fit() substitutes
             # when the resolved execution platform is not TPU; bare "pallas"
             # leaves interpret=None (the wrapper auto-detects)
             interp = True if cfg.lambda_kernel == "pallas-interpret" else None
-            Lam = chol_sample_batched_pallas(
-                Q.reshape(Gl * P, K, K), B.reshape(Gl * P, K),
-                Zn.reshape(Gl * P, K), interpret=interp).reshape(Gl, P, K)
+            Lam = lam_update_pallas(E, plam, state.ps, EYt, Zn,
+                                    interpret=interp)
         else:
             Lam = jax.vmap(lam_update)(kl, Y, eta_lam, state.ps, plam)
         if state.active is not None:
@@ -214,6 +217,7 @@ def covariance_blocks(
     eta_local: Optional[jax.Array] = None,
     eta_all: Optional[jax.Array] = None,
     compute_dtype=None,
+    col_offset: int = 0,
 ) -> jax.Array:
     """Per-draw covariance blocks for the combine step ("conquer").
 
@@ -251,14 +255,22 @@ def covariance_blocks(
       compute_dtype: input dtype for the block matmuls (None = keep float32;
         jnp.bfloat16 feeds the MXU at native rate).  Accumulation and output
         stay in the state dtype via preferred_element_type.
+      col_offset: global shard index of ``Lam_all``'s first entry - pass it
+        when ``Lam_all``/``eta_all`` are a column SLICE of the gathered
+        loadings (ModelConfig.combine_chunks splits the combine this way to
+        bound the collective-free stretch per saved draw); the diagonal
+        blocks are identified by global row == col_offset + column.
 
-    Returns: (Gl, G, P, P) row-panel of Sigma blocks.
+    Returns: (Gl, G, P, P) row-panel of Sigma blocks (G = the column-slice
+    width when chunked).
     """
     Gl, P, K = Lam_local.shape
     G = Lam_all.shape[0]
     out_dtype = Lam_local.dtype
     r_idx = local_shard_start + jnp.arange(Gl)                  # global rows
-    onehot = jax.nn.one_hot(r_idx, G, dtype=out_dtype)          # (Gl, G)
+    # one_hot yields an all-zero row when the global diagonal column falls
+    # outside this column slice - exactly "no diagonal block in this chunk"
+    onehot = jax.nn.one_hot(r_idx - col_offset, G, dtype=out_dtype)
     if compute_dtype is not None:
         Lam_local_c = Lam_local.astype(compute_dtype)
         Lam_all_c = Lam_all.astype(compute_dtype)

@@ -39,14 +39,20 @@ class DrawBuffers(NamedTuple):
     quantities that define it, enabling arbitrary posterior functionals
     (credible intervals for covariance entries, loading structure, ...).
     eta/Z draws are deliberately NOT stored - (S, Gl, n, K) is the one
-    buffer that would not fit at scale - so draw-level covariance
-    reconstruction uses the plain rule (Lambda, ps, rho); the "scaled"
-    estimator's empirical factor moments exist only in the accumulated
-    mean.
+    buffer that would not fit at scale.  Instead, under the default
+    "scaled" estimator, the per-draw factor CROSS-MOMENTS
+    H_rc = eta_r' eta_c / n are stored (``H``, kilobytes per draw): they
+    are exactly what the scaled combine rule consumes, so per-draw
+    covariance reconstruction Sigma_rc = Lam_r H_rc Lam_c' is exact at
+    draw level (utils/estimate.draw_covariance_entries).
     """
     Lambda: jax.Array        # (S, Gl, P, K)
     ps: jax.Array            # (S, Gl, P)
     X: jax.Array             # (S, n, K) - replicated, like state.X
+    # (S, Gl, G, K, K) per-draw factor cross-moment row-panels (sharded
+    # like sigma_acc), or None when estimator="plain" (the plain rule
+    # needs no factor moments).
+    H: Optional[jax.Array] = None
 
 
 class ChainCarry(NamedTuple):
@@ -238,7 +244,9 @@ def init_chain(
         draws = DrawBuffers(
             Lambda=jnp.zeros((num_stored_draws, Gl, P, K), dtype),
             ps=jnp.zeros((num_stored_draws, Gl, P), dtype),
-            X=jnp.zeros((num_stored_draws, n, K), dtype))
+            X=jnp.zeros((num_stored_draws, n, K), dtype),
+            H=(jnp.zeros((num_stored_draws, Gl, num_global_shards, K, K),
+                         dtype) if cfg.estimator == "scaled" else None))
     return ChainCarry(state=state, sigma_acc=sigma_acc,
                       iteration=jnp.zeros((), jnp.int32),
                       health=_health_init(Gl, dtype),
@@ -298,25 +306,66 @@ def run_chunk(
                 eta_all = gather_fn(eta)
             else:
                 eta = eta_all = None
-            blocks = covariance_blocks(
-                state.Lambda, state.ps, Lam_all, cfg.rho, shard_offset,
-                eta_local=eta, eta_all=eta_all,
-                compute_dtype=(jnp.bfloat16
-                               if cfg.combine_dtype == "bfloat16" else None))
-            acc = acc + blocks
-            if acc_sq is not None:
-                acc_sq = acc_sq + blocks * blocks
+            c_dtype = (jnp.bfloat16
+                       if cfg.combine_dtype == "bfloat16" else None)
+            if cfg.combine_chunks <= 1:
+                blocks = covariance_blocks(
+                    state.Lambda, state.ps, Lam_all, cfg.rho, shard_offset,
+                    eta_local=eta, eta_all=eta_all, compute_dtype=c_dtype)
+                acc = acc + blocks
+                if acc_sq is not None:
+                    acc_sq = acc_sq + blocks * blocks
+            else:
+                # Column-chunked combine (ModelConfig.combine_chunks): the
+                # einsum over all G columns is the longest collective-free
+                # stretch of the chain; on timeshared virtual meshes the
+                # slowest device thread can reach the next collective
+                # minutes after the first, tripping XLA's rendezvous
+                # termination.  A tiny psum (via reduce_fn) after each
+                # chunk, tied into the next chunk's inputs with
+                # optimization_barrier, forces all devices to rendezvous
+                # every chunk - bounding the gap to one chunk's compute.
+                # The barrier token's value is never added to any data.
+                G_all = acc.shape[1]
+                Gc = G_all // cfg.combine_chunks
+                token = jnp.zeros((), acc.dtype)
+                for i in range(cfg.combine_chunks):
+                    c0 = i * Gc
+                    Lam_s = Lam_all[c0:c0 + Gc]
+                    eta_s = None if eta_all is None else eta_all[c0:c0 + Gc]
+                    if i:
+                        Lam_s, token = lax.optimization_barrier(
+                            (Lam_s, token))
+                    blocks = covariance_blocks(
+                        state.Lambda, state.ps, Lam_s, cfg.rho,
+                        shard_offset, eta_local=eta, eta_all=eta_s,
+                        compute_dtype=c_dtype, col_offset=c0)
+                    acc = acc.at[:, c0:c0 + Gc].add(blocks)
+                    if acc_sq is not None:
+                        acc_sq = acc_sq.at[:, c0:c0 + Gc].add(blocks * blocks)
+                    token = reduce_fn(blocks[:, 0, 0, 0])
+                # the final token must survive into the graph or XLA would
+                # DCE every psum above; tie it to the accumulator output
+                acc, token = lax.optimization_barrier((acc, token))
             if draws is not None:
                 # 0-based index of this saved draw; clamped by
                 # dynamic_update_slice if a resumed schedule ever overran
                 idx = (it - burnin) // thin - 1
+                H_bufs = draws.H
+                if H_bufs is not None:
+                    n_obs = eta.shape[1]
+                    H_draw = jnp.einsum("rnk,cnj->rckj", eta,
+                                        eta_all) / n_obs   # (Gl, G, K, K)
+                    H_bufs = lax.dynamic_update_slice_in_dim(
+                        H_bufs, H_draw[None], idx, axis=0)
                 draws = DrawBuffers(
                     Lambda=lax.dynamic_update_slice_in_dim(
                         draws.Lambda, state.Lambda[None], idx, axis=0),
                     ps=lax.dynamic_update_slice_in_dim(
                         draws.ps, state.ps[None], idx, axis=0),
                     X=lax.dynamic_update_slice_in_dim(
-                        draws.X, state.X[None], idx, axis=0))
+                        draws.X, state.X[None], idx, axis=0),
+                    H=H_bufs)
             return acc, acc_sq, draws
 
         save = jnp.logical_and(it > burnin, (it - burnin) % thin == 0)

@@ -116,6 +116,132 @@ def chol_sample_batched_pallas(
     return _chol_sample_jit(Q, B, Zn, interpret=bool(interpret))
 
 
+def _lam_rows_kernel(e_ref, plam_ref, ps_ref, ey_ref, z_ref, out_ref,
+                     *, K: int):
+    """One (shard, row-tile) block of the FUSED Lambda update: forms each
+    row's precision Q_j = diag(plam_j) + ps_j * E on the fly from the
+    shard's shared (K, K) cross-moment E (SMEM scalars) and the per-row
+    plam/ps lanes, then runs the same factor-solve-sample recurrence as
+    _chol_sample_kernel.  The (rows, K, K) Q tensor - 2.6 MB per sweep at
+    the bench shape - never exists in HBM.
+
+    b_j = ps_j * (eta'Y)_j is also formed in-kernel from ey lanes.
+    """
+    ps = ps_ref[0, :1, :]                                # (1, TILE)
+
+    # ---- Cholesky with on-the-fly Q columns ---------------------------
+    cols = []               # cols[j]: (K - j, TILE)
+    for j in range(K):
+        rows = [ps * e_ref[0, i, j] for i in range(j, K)]
+        rows[0] = rows[0] + plam_ref[0, j:j + 1, :]      # diagonal term
+        s = jnp.concatenate(rows, axis=0) if len(rows) > 1 else rows[0]
+        for t in range(j):
+            s = s - cols[t][j - t:, :] * cols[t][j - t:j - t + 1, :]
+        d = jnp.sqrt(s[:1, :])
+        if K - j > 1:
+            cols.append(jnp.concatenate([d, s[1:, :] / d], axis=0))
+        else:
+            cols.append(d)
+
+    # ---- forward solve L v = b,  b_j = ps * ey_j ----------------------
+    v = []
+    for j in range(K):
+        acc = ps * ey_ref[0, j:j + 1, :]
+        for t in range(j):
+            acc = acc - cols[t][j - t:j - t + 1, :] * v[t]
+        v.append(acc / cols[j][:1, :])
+
+    # ---- two backward solves L' m = v and L' y = z, fused -------------
+    m = [None] * K
+    y = [None] * K
+    for j in reversed(range(K)):
+        acc_m = v[j]
+        acc_y = z_ref[0, j:j + 1, :]
+        for i in range(j + 1, K):
+            lij = cols[j][i - j:i - j + 1, :]
+            acc_m = acc_m - lij * m[i]
+            acc_y = acc_y - lij * y[i]
+        inv = 1.0 / cols[j][:1, :]
+        m[j] = acc_m * inv
+        y[j] = acc_y * inv
+
+    for j in range(K):
+        out_ref[0, j:j + 1, :] = m[j] + y[j]
+
+
+def lam_update_pallas(
+    E: jax.Array,
+    plam: jax.Array,
+    ps: jax.Array,
+    EYt: jax.Array,
+    Zn: jax.Array,
+    *,
+    interpret: "bool | None" = None,
+    tile: int = 256,
+) -> jax.Array:
+    """Fused Lambda-row sampler covering the WHOLE update (SURVEY C10):
+    Q/b formation + factor + solves + sample in one kernel.
+
+    Args:
+      E: (G, K, K) per-shard factor cross-moments eta_m' eta_m.
+      plam: (G, P, K) prior row precisions.
+      ps: (G, P) residual precisions.
+      EYt: (G, P, K) per-row data terms (eta_m' Y_m)' - WITHOUT the ps
+        factor (applied in-kernel).
+      Zn: (G, P, K) standard-normal draws.
+      interpret: None = auto (compiled on TPU, interpreter elsewhere).
+      tile: lane-tile width over rows (multiple of 128).
+
+    Returns: (G, P, K) sampled loading rows.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _lam_update_jit(E, plam, ps, EYt, Zn, bool(interpret), int(tile))
+
+
+@functools.partial(jax.jit, static_argnames=("interpret", "tile"))
+def _lam_update_jit(E, plam, ps, EYt, Zn, interpret, tile):
+    G, P, K = plam.shape
+    if K > _MAX_K:
+        raise ValueError(f"K={K} exceeds the unrolled kernel bound {_MAX_K}")
+    dtype = plam.dtype
+    n_tiles = max((P + tile - 1) // tile, 1)
+    Pp = n_tiles * tile
+    if Pp != P:
+        # pad rows with plam=1, ps=0, ey=z=0: Q = I, b = 0 -> sample 0
+        pad = Pp - P
+        plam = jnp.concatenate([plam, jnp.ones((G, pad, K), dtype)], axis=1)
+        ps = jnp.concatenate([ps, jnp.zeros((G, pad), dtype)], axis=1)
+        EYt = jnp.concatenate([EYt, jnp.zeros((G, pad, K), dtype)], axis=1)
+        Zn = jnp.concatenate([Zn, jnp.zeros((G, pad, K), dtype)], axis=1)
+
+    plam_t = jnp.transpose(plam, (0, 2, 1))              # (G, K, Pp)
+    ey_t = jnp.transpose(EYt, (0, 2, 1))
+    z_t = jnp.transpose(Zn, (0, 2, 1))
+    ps_t = ps[:, None, :]                                # (G, 1, Pp)
+    out = pl.pallas_call(
+        functools.partial(_lam_rows_kernel, K=K),
+        grid=(G, n_tiles),
+        in_specs=[
+            pl.BlockSpec((1, K, K), lambda g, t: (g, 0, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, K, tile), lambda g, t: (g, 0, t),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, tile), lambda g, t: (g, 0, t),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, K, tile), lambda g, t: (g, 0, t),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, K, tile), lambda g, t: (g, 0, t),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, K, tile), lambda g, t: (g, 0, t),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((G, K, Pp), dtype),
+        interpret=interpret,
+    )(E, plam_t, ps_t, ey_t, z_t)
+    return jnp.transpose(out[:, :, :P], (0, 2, 1))       # (G, P, K)
+
+
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def _chol_sample_jit(Q, B, Zn, interpret):
     P, K = B.shape

@@ -107,7 +107,9 @@ def build_mesh_chain(
         state_spec = SamplerState(Lambda=sh_c, Z=sh_c, X=rep, ps=sh_c,
                                   prior=jax.tree.map(lambda _: sh_c, prior_leaf_tree),
                                   active=sh_c if cfg.rank_adapt else None)
-        draws_spec = (DrawBuffers(Lambda=sh_d, ps=sh_d, X=rep)
+        draws_spec = (DrawBuffers(Lambda=sh_d, ps=sh_d, X=rep,
+                                  H=(sh_d if cfg.estimator == "scaled"
+                                     else None))
                       if num_stored_draws else None)
         return ChainCarry(state=state_spec, sigma_acc=sh_c, iteration=rep,
                           health=sh_c,

@@ -43,7 +43,11 @@ from dcfm_tpu.config import (
 # 1/num_saved-weighted running means (enables chain extension on resume);
 # resuming a v2 checkpoint would silently mis-scale the estimate, so the
 # version gate refuses it.
-_FORMAT_VERSION = 3
+# v4: DrawBuffers gained the per-draw factor cross-moment leaf H (scaled
+# estimator + store_draws), changing the carry leaf count; v3 checkpoints
+# with draws would otherwise die on a missing-leaf KeyError instead of
+# the friendly version refusal.
+_FORMAT_VERSION = 4
 
 
 def data_fingerprint(data: np.ndarray) -> str:

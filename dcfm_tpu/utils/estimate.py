@@ -9,6 +9,8 @@ host ever holds the full p x p matrix.
 
 from __future__ import annotations
 
+from typing import Optional
+
 import numpy as np
 
 from dcfm_tpu import native
@@ -128,6 +130,60 @@ def assemble_from_upper(
         stitch_blocks(full_blocks_from_upper(upper, g), symmetrize=False),
         pre, destandardize=destandardize,
         reinsert_zero_cols=reinsert_zero_cols)
+
+
+def _pool_chain_axis(draws: dict) -> dict:
+    """(C, S, ...) chain-major draw buffers -> (C*S, ...) pooled draws.
+    Chains are independent equal-weight posterior samples, so pooling is
+    the right draw set for entrywise functionals."""
+    Lam = np.asarray(draws["Lambda"])
+    if Lam.ndim == 4:
+        return draws
+    return {k: np.asarray(v).reshape((-1,) + np.asarray(v).shape[2:])
+            for k, v in draws.items()}
+
+
+def draw_covariance_entries(
+    draws: dict,
+    rows: np.ndarray,
+    cols: np.ndarray,
+    *,
+    rho: Optional[float] = None,
+) -> np.ndarray:
+    """Per-draw posterior covariance entries, (S, m), in SHARD coordinates.
+
+    ``draws`` is FitResult.draws (a leading chain axis is pooled).  When the
+    per-draw factor cross-moments ``H`` are present (estimator="scaled",
+    models/sampler.DrawBuffers), each draw's entry is the exact scaled-rule
+    value Sigma_ij = Lam_i' H_rc Lam_j (+ 1/ps_i when i == j) - the same
+    rule the accumulated posterior mean uses, so the draw mean reproduces
+    the accumulator exactly.  Without ``H`` the plain reference rule
+    applies and ``rho`` is required (``divideconquer.m:186,:189``).
+    """
+    draws = _pool_chain_axis(draws)
+    Lam, ps = draws["Lambda"], draws["ps"]          # (S, g, P, K), (S, g, P)
+    S, g, P, K = Lam.shape
+    rows = np.asarray(rows, np.int64)
+    cols = np.asarray(cols, np.int64)
+    r_s, r_l = np.divmod(rows, P)
+    c_s, c_l = np.divmod(cols, P)
+    lam_r = Lam[:, r_s, r_l, :]                     # (S, m, K)
+    lam_c = Lam[:, c_s, c_l, :]
+    H = draws.get("H")
+    if H is not None:
+        Hrc = H[:, r_s, c_s]                        # (S, m, K, K)
+        vals = np.einsum("smk,smkj,smj->sm", lam_r, Hrc, lam_c)
+    else:
+        if rho is None:
+            raise ValueError(
+                "draws carry no factor cross-moments H (estimator='plain'); "
+                "pass rho for the plain combine rule")
+        scale = np.where(r_s == c_s, 1.0, rho)
+        vals = scale[None, :] * np.einsum("smk,smk->sm", lam_r, lam_c)
+    diag = rows == cols
+    if diag.any():
+        vals[:, diag] += 1.0 / ps[:, r_s[diag], r_l[diag]]
+    return vals
 
 
 def posterior_covariance(

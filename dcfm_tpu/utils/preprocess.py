@@ -135,6 +135,26 @@ def preprocess(
     )
 
 
+def caller_to_shard_index(pre: PreprocessResult, idx) -> np.ndarray:
+    """Caller-coordinate column indices -> shard-coordinate positions.
+
+    Shard position j models caller column ``kept_cols[perm[j]]``, so caller
+    column c (at position q of kept_cols) sits at shard position
+    ``inv_perm[q]``.  Dropped all-zero columns map to -1 (they have no
+    shard coordinate; their covariance entries are identically 0).
+    """
+    idx = np.asarray(idx, np.int64)
+    if idx.size and (idx.min() < 0 or idx.max() >= pre.p_original):
+        raise IndexError(
+            f"column index out of range [0, {pre.p_original})")
+    pos = np.searchsorted(pre.kept_cols, idx)
+    out = np.full(idx.shape, -1, np.int64)
+    ok = pos < pre.kept_cols.size
+    ok &= pre.kept_cols[np.minimum(pos, pre.kept_cols.size - 1)] == idx
+    out[ok] = pre.inv_perm[pos[ok]]
+    return out
+
+
 def restore_covariance(
     Sigma_shard: np.ndarray,
     pre: PreprocessResult,

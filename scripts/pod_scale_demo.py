@@ -63,10 +63,21 @@ import numpy as np  # noqa: E402
 
 
 def run_demo(g=256, n_devices=8, P=196, n=16, K=2, iters=3, seed=0,
-             prior="mgp", rank_adapt=False, verbose=True):
+             prior="mgp", rank_adapt=False, verbose=True,
+             combine_chunks=16, synth=False, thin=0):
+    """``synth=True`` draws Y from a true rank-K shared-factor model and
+    reports the relative Frobenius error of the accumulated posterior mean
+    against the known truth, computed ON DEVICE in column chunks (the p x p
+    truth, like the estimate, never materializes anywhere).
+
+    ``combine_chunks`` (ModelConfig.combine_chunks) is what makes the run
+    deterministic on a timeshared 1-core virtual mesh: it bounds the
+    collective-free stretch of a saved draw to one chunk's compute, far
+    under XLA's rendezvous termination timeout.
+    """
     from dcfm_tpu.config import ModelConfig, RunConfig
     from dcfm_tpu.models.priors import make_prior
-    from dcfm_tpu.models.sampler import schedule_array
+    from dcfm_tpu.models.sampler import num_saved_draws, schedule_array
     from dcfm_tpu.parallel.mesh import make_mesh, shards_per_device
     from dcfm_tpu.parallel.shard import build_mesh_chain, place_sharded
 
@@ -74,14 +85,31 @@ def run_demo(g=256, n_devices=8, P=196, n=16, K=2, iters=3, seed=0,
     # BASELINE config 5 pairs this shape with the horseshoe prior and
     # adaptive rank truncation - both are plain config knobs here.
     cfg = ModelConfig(num_shards=g, factors_per_shard=K, rho=0.9,
-                      prior=prior, rank_adapt=rank_adapt)
-    run = RunConfig(burnin=iters - 1, mcmc=1, thin=1, seed=seed)
+                      prior=prior, rank_adapt=rank_adapt,
+                      combine_chunks=combine_chunks)
+    # Schedule: >= 1 saved draw under any (iters, thin) combination, with
+    # burnin never negative.  synth runs save ~iters/4 worth of draws for
+    # a usable posterior mean; shape-demo runs save exactly one.
+    thin = max(min(thin or 1, iters), 1)
+    mcmc = (max((iters // 4) // thin, 1) * thin) if synth else thin
+    mcmc = min(mcmc, (iters // thin) * thin)
+    run = RunConfig(burnin=iters - mcmc, mcmc=mcmc, thin=thin, seed=seed)
     prior_triple = make_prior(cfg)
 
     mesh = make_mesh(n_devices)
     gl = shards_per_device(g, mesh)
     rng = np.random.default_rng(seed)
-    Y = rng.standard_normal((g, n, P)).astype(np.float32)
+    noise = 0.3
+    if synth:
+        # true model: K shared factors across ALL shards (the rho ~ 1
+        # structure), loadings ~ N(0, 1/K) so Var(y) ~ 1 + noise^2
+        L_true = (rng.standard_normal((g, P, K)) / np.sqrt(K)).astype(
+            np.float32)
+        F = rng.standard_normal((n, K)).astype(np.float32)
+        Y = (np.einsum("nk,gpk->gnp", F, L_true)
+             + noise * rng.standard_normal((g, n, P))).astype(np.float32)
+    else:
+        Y = rng.standard_normal((g, n, P)).astype(np.float32)
 
     panel_gb = gl * g * P * P * 4 / 1e9
     if verbose:
@@ -123,16 +151,47 @@ def run_demo(g=256, n_devices=8, P=196, n=16, K=2, iters=3, seed=0,
     assert tr0 > 0, "empty accumulator - no draw saved"
     it = int(np.asarray(carry.iteration).reshape(-1)[0])
     assert it == iters
+    n_saved = num_saved_draws(it, run.burnin, run.thin)
+
+    rel_err = None
+    if synth:
+        # Rel Frobenius error vs the known truth, on device, sharded, in
+        # column chunks: neither the p x p estimate nor the p x p truth is
+        # ever materialized (each chunk is (g, Gc, P, P) sharded over rows).
+        Lt = jax.device_put(L_true)          # (g, P, K) replicated, ~0.5 MB
+
+        @jax.jit
+        def _err(acc, Lt):
+            Gc = max(g // 16, 1)          # ~16 chunks; last may be ragged
+            num = den = 0.0
+            for c0 in range(0, g, Gc):
+                w = min(Gc, g - c0)
+                true_blk = jnp.einsum("rpk,cqk->rcpq",
+                                      Lt, Lt[c0:c0 + w])
+                eyeP = jnp.eye(P, dtype=acc.dtype)
+                diag = jax.nn.one_hot(jnp.arange(g) - c0, w,
+                                      dtype=acc.dtype)
+                true_blk += (noise * noise) * (
+                    diag[:, :, None, None] * eyeP)
+                d = acc[:, c0:c0 + w] / max(n_saved, 1) - true_blk
+                num += jnp.sum(d * d)
+                den += jnp.sum(true_blk * true_blk)
+            return jnp.sqrt(num / den)
+
+        rel_err = float(_err(blocks, Lt))
 
     if verbose:
         print(f"compile+init {t_init:.1f}s, {iters} Gibbs iterations + "
-              f"1 saved draw {t_run:.1f}s "
-              f"(prior={cfg.prior}, rank_adapt={rank_adapt})")
+              f"{n_saved} saved draw(s) {t_run:.1f}s "
+              f"({t_run / iters:.2f} s/iter incl. combine; "
+              f"prior={cfg.prior}, rank_adapt={rank_adapt}, "
+              f"combine_chunks={combine_chunks})")
         print(f"accumulator shape {tuple(blocks.shape)}, finite, "
-              f"tr(Sigma_00) = {tr0:.1f}")
+              f"tr(Sigma_00) = {tr0:.1f}"
+              + (f", rel_frob_err vs truth = {rel_err:.4f}" if synth else ""))
         print("OK")
     return dict(p=p, g=g, gl=gl, panel_gb=panel_gb, t_init=t_init,
-                t_run=t_run)
+                t_run=t_run, n_saved=n_saved, rel_err=rel_err)
 
 
 import jax.numpy as jnp  # noqa: E402
@@ -140,6 +199,11 @@ import jax.numpy as jnp  # noqa: E402
 
 if __name__ == "__main__":
     run_demo(P=int(os.environ.get("PODDEMO_P", 196)),
+             n=int(os.environ.get("PODDEMO_N", 16)),
+             iters=int(os.environ.get("PODDEMO_ITERS", 3)),
+             thin=int(os.environ.get("PODDEMO_THIN", 0)),
              prior=os.environ.get("PODDEMO_PRIOR", "mgp"),
-             rank_adapt=bool(int(os.environ.get("PODDEMO_ADAPT", "0"))))
+             rank_adapt=bool(int(os.environ.get("PODDEMO_ADAPT", "0"))),
+             combine_chunks=int(os.environ.get("PODDEMO_CCHUNKS", 16)),
+             synth=bool(int(os.environ.get("PODDEMO_SYNTH", "0"))))
     sys.exit(0)

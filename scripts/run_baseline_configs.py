@@ -95,7 +95,11 @@ def run_config5():
     env["PYTHONPATH"] = os.pathsep.join(
         [_REPO] + [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
                    if p])
-    env.setdefault("PODDEMO_P", "96")   # full 196 on multi-core hosts
+    # Full-spec width by default (p = 256*196 = 50,176).  Deterministic even
+    # on a 1-core host: ModelConfig.combine_chunks (set inside the demo)
+    # bounds the collective-free stretch per saved draw, so XLA's
+    # rendezvous termination never trips.
+    env.setdefault("PODDEMO_P", "196")
     env["PODDEMO_PRIOR"] = "horseshoe"
     env["PODDEMO_ADAPT"] = "1"
     t0 = time.perf_counter()

@@ -114,12 +114,16 @@ def test_rank_adapt_dl_recovers_true_rank():
     shrink toward truth with accuracy maintained."""
     k_true = 2
     Y, St = make_synthetic(200, 48, k_true, seed=41)
+    # eps is coarser than the MGP test's 0.1: DL's heavier-tailed draws
+    # keep a redundant column's entries hovering above a tight threshold
+    # longer (measured: eps=0.1 strands one spare column at rank 3-4;
+    # eps=0.2 recovers rank exactly 2 at identical accuracy, err 0.043)
     cfg = FitConfig(
         model=ModelConfig(num_shards=2, factors_per_shard=2 * k_true, rho=0.9,
                           prior="dl", rank_adapt=True,
-                          adapt=AdaptConfig(a0=-0.5, a1=-2e-3, eps=0.1,
+                          adapt=AdaptConfig(a0=-0.5, a1=-1.5e-3, eps=0.2,
                                             prop=0.9)),
-        run=RunConfig(burnin=400, mcmc=200, thin=1, seed=0))
+        run=RunConfig(burnin=600, mcmc=200, thin=1, seed=0))
     res = fit(Y, cfg)
     assert res.stats.nonfinite_count == 0
     assert res.stats.rank_max <= 2 * k_true

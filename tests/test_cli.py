@@ -109,6 +109,53 @@ def test_cli_checkpoint_resume(tmp_path, capsys, data_npy):
     np.testing.assert_array_equal(np.load(out1), np.load(out2))
 
 
+def test_cli_transfer_and_combine_knobs(tmp_path, capsys, data_npy):
+    """The load-bearing perf/accuracy knobs are CLI-reachable: reduced
+    transfer dtypes, bf16 combine, chunked combine, X prior precision."""
+    path, _, _ = data_npy
+    out = str(tmp_path / "s_knobs.npy")
+    rc, meta = _run(capsys, [
+        "fit", path, "-g", "2", "-k", "6", "--burnin", "20", "--mcmc", "20",
+        "--thin", "2", "--fetch-dtype", "quant8",
+        "--upload-dtype", "float16", "--combine-dtype", "bfloat16",
+        "--combine-chunks", "2", "--x-prior-precision", "2.0",
+        "--out", out])
+    assert rc == 0
+    assert np.isfinite(np.load(out)).all()
+    assert set(meta["phase_seconds"]) == {"upload_s", "chain_s", "fetch_s",
+                                          "assemble_s"}
+
+
+def test_cli_no_permute_keeps_feature_order(tmp_path, capsys, data_npy):
+    """--no-permute (the config-3 locality win, a knob the reference lacks)
+    must reach preprocessing: with it, shard coordinates are the caller's
+    column order, so the raw-coords output equals the permuted fit only in
+    caller coordinates, and the fits agree on recovered structure."""
+    path, Y, Sigma_true = data_npy
+    out_np = str(tmp_path / "s_noperm.npy")
+    rc, _ = _run(capsys, [
+        "fit", path, "-g", "2", "-k", "6", "--burnin", "60", "--mcmc", "60",
+        "--thin", "2", "--rho", "0.8", "--no-permute", "--out", out_np])
+    assert rc == 0
+    S = np.load(out_np)
+    err = np.linalg.norm(S - Sigma_true) / np.linalg.norm(Sigma_true)
+    assert err < 0.8
+
+
+def test_cli_profile_dir_writes_trace(tmp_path, capsys, data_npy):
+    import os
+
+    path, _, _ = data_npy
+    prof = str(tmp_path / "prof")
+    out = str(tmp_path / "s_prof.npy")
+    rc, _ = _run(capsys, [
+        "fit", path, "-g", "2", "-k", "4", "--burnin", "5", "--mcmc", "5",
+        "--profile-dir", prof, "--out", out])
+    assert rc == 0
+    files = [os.path.join(r, f) for r, _, fs in os.walk(prof) for f in fs]
+    assert files, "profile dir is empty - jax.profiler trace not written"
+
+
 def test_cli_resume_without_checkpoint_errors(data_npy):
     path, _, _ = data_npy
     with pytest.raises(SystemExit):

@@ -72,6 +72,40 @@ def mvn_mean_precision_batched_ref(Q, B):
     return M[..., 0]
 
 
+@pytest.mark.parametrize("G,P,K", [(3, 157, 8), (2, 40, 3), (1, 300, 16)])
+def test_fused_lam_update_matches_reference(G, P, K):
+    """The fully-fused Lambda kernel (Q formed in-kernel from E/plam/ps)
+    must equal the explicit Q materialization + lax.linalg solve chain on
+    identical noise."""
+    from dcfm_tpu.ops.pallas_gaussian import lam_update_pallas
+
+    rng = np.random.default_rng(5)
+    A = rng.standard_normal((G, K, K)).astype(np.float32)
+    E = jnp.asarray(A @ np.transpose(A, (0, 2, 1))
+                    + 0.5 * np.eye(K, dtype=np.float32))
+    plam = jnp.asarray(
+        rng.gamma(2.0, 1.0, size=(G, P, K)).astype(np.float32) + 0.1)
+    ps = jnp.asarray(rng.gamma(3.0, 0.5, size=(G, P)).astype(np.float32))
+    EYt = jnp.asarray(rng.standard_normal((G, P, K)).astype(np.float32))
+    Zn = jnp.asarray(rng.standard_normal((G, P, K)).astype(np.float32))
+
+    x_fused = lam_update_pallas(E, plam, ps, EYt, Zn)
+
+    # reference: materialize Q/b, factor with lax.linalg, same noise
+    Q = (jax.vmap(jax.vmap(jnp.diag))(plam)
+         + ps[..., None, None] * E[:, None])            # (G, P, K, K)
+    b = ps[..., None] * EYt
+    L = jax.lax.linalg.cholesky(Q)
+    v = jax.lax.linalg.triangular_solve(L, b[..., None], left_side=True,
+                                        lower=True)
+    m = jax.lax.linalg.triangular_solve(L, v, left_side=True, lower=True,
+                                        transpose_a=True)[..., 0]
+    y = jax.lax.linalg.triangular_solve(L, Zn[..., None], left_side=True,
+                                        lower=True, transpose_a=True)[..., 0]
+    np.testing.assert_allclose(np.asarray(x_fused), np.asarray(m + y),
+                               rtol=3e-4, atol=3e-4)
+
+
 def test_unknown_impl_raises():
     rng = np.random.default_rng(0)
     Q = jnp.asarray(_random_spd(rng, 4, 3))

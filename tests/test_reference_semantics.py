@@ -111,21 +111,40 @@ def test_x_prior_precision_reproduces_reference_q3():
 
 # Tiny model; hyperparameters chosen so every monitored moment is finite
 # (as=4 keeps E[1/ps] and Var[1/ps] finite; the statistics below are
-# log-scale or second-moment, all finite under the priors).
+# log-scale or second-moment, all finite under every prior - in particular
+# mean(Lambda^2) / mean(Y^2) are replaced by their log-scale versions for
+# the horseshoe, whose half-Cauchy local scales have no finite mean).
 _G, _N, _P, _K, _RHO = 2, 6, 4, 2, 0.7
 _AS, _BS = 4.0, 2.0
 
 
-def _geweke_cfg():
+def _geweke_cfg(prior_name="mgp"):
     return ModelConfig(num_shards=_G, factors_per_shard=_K, rho=_RHO,
-                       as_=_AS, bs=_BS)
+                       prior=prior_name, as_=_AS, bs=_BS)
+
+
+def _prior_shrinkage_draw(key, prior):
+    """One shard's prior-state pytree drawn from the PRIOR (not the chain
+    init): mgp/dl's ``init`` already draws from the prior; the horseshoe's
+    ``init`` is the deterministic all-ones chain start, so its hierarchy
+    (Makalic-Schmidt: nu, xi ~ iG(1/2, 1); lam2 | nu ~ iG(1/2, 1/nu);
+    tau2 | xi ~ iG(1/2, 1/xi)) is sampled here."""
+    if prior.name == "horseshoe":
+        from dcfm_tpu.ops.gamma import inverse_gamma_rate
+        k1, k2, k3, k4 = jax.random.split(key, 4)
+        nu = inverse_gamma_rate(k1, 0.5, jnp.ones((_P, _K)))
+        lam2 = inverse_gamma_rate(k2, 0.5, 1.0 / nu)
+        xi = inverse_gamma_rate(k3, 0.5, jnp.ones(()))
+        tau2 = inverse_gamma_rate(k4, 0.5, 1.0 / xi)
+        return {"lam2": lam2, "nu": nu, "tau2": tau2, "xi": xi}
+    return prior.init(key, _P, _K)
 
 
 def _prior_state(key, prior):
     """Draw a full SamplerState from the prior (matches state.init_state's
-    distributions, but with Lambda ~ N(0, 1/(psi tau)) instead of zeros -
-    the Geweke test needs the exact prior, not the reference's zero init."""
-    cfg = _geweke_cfg()
+    distributions, but with Lambda ~ N(0, 1/row_precision) instead of
+    zeros - the Geweke test needs the exact prior, not the reference's
+    zero init)."""
     k_x, k_shard = jax.random.split(key)
     X = jax.random.normal(k_x, (_N, _K))
 
@@ -134,7 +153,7 @@ def _prior_state(key, prior):
         k_ps, k_z, k_prior, k_lam = jax.random.split(kg, 4)
         ps = gamma_rate(k_ps, _AS, _BS, sample_shape=(_P,))
         Z = jax.random.normal(k_z, (_N, _K))
-        prior_state = prior.init(k_prior, _P, _K)
+        prior_state = _prior_shrinkage_draw(k_prior, prior)
         plam = prior.row_precision(prior_state)
         Lam = jax.random.normal(k_lam, (_P, _K)) / jnp.sqrt(plam)
         return Lam, Z, ps, prior_state
@@ -153,63 +172,105 @@ def _sample_Y(key, state):
     return mean + noise
 
 
-def _stats(state, Y):
-    """Scalar functionals with finite prior variance, covering every site."""
-    return jnp.stack([
-        jnp.mean(jnp.log(state.ps)),
-        jnp.mean(jnp.log(state.prior["psijh"])),
-        jnp.mean(jnp.log(state.prior["delta"])),
-        jnp.mean(state.Z ** 2),
-        jnp.mean(state.X ** 2),
-        jnp.mean(state.Lambda ** 2),
-        jnp.mean(Y ** 2),
-    ])
+def _stats_fn(prior_name):
+    """Per-prior scalar functionals with finite prior variance, covering
+    every Gibbs site (shared sites + each prior's own hierarchy)."""
+    def shared(state, Y):
+        return [jnp.mean(jnp.log(state.ps)),
+                jnp.mean(state.Z ** 2),
+                jnp.mean(state.X ** 2)]
 
-
-_STAT_NAMES = ("log_ps", "log_psi", "log_delta", "Z2", "X2", "lam2", "Y2")
+    if prior_name == "mgp":
+        def stats(state, Y):
+            return jnp.stack(shared(state, Y) + [
+                jnp.mean(jnp.log(state.prior["psijh"])),
+                jnp.mean(jnp.log(state.prior["delta"])),
+                jnp.mean(state.Lambda ** 2),
+                jnp.mean(Y ** 2)])
+        return stats, ("log_ps", "Z2", "X2", "log_psi", "log_delta",
+                       "lam2", "Y2")
+    if prior_name == "horseshoe":
+        # half-Cauchy scales: no finite mean for lam2/tau2 or anything
+        # downstream (Lambda^2, Y^2) - monitor on the log scale throughout
+        def stats(state, Y):
+            return jnp.stack(shared(state, Y) + [
+                jnp.mean(jnp.log(state.prior["lam2"])),
+                jnp.mean(jnp.log(state.prior["nu"])),
+                jnp.mean(jnp.log(state.prior["tau2"])),
+                jnp.mean(jnp.log(state.prior["xi"])),
+                jnp.mean(jnp.log(state.Lambda ** 2)),
+                jnp.mean(jnp.log(Y ** 2))])
+        return stats, ("log_ps", "Z2", "X2", "log_lam2", "log_nu",
+                       "log_tau2", "log_xi", "log_LamSq", "log_Y2")
+    def stats(state, Y):  # dl
+        return jnp.stack(shared(state, Y) + [
+            jnp.mean(jnp.log(state.prior["psi"])),
+            jnp.mean(jnp.log(state.prior["phi"])),
+            jnp.mean(jnp.log(state.prior["tau"])),
+            jnp.mean(state.Lambda ** 2),
+            jnp.mean(Y ** 2)])
+    return stats, ("log_ps", "Z2", "X2", "log_psi", "log_phi", "log_tau",
+                   "lam2", "Y2")
 
 
 @pytest.mark.slow
-def test_geweke_joint_distribution():
+@pytest.mark.parametrize("prior_name", ["mgp", "horseshoe", "dl"])
+def test_geweke_joint_distribution(prior_name):
     """Marginal-conditional (prior) vs successive-conditional (prior
     transported through the full Gibbs sweep) moments must agree.  A bug in
     ANY conditional - wrong weighting, wrong Cholesky orientation, wrong
     shape/rate, cross-shard leakage - shifts the stationary distribution of
-    the successive chain away from the prior and fails the z-test."""
-    cfg = _geweke_cfg()
+    the successive chain away from the prior and fails the z-test.
+    Parametrized over all three shrinkage priors so the horseshoe/DL
+    hierarchies' cross-conditional wiring is validated by the same joint
+    test as MGP, not only by per-conditional moment checks."""
+    cfg = _geweke_cfg(prior_name)
     prior = make_prior(cfg)
-    M_MARG = 4000
-    M_SUCC = 20000
-    THIN = 5
+    stats, stat_names = _stats_fn(prior_name)
+    M_MARG = 6000
+    # Many SHORT independent successive chains instead of one long one: a
+    # successive-conditional chain started from an exact prior draw is
+    # stationary from step 0, so the final states of R independent chains
+    # are R i.i.d. draws from the kernel's stationary distribution - clean
+    # sqrt(R) standard errors.  A single long chain cannot test the
+    # horseshoe: its global scale's autocorrelation time exceeds 10^4
+    # sweeps (measured: batch-means SE still growing at batch 400), so no
+    # feasible length yields an honest SE.  A biased kernel still fails
+    # here because its T-step distribution drifts away from the prior.
+    R_CHAINS = 3000
+    T_STEPS = 40
 
     # marginal-conditional: independent prior draws
     def marg_one(key):
         k1, k2 = jax.random.split(key)
         state = _prior_state(k1, prior)
         Y = _sample_Y(k2, state)
-        return _stats(state, Y)
+        return stats(state, Y)
 
     marg = np.asarray(jax.jit(jax.vmap(marg_one))(
         jax.random.split(jax.random.key(0), M_MARG)))
 
-    # successive-conditional: Y | state, then state | Y via the real sweep
-    def succ_body(state, key):
-        ky, ks = jax.random.split(key)
-        Y = _sample_Y(ky, state)
-        new_state = gibbs_sweep(ks, Y, state, cfg, prior)
-        return new_state, _stats(new_state, Y)
+    # successive-conditional: Y | state then state | Y via the real sweep,
+    # T steps from a prior draw; report the final (state, Y) functionals
+    def succ_one(key):
+        k0, kY, k_steps = jax.random.split(key, 3)
 
-    state0 = _prior_state(jax.random.key(1), prior)
-    _, succ = jax.jit(lambda s0, ks: jax.lax.scan(succ_body, s0, ks))(
-        state0, jax.random.split(jax.random.key(2), M_SUCC))
-    succ = np.asarray(succ)[500::THIN]   # drop warm-up, thin autocorrelation
+        def body(state, k):
+            ky, ks = jax.random.split(k)
+            Y = _sample_Y(ky, state)
+            return gibbs_sweep(ks, Y, state, cfg, prior), None
 
-    for i, name in enumerate(_STAT_NAMES):
+        state, _ = jax.lax.scan(body, _prior_state(k0, prior),
+                                jax.random.split(k_steps, T_STEPS))
+        return stats(state, _sample_Y(kY, state))
+
+    succ = np.asarray(jax.jit(jax.vmap(succ_one))(
+        jax.random.split(jax.random.key(1), R_CHAINS)))
+
+    for i, name in enumerate(stat_names):
         m1, m2 = marg[:, i].mean(), succ[:, i].mean()
         se1 = marg[:, i].std(ddof=1) / np.sqrt(marg.shape[0])
-        # autocorrelation beyond the thinning: inflate the SE via a crude
-        # batch-means estimate
-        b = succ[:, i].reshape(-1, 20).mean(axis=1)
-        se2 = b.std(ddof=1) / np.sqrt(b.size)
+        se2 = succ[:, i].std(ddof=1) / np.sqrt(succ.shape[0])
         z = abs(m1 - m2) / np.sqrt(se1 ** 2 + se2 ** 2)
-        assert z < 5.0, f"Geweke z[{name}] = {z:.2f} ({m1:.4f} vs {m2:.4f})"
+        assert z < 5.0, \
+            f"Geweke[{prior_name}] z[{name}] = {z:.2f} ({m1:.4f} vs {m2:.4f})"
