@@ -421,13 +421,15 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
                 f"devices (got mesh_devices={n_mesh}); partial multi-host "
                 "meshes would leave idle processes deadlocked in collectives")
         use_mesh = True
-    if m.lambda_kernel == "pallas" and devices[0].platform != "tpu":
+    if (m.lambda_kernel.startswith("pallas")
+            and devices[0].platform != "tpu"):
         # Mosaic only lowers for TPU: compile the kernel in interpreter mode
         # when the RESOLVED execution platform is anything else (the default
         # backend may still be TPU, e.g. backend="jax_cpu" on a TPU host).
         # The internal name keys the jit caches, so switching backends
         # between fit() calls re-traces instead of reusing a stale lowering.
-        m = dataclasses.replace(m, lambda_kernel="pallas-interpret")
+        m = dataclasses.replace(
+            m, lambda_kernel=m.lambda_kernel + "-interpret")
 
     # Chunk schedule: full chunks + one remainder chunk (exactly total_iters;
     # per-iteration RNG keys are derived from the *global* iteration index in

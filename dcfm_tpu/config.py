@@ -123,8 +123,10 @@ class ModelConfig:
     # Implementation of the Lambda-update batched K x K Cholesky sampler
     # (the hot kernel, SURVEY.md C10).  "auto" picks the statically-unrolled
     # elementwise XLA path for K <= 16 and lax.linalg beyond; "pallas" uses
-    # the fused TPU kernel (ops/pallas_gaussian.py, interpreter mode
-    # off-TPU); "unrolled"/"lax" force those paths.  See
+    # the fused sampler TPU kernel (ops/pallas_gaussian.py, interpreter
+    # mode off-TPU); "pallas-fused" additionally forms Q in-kernel
+    # (EXPERIMENTAL: saves the (P, K, K) HBM round-trip but measures
+    # slower - see README); "unrolled"/"lax" force those paths.  See
     # scripts/bench_lambda_kernel.py for the measured comparison.
     lambda_kernel: str = "auto"
     # Adaptive rank truncation (see AdaptConfig).  Off by default: the
@@ -282,15 +284,18 @@ def validate(cfg: FitConfig, n: int, p: int) -> None:
             f"unknown estimator {m.estimator!r} (expected 'plain' or "
             "'scaled'; a typo would otherwise silently fall back to the "
             "plain reference combine rule)")
-    if m.lambda_kernel not in ("auto", "unrolled", "lax", "pallas"):
+    if m.lambda_kernel not in ("auto", "unrolled", "lax", "pallas",
+                               "pallas-fused"):
         raise ValueError(
             f"unknown lambda_kernel {m.lambda_kernel!r} "
-            "(auto | unrolled | lax | pallas)")
-    if m.lambda_kernel == "pallas" and m.factors_per_shard > 16:
+            "(auto | unrolled | lax | pallas | pallas-fused)")
+    if (m.lambda_kernel.startswith("pallas")
+            and m.factors_per_shard > 16):
         raise ValueError(
-            f"lambda_kernel='pallas' supports factors_per_shard <= 16 "
-            f"(statically-unrolled recurrence), got {m.factors_per_shard}; "
-            "use lambda_kernel='auto' (lax.linalg handles large K)")
+            f"lambda_kernel={m.lambda_kernel!r} supports factors_per_shard "
+            f"<= 16 (statically-unrolled recurrence), got "
+            f"{m.factors_per_shard}; use lambda_kernel='auto' (lax.linalg "
+            "handles large K)")
     if m.combine_chunks < 1 or m.num_shards % m.combine_chunks != 0:
         raise ValueError(
             f"combine_chunks={m.combine_chunks} must be >= 1 and divide "

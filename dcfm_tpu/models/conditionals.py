@@ -158,26 +158,43 @@ def gibbs_sweep(
     with jax.named_scope("lambda_update"):
         kl = _shard_keys(jax.random.fold_in(key, _SITE_LAM), shard_offset, Gl)
         if cfg.lambda_kernel.startswith("pallas"):
-            # FUSED path (ops/pallas_gaussian.lam_update_pallas): only the
-            # two MXU einsums (eta'eta, eta'Y) run outside the kernel; the
-            # per-row precision Q_j = diag(plam_j) + ps_j E and the whole
-            # factor-solve-sample chain live inside it, so the (Gl, P, K,
-            # K) Q tensor never exists in HBM.  The noise is still drawn
-            # per shard from the per-shard key - identical draws to the
-            # unrolled path (results then agree to float reassociation,
-            # not bitwise).
-            from dcfm_tpu.ops.pallas_gaussian import lam_update_pallas
-            E = jnp.einsum("gnk,gnj->gkj", eta_lam, eta_lam)     # (Gl,K,K)
-            EYt = jnp.einsum("gnp,gnk->gpk", Y, eta_lam)         # (Gl,P,K)
+            # "*-interpret" is the api-internal suffix fit() appends when
+            # the resolved execution platform is not TPU; without it the
+            # wrappers auto-detect.  The noise is drawn per shard from the
+            # per-shard key either way - identical draws to the unrolled
+            # path (results then agree to float reassociation, not
+            # bitwise).
+            interp = (True if cfg.lambda_kernel.endswith("-interpret")
+                      else None)
             Zn = jax.vmap(
                 lambda k, s: jax.random.normal(k, s.shape, s.dtype))(
                     kl, state.Lambda)
-            # "pallas-interpret" is the api-internal name fit() substitutes
-            # when the resolved execution platform is not TPU; bare "pallas"
-            # leaves interpret=None (the wrapper auto-detects)
-            interp = True if cfg.lambda_kernel == "pallas-interpret" else None
-            Lam = lam_update_pallas(E, plam, state.ps, EYt, Zn,
-                                    interpret=interp)
+            if cfg.lambda_kernel.startswith("pallas-fused"):
+                # EXPERIMENTAL whole-update fusion (ops/pallas_gaussian.
+                # lam_update_pallas): only the two MXU einsums run outside
+                # the kernel; Q_j = diag(plam_j) + ps_j E forms in-kernel,
+                # so the (Gl, P, K, K) Q tensor never exists in HBM.
+                # Measured SLOWER than "pallas" at the bench shape (the
+                # per-lane broadcast of the shard-constant E dominates -
+                # see README); kept for its memory behavior and as the
+                # fusion testbed.
+                from dcfm_tpu.ops.pallas_gaussian import lam_update_pallas
+                E = jnp.einsum("gnk,gnj->gkj", eta_lam, eta_lam)
+                EYt = jnp.einsum("gnp,gnk->gpk", Y, eta_lam)     # (Gl,P,K)
+                Lam = lam_update_pallas(E, plam, state.ps, EYt, Zn,
+                                        interpret=interp)
+            else:
+                # Sampler-only kernel on a materialized Q: flatten shards
+                # x rows into ONE kernel batch (under vmap the pallas
+                # batching rule would pad each shard's P rows to the lane
+                # tile separately, ~3x wasted lanes at P=157).
+                from dcfm_tpu.ops.pallas_gaussian import (
+                    chol_sample_batched_pallas)
+                Q, B = jax.vmap(lam_terms)(Y, eta_lam, state.ps, plam)
+                Lam = chol_sample_batched_pallas(
+                    Q.reshape(Gl * P, K, K), B.reshape(Gl * P, K),
+                    Zn.reshape(Gl * P, K), interpret=interp
+                ).reshape(Gl, P, K)
         else:
             Lam = jax.vmap(lam_update)(kl, Y, eta_lam, state.ps, plam)
         if state.active is not None:

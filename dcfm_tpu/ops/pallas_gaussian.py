@@ -116,25 +116,37 @@ def chol_sample_batched_pallas(
     return _chol_sample_jit(Q, B, Zn, interpret=bool(interpret))
 
 
-def _lam_rows_kernel(e_ref, plam_ref, ps_ref, ey_ref, z_ref, out_ref,
-                     *, K: int):
+def _lam_rows_kernel(e_ref, pk_ref, out_ref, *, K: int):
     """One (shard, row-tile) block of the FUSED Lambda update: forms each
     row's precision Q_j = diag(plam_j) + ps_j * E on the fly from the
-    shard's shared (K, K) cross-moment E (SMEM scalars) and the per-row
-    plam/ps lanes, then runs the same factor-solve-sample recurrence as
+    shard's shared (K, K) cross-moment E and the per-row plam/ps lanes,
+    then runs the same factor-solve-sample recurrence as
     _chol_sample_kernel.  The (rows, K, K) Q tensor - 2.6 MB per sweep at
     the bench shape - never exists in HBM.
 
     b_j = ps_j * (eta'Y)_j is also formed in-kernel from ey lanes.
+
+    All refs are rank-2 with 8-aligned sublane counts (Mosaic's block
+    constraint; leading-singleton rank-3 blocks also measured ~40x slower
+    per grid step): e_ref (Kr, K) zero-row-padded, pk_ref (Kp, TILE)
+    packing [plam; ey; z; ps] row-slabs, out (K8, TILE).
     """
-    ps = ps_ref[0, :1, :]                                # (1, TILE)
+    plam_ref = pk_ref[0:K, :]                            # (K, TILE)
+    ey_ref = pk_ref[K:2 * K, :]
+    z_ref = pk_ref[2 * K:3 * K, :]
+    ps = pk_ref[3 * K:3 * K + 1, :]                      # (1, TILE)
 
     # ---- Cholesky with on-the-fly Q columns ---------------------------
+    # E's column j is broadcast over the lane tile in ONE vector op per
+    # column ((K-j, 1) x (1, TILE)); building it from SMEM scalars
+    # (K-j splat-and-concatenate ops per column) measured ~100x slower.
     cols = []               # cols[j]: (K - j, TILE)
     for j in range(K):
-        rows = [ps * e_ref[0, i, j] for i in range(j, K)]
-        rows[0] = rows[0] + plam_ref[0, j:j + 1, :]      # diagonal term
-        s = jnp.concatenate(rows, axis=0) if len(rows) > 1 else rows[0]
+        e_col = e_ref[j:, j:j + 1]                       # (K-j, 1)
+        s = ps * e_col                                   # (K-j, TILE)
+        s = jnp.concatenate(
+            [s[:1, :] + plam_ref[j:j + 1, :], s[1:, :]], axis=0) \
+            if K - j > 1 else s + plam_ref[j:j + 1, :]
         for t in range(j):
             s = s - cols[t][j - t:, :] * cols[t][j - t:j - t + 1, :]
         d = jnp.sqrt(s[:1, :])
@@ -146,7 +158,7 @@ def _lam_rows_kernel(e_ref, plam_ref, ps_ref, ey_ref, z_ref, out_ref,
     # ---- forward solve L v = b,  b_j = ps * ey_j ----------------------
     v = []
     for j in range(K):
-        acc = ps * ey_ref[0, j:j + 1, :]
+        acc = ps * ey_ref[j:j + 1, :]
         for t in range(j):
             acc = acc - cols[t][j - t:j - t + 1, :] * v[t]
         v.append(acc / cols[j][:1, :])
@@ -156,7 +168,7 @@ def _lam_rows_kernel(e_ref, plam_ref, ps_ref, ey_ref, z_ref, out_ref,
     y = [None] * K
     for j in reversed(range(K)):
         acc_m = v[j]
-        acc_y = z_ref[0, j:j + 1, :]
+        acc_y = z_ref[j:j + 1, :]
         for i in range(j + 1, K):
             lij = cols[j][i - j:i - j + 1, :]
             acc_m = acc_m - lij * m[i]
@@ -166,7 +178,11 @@ def _lam_rows_kernel(e_ref, plam_ref, ps_ref, ey_ref, z_ref, out_ref,
         y[j] = acc_y * inv
 
     for j in range(K):
-        out_ref[0, j:j + 1, :] = m[j] + y[j]
+        out_ref[j:j + 1, :] = m[j] + y[j]
+    K8 = out_ref.shape[0]
+    if K8 > K:   # zero the 8-alignment padding rows (sliced away outside)
+        out_ref[K:, :] = jnp.zeros((K8 - K, out_ref.shape[1]),
+                                   out_ref.dtype)
 
 
 def lam_update_pallas(
@@ -215,31 +231,39 @@ def _lam_update_jit(E, plam, ps, EYt, Zn, interpret, tile):
         EYt = jnp.concatenate([EYt, jnp.zeros((G, pad, K), dtype)], axis=1)
         Zn = jnp.concatenate([Zn, jnp.zeros((G, pad, K), dtype)], axis=1)
 
-    plam_t = jnp.transpose(plam, (0, 2, 1))              # (G, K, Pp)
-    ey_t = jnp.transpose(EYt, (0, 2, 1))
-    z_t = jnp.transpose(Zn, (0, 2, 1))
-    ps_t = ps[:, None, :]                                # (G, 1, Pp)
+    # Rank-2 blocks with 8-aligned sublane counts only (Mosaic's block
+    # constraint; leading-singleton rank-3 layouts also measured ~40x
+    # slower per grid step).  The shard axis folds into the grid: per
+    # shard, [plam; ey; z; ps] pack into one (Kp, Pp) row-slab operand
+    # (Kp = 3K+1 rounded up to 8), and E pads its rows to Kr = 8-aligned.
+    Kp = ((3 * K + 1 + 7) // 8) * 8
+    Kr = ((K + 7) // 8) * 8
+    K8 = Kr
+    packed = jnp.concatenate([
+        jnp.transpose(plam, (0, 2, 1)),                  # rows 0..K-1
+        jnp.transpose(EYt, (0, 2, 1)),                   # rows K..2K-1
+        jnp.transpose(Zn, (0, 2, 1)),                    # rows 2K..3K-1
+        ps[:, None, :],                                  # row 3K
+        jnp.zeros((G, Kp - 3 * K - 1, Pp), dtype),
+    ], axis=1).reshape(G * Kp, Pp)
+    E_flat = jnp.concatenate(
+        [E, jnp.zeros((G, Kr - K, K), dtype)], axis=1).reshape(G * Kr, K)
     out = pl.pallas_call(
         functools.partial(_lam_rows_kernel, K=K),
         grid=(G, n_tiles),
         in_specs=[
-            pl.BlockSpec((1, K, K), lambda g, t: (g, 0, 0),
-                         memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, K, tile), lambda g, t: (g, 0, t),
+            pl.BlockSpec((Kr, K), lambda g, t: (g, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, tile), lambda g, t: (g, 0, t),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, K, tile), lambda g, t: (g, 0, t),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, K, tile), lambda g, t: (g, 0, t),
+            pl.BlockSpec((Kp, tile), lambda g, t: (g, t),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((1, K, tile), lambda g, t: (g, 0, t),
+        out_specs=pl.BlockSpec((K8, tile), lambda g, t: (g, t),
                                memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((G, K, Pp), dtype),
+        out_shape=jax.ShapeDtypeStruct((G * K8, Pp), dtype),
         interpret=interpret,
-    )(E, plam_t, ps_t, ey_t, z_t)
-    return jnp.transpose(out[:, :, :P], (0, 2, 1))       # (G, P, K)
+    )(E_flat, packed)
+    return jnp.transpose(out.reshape(G, K8, Pp)[:, :K, :P],
+                         (0, 2, 1))                      # (G, P, K)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))

@@ -40,10 +40,10 @@ def main():
         jnp.arange(G))
 
     results = {}
-    for impl in ("lax", "unrolled", "pallas"):
+    for impl in ("lax", "unrolled", "pallas", "pallas-fused"):
         if impl == "pallas":
-            # gibbs_sweep flattens shards x rows into one kernel batch
-            # (models/conditionals.py) - measure that call shape
+            # the sampler-only kernel on a pre-materialized Q (flattened
+            # shards x rows batch)
             from dcfm_tpu.ops.pallas_gaussian import chol_sample_batched_pallas
 
             def fn(keys, Q, B, _f=chol_sample_batched_pallas):
@@ -52,6 +52,31 @@ def main():
                         keys, B)
                 return _f(Q.reshape(G * P, K, K), B.reshape(G * P, K),
                           Zn.reshape(G * P, K)).reshape(G, P, K)
+            fn = jax.jit(fn)
+        elif impl == "pallas-fused":
+            # the WHOLE-update kernel as gibbs_sweep now calls it: Q is
+            # formed in-kernel from (E, plam, ps); inputs here mirror the
+            # sweep's own operands (lam_update_pallas docstring).  For a
+            # like-for-like comparison the other impls' timings should be
+            # read as "sampler given Q/B materialized" vs this path's
+            # "sampler given only the einsum outputs".
+            from dcfm_tpu.ops.pallas_gaussian import lam_update_pallas
+            rng2 = np.random.default_rng(1)
+            A2 = rng2.standard_normal((G, K, K)).astype(np.float32)
+            E = jnp.asarray(A2 @ np.transpose(A2, (0, 2, 1))
+                            + 0.5 * np.eye(K, dtype=np.float32))
+            plam = jnp.asarray(
+                rng2.gamma(2.0, 1.0, (G, P, K)).astype(np.float32) + 0.1)
+            ps = jnp.asarray(rng2.gamma(3.0, 0.5, (G, P)).astype(np.float32))
+            EYt = jnp.asarray(
+                rng2.standard_normal((G, P, K)).astype(np.float32))
+
+            def fn(keys, Q_unused, B_unused, _f=lam_update_pallas,
+                   _E=E, _plam=plam, _ps=ps, _EYt=EYt):
+                Zn = jax.vmap(
+                    lambda k, b: jax.random.normal(k, b.shape, b.dtype))(
+                        keys, _EYt)
+                return _f(_E, _plam, _ps, _EYt, Zn)
             fn = jax.jit(fn)
         else:
             fn = jax.jit(jax.vmap(

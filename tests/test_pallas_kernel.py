@@ -114,8 +114,9 @@ def test_unknown_impl_raises():
         sample_mvn_precision_batched(jax.random.key(0), Q, B, impl="unroled")
 
 
-def test_fit_with_pallas_kernel():
-    # end-to-end: the whole chain runs with lambda_kernel="pallas"
+@pytest.mark.parametrize("kernel", ["pallas", "pallas-fused"])
+def test_fit_with_pallas_kernel(kernel):
+    # end-to-end: the whole chain runs with both pallas kernel variants
     from dcfm_tpu import FitConfig, ModelConfig, RunConfig, fit
     rng = np.random.default_rng(3)
     n, p = 60, 64
@@ -124,8 +125,67 @@ def test_fit_with_pallas_kernel():
          + 0.3 * rng.standard_normal((n, p)).astype(np.float32))
     cfg = FitConfig(
         model=ModelConfig(num_shards=4, factors_per_shard=3, rho=0.8,
-                          lambda_kernel="pallas"),
+                          lambda_kernel=kernel),
         run=RunConfig(burnin=30, mcmc=30, thin=2, seed=0))
     res = fit(Y, cfg)
     assert np.isfinite(res.Sigma).all()
     assert res.stats.nonfinite_count == 0
+
+
+@pytest.mark.slow
+def test_pallas_compiled_on_tpu_smoke():
+    """TPU-gated smoke for the COMPILED (Mosaic) path of both kernels: the
+    CPU conftest forces interpret mode for every other test in this file,
+    so without this the compiled lowering would only ever run via manual
+    bench scripts.  Runs in a subprocess so the forced-CPU test process
+    doesn't constrain the backend; skips where no TPU is attached."""
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "JAX_PLATFORM_NAME", "XLA_FLAGS")}
+    probe = subprocess.run(
+        [sys.executable, "-c",
+         "import jax; print(jax.devices()[0].platform)"],
+        capture_output=True, text=True, env=env, cwd=repo, timeout=120)
+    if "tpu" not in probe.stdout:
+        pytest.skip(f"no TPU attached (default platform: {probe.stdout!r})")
+    code = """
+import numpy as np, jax, jax.numpy as jnp
+from dcfm_tpu.ops.gaussian import sample_mvn_precision_batched
+from dcfm_tpu.ops.pallas_gaussian import lam_update_pallas
+rng = np.random.default_rng(0)
+P, K, G = 700, 8, 4
+A = rng.standard_normal((P, K, K)).astype(np.float32)
+Q = jnp.asarray(A @ np.transpose(A, (0, 2, 1)) + 2 * np.eye(K, dtype=np.float32))
+B = jnp.asarray(rng.standard_normal((P, K)).astype(np.float32))
+key = jax.random.key(7)
+x_ref = sample_mvn_precision_batched(key, Q, B, impl="unrolled")
+x_pal = sample_mvn_precision_batched(key, Q, B, impl="pallas")
+np.testing.assert_allclose(np.asarray(x_pal), np.asarray(x_ref),
+                           rtol=2e-4, atol=2e-4)
+A2 = rng.standard_normal((G, K, K)).astype(np.float32)
+E = jnp.asarray(A2 @ np.transpose(A2, (0, 2, 1)) + 0.5 * np.eye(K, dtype=np.float32))
+plam = jnp.asarray(rng.gamma(2.0, 1.0, (G, P, K)).astype(np.float32) + 0.1)
+ps = jnp.asarray(rng.gamma(3.0, 0.5, (G, P)).astype(np.float32))
+EYt = jnp.asarray(rng.standard_normal((G, P, K)).astype(np.float32))
+Zn = jnp.asarray(rng.standard_normal((G, P, K)).astype(np.float32))
+x_fused = lam_update_pallas(E, plam, ps, EYt, Zn)
+Qf = jax.vmap(jax.vmap(jnp.diag))(plam) + ps[..., None, None] * E[:, None]
+b = ps[..., None] * EYt
+L = jax.lax.linalg.cholesky(Qf)
+v = jax.lax.linalg.triangular_solve(L, b[..., None], left_side=True, lower=True)
+m = jax.lax.linalg.triangular_solve(L, v, left_side=True, lower=True,
+                                    transpose_a=True)[..., 0]
+y = jax.lax.linalg.triangular_solve(L, Zn[..., None], left_side=True,
+                                    lower=True, transpose_a=True)[..., 0]
+np.testing.assert_allclose(np.asarray(x_fused), np.asarray(m + y),
+                           rtol=3e-4, atol=3e-4)
+print("COMPILED-PALLAS-OK")
+"""
+    run = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                         text=True, env=env, cwd=repo, timeout=420)
+    assert run.returncode == 0 and "COMPILED-PALLAS-OK" in run.stdout, (
+        run.stdout[-1000:], run.stderr[-1000:])
