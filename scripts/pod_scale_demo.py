@@ -23,15 +23,17 @@ Total ~1.3 GB/device; a TPU v5e (16 GB HBM) holds it 12x over.  At p=100k
 stream panels per saved draw.
 
 Run:  python scripts/pod_scale_demo.py          (~4-8 min on 8 virtual CPUs)
+      PODDEMO_SYNTH=1 PODDEMO_ITERS=200 PODDEMO_THIN=10 PODDEMO_N=64 \\
+          python scripts/pod_scale_demo.py      (full run + rel-err, ~7 min)
 
-Caveat for 1-core hosts: XLA CPU executes each device's big combine einsum
-to completion on the shared intra-op worker, so the 8 device threads reach
-each all-reduce serially; when the gap exceeds XLA's hard-coded 40 s
-rendezvous termination (rendezvous.cc), the process aborts by design.  At
-the full p=50k shape on one core this is a coin flip (observed 2-in-3
-pass); PODDEMO_P overrides the per-shard width (the layout - 256 shards,
-32/device, psum + all_gather - is identical at any P).  Real multi-core /
-multi-chip meshes do not hit this.
+1-core hosts: XLA CPU timeshares the 8 device threads, so one device's
+combine einsum can finish minutes after another's and trip XLA's 40 s
+collective-rendezvous termination.  ``ModelConfig.combine_chunks`` (set
+to 16 here via PODDEMO_CCHUNKS) fixes this DETERMINISTICALLY: the combine
+is split into column chunks with a psum rendezvous between chunks, so the
+collective-free stretch is one chunk's compute (measured 3/3 full-width
+passes; round 2's unchunked combine was a coin flip).  Real multi-core /
+multi-chip meshes don't need it (default combine_chunks=1).
 """
 
 import os

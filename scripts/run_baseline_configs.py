@@ -62,7 +62,7 @@ def gene_expression_like(n, p, n_modules=50, k_global=4, seed=0):
 
 def run_fit(name, Y, St, *, g, k, prior="mgp", rank_adapt=False,
             iters=1000, rho=0.9, seed=0, permute=True):
-    from dcfm_tpu import FitConfig, ModelConfig, RunConfig, fit
+    from dcfm_tpu import BackendConfig, FitConfig, ModelConfig, RunConfig, fit
 
     burnin = iters // 2
     cfg = FitConfig(
@@ -71,6 +71,9 @@ def run_fit(name, Y, St, *, g, k, prior="mgp", rank_adapt=False,
                           combine_dtype="bfloat16"),
         run=RunConfig(burnin=burnin, mcmc=iters - burnin, thin=5, seed=seed,
                       chunk_size=max(iters // 10, 1)),
+        # same transfer knobs as bench.py: this box reaches the TPU over a
+        # 2-25 MB/s tunnel, and config 3's p=10k panels are ~193 MB f32
+        backend=BackendConfig(fetch_dtype="quant8", upload_dtype="float16"),
         permute=permute)
     t0 = time.perf_counter()
     res = fit(Y, cfg)

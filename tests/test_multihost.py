@@ -12,10 +12,12 @@ import subprocess
 import sys
 
 import numpy as np
+import pytest
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+@pytest.mark.slow
 def test_multihost_demo_end_to_end():
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)
@@ -30,6 +32,7 @@ def test_multihost_demo_end_to_end():
     assert '"ok": true' in proc.stdout
 
 
+@pytest.mark.slow
 def test_multihost_elastic_recovery():
     # crash after the first per-process checkpoint save, resume="auto",
     # and require the recovered chain to match the uninterrupted run

@@ -27,14 +27,12 @@ def test_pod_scale_shapes_hold():
     env = dict(os.environ)
     # let the demo set up its own virtual mesh; drop the conftest's flags
     env.pop("XLA_FLAGS", None)
-    # P=96 (p=24,576): the LAYOUT under test (256 shards, 32/device,
-    # psum + all_gather, >0.3 GB/device row panels) is identical to the full
-    # p=50k run, but each device's inter-collective compute stays well under
-    # XLA's hard-coded 40 s CPU-collective rendezvous termination, which the
-    # full shape trips nondeterministically on a ONE-core host (see the
-    # demo's docstring).  The full-shape numbers are recorded in README.md
-    # from standalone runs.
-    env["PODDEMO_P"] = "96"
+    # FULL config-5 width (p = 256*196 = 50,176).  Deterministic even on a
+    # one-core host since ModelConfig.combine_chunks bounds each saved
+    # draw's collective-free stretch (the demo sets it; 3/3 consecutive
+    # full-width passes measured - BASELINE.md).  ~1.26 GB/device
+    # row-panel accumulators, ~11 GB host RAM.
+    env["PODDEMO_P"] = "196"
     env["PYTHONPATH"] = os.pathsep.join(
         [_REPO] + [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
                    if p])
