@@ -51,6 +51,25 @@ def test_multihost_elastic_recovery():
     assert '"ok": true' in proc.stdout
 
 
+@pytest.mark.slow
+def test_multihost_chain_extension():
+    # "ran 6, need 4 more" across 2 processes: the extended multi-host
+    # estimate must equal an uninterrupted full-length run bitwise (raw
+    # sum accumulators + per-process shard-local checkpoint format v4)
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [_REPO] + [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+                   if p])
+    env["MULTIHOST_DEMO_PORT"] = "29867"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "scripts", "multihost_demo.py"),
+         "--ext"],
+        env=env, cwd=_REPO, capture_output=True, text=True, timeout=560)
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    assert '"ok": true' in proc.stdout
+
+
 def test_initialize_from_env_noop_without_vars():
     # in-process check of the no-op contract (no coordinator set)
     env_backup = {k: os.environ.pop(k, None)
