@@ -78,13 +78,15 @@ class FitResult:
     # wall-clock per host-level chunk (SURVEY.md section 5 observability);
     # chunk_seconds[0] includes compilation.
     chunk_seconds: Optional[list] = None
-    # Phase-resolved wall-clock: {"upload_s", "chain_s", "fetch_s",
-    # "assemble_s"}.  On a tunneled device the fetch is usually the
-    # dominant term and fluctuates with link bandwidth; separating it from
-    # chain_s is what distinguishes a code regression from link weather.
-    # assemble_s is host CPU time only - in quant8 mode the native
-    # assembler runs inside the transfer's shadow, so it does not add to
-    # wall-clock on top of fetch_s.
+    # Phase-resolved wall-clock: {"preprocess_s", "upload_s", "init_s",
+    # "chain_s", "fetch_s", "assemble_s"}.  On a tunneled device the fetch
+    # is usually the dominant term and fluctuates with link bandwidth;
+    # separating it from chain_s is what distinguishes a code regression
+    # from link weather.  assemble_s is host CPU time only - in quant8
+    # mode the native assembler runs inside the transfer's shadow, so it
+    # does not add to wall-clock on top of fetch_s.  init_s covers state
+    # init or checkpoint load (incl. the init executable load on a
+    # tunneled device).
     phase_seconds: Optional[dict] = None
     # (p, p) entrywise posterior standard deviation of the covariance, in
     # the caller's coordinates; set when ModelConfig.posterior_sd is on.
@@ -393,10 +395,12 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
     validate(cfg, n, p)
     m, run = cfg.model, cfg.run
 
+    t_pre = time.perf_counter()
     pre = preprocess(
         Y, m.num_shards,
         permute=cfg.permute, standardize=cfg.standardize,
         pad_to_shards=cfg.pad_to_shards, seed=run.seed)
+    preprocess_s = time.perf_counter() - t_pre
     key = jax.random.key(run.seed)
     k_init, k_chain = jax.random.split(key)
 
@@ -540,8 +544,11 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
         return carry0, 0
 
     def _run_chain(init_fn, get_chunk_fn, Yd):
+        t_init = time.perf_counter()
         carry, done = (_resume_state_multiproc if multiproc
                        else _resume_state)(init_fn, Yd)
+        jax.block_until_ready(carry)
+        phase["init_s"] = time.perf_counter() - t_init
         stats = None
         traces = []
         chunk_secs = []
@@ -563,8 +570,8 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
     sched = schedule_array(run)
     profile_ctx = (jax.profiler.trace(cfg.backend.profile_dir)
                    if cfg.backend.profile_dir else contextlib.nullcontext())
-    phase = {"upload_s": 0.0, "chain_s": 0.0, "fetch_s": 0.0,
-             "assemble_s": 0.0}
+    phase = {"preprocess_s": preprocess_s, "upload_s": 0.0, "init_s": 0.0,
+             "chain_s": 0.0, "fetch_s": 0.0, "assemble_s": 0.0}
     t0 = time.perf_counter()
     with profile_ctx:
         if use_mesh:

@@ -122,7 +122,8 @@ def test_cli_transfer_and_combine_knobs(tmp_path, capsys, data_npy):
         "--out", out])
     assert rc == 0
     assert np.isfinite(np.load(out)).all()
-    assert set(meta["phase_seconds"]) == {"upload_s", "chain_s", "fetch_s",
+    assert set(meta["phase_seconds"]) == {"preprocess_s", "upload_s",
+                                          "init_s", "chain_s", "fetch_s",
                                           "assemble_s"}
 
 
