@@ -401,6 +401,13 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
         permute=cfg.permute, standardize=cfg.standardize,
         pad_to_shards=cfg.pad_to_shards, seed=run.seed)
     preprocess_s = time.perf_counter() - t_pre
+    if pre.n_missing and not m.impute_missing:
+        # NaN entries in Y: enable the per-sweep imputation site
+        # (models/conditionals.impute_missing_y).  Applied to the internal
+        # model config only - like the pallas-interpret substitution - so
+        # the user's config round-trips unchanged through checkpoints, and
+        # complete-data fits compile exactly their usual code.
+        m = dataclasses.replace(m, impute_missing=True)
     key = jax.random.key(run.seed)
     k_init, k_chain = jax.random.split(key)
 

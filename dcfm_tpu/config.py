@@ -132,6 +132,13 @@ class ModelConfig:
     # Adaptive rank truncation (see AdaptConfig).  Off by default: the
     # reference model has a fixed per-shard factor budget.
     rank_adapt: bool = False
+    # Gibbs data augmentation for missing entries: each iteration draws
+    # Y_miss | state ~ N((eta Lam')_miss, 1/ps) and the sweep conditions
+    # on the completed matrix - the standard missing-at-random treatment
+    # (the reference has none; NaNs would silently corrupt its chain).
+    # AUTO-ENABLED by fit() when Y contains NaNs; settable explicitly only
+    # to pre-build jitted functions for data that will have NaNs.
+    impute_missing: bool = False
     # Split the per-saved-draw combine into this many column-chunks, with a
     # cross-shard rendezvous (a tiny psum) between consecutive chunks.  The
     # combine einsum is the one long collective-free stretch of the chain

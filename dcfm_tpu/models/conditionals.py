@@ -41,8 +41,10 @@ from dcfm_tpu.ops.gaussian import (
     sample_mvn_precision_shared,
 )
 
-# site ids for RNG folding - stable across refactors
+# site ids for RNG folding - stable across refactors (6 = rank adaptation,
+# models/adapt.py; 7 = missing-data imputation)
 _SITE_Z, _SITE_X, _SITE_LAM, _SITE_PRIOR, _SITE_PS = 1, 2, 3, 4, 5
+_SITE_IMPUTE = 7
 
 
 def _shard_keys(site_key: jax.Array, shard_offset, num_local: int) -> jax.Array:
@@ -53,6 +55,40 @@ def _shard_keys(site_key: jax.Array, shard_offset, num_local: int) -> jax.Array:
 def local_sum(x: jax.Array) -> jax.Array:
     """Cross-shard reduction for the single-device layout: plain sum over Gl."""
     return jnp.sum(x, axis=0)
+
+
+def impute_missing_y(
+    key: jax.Array,
+    Y: jax.Array,
+    state: SamplerState,
+    rho: float,
+    *,
+    shard_offset=0,
+) -> jax.Array:
+    """Gibbs data-augmentation site: complete Y by drawing the missing
+    entries (NaN markers) from their conditional
+    Y_miss | state ~ N((eta Lam')_miss, 1/ps).
+
+    The mask is derived from the data itself (NaN survives preprocessing
+    and the reduced-precision upload), so no extra array crosses the
+    host->device link and no jit signature changes.  Run once per sweep,
+    BEFORE the conditionals - all of them then see the completed matrix,
+    which is the standard missing-at-random treatment (the reference
+    would silently poison its chain: NaN propagates through every MATLAB
+    update).  ModelConfig.impute_missing gates the call, so complete-data
+    fits compile exactly the code they always did.
+    """
+    Gl = Y.shape[0]
+    mask = jnp.isnan(Y)                                     # (Gl, n, P)
+    eta = (jnp.sqrt(rho) * state.X[None]
+           + jnp.sqrt(1.0 - rho) * state.Z)                 # (Gl, n, K)
+    mu = jnp.einsum("gnk,gpk->gnp", eta, state.Lambda)
+    keys = _shard_keys(jax.random.fold_in(key, _SITE_IMPUTE),
+                       shard_offset, Gl)
+    noise = jax.vmap(
+        lambda k, m: jax.random.normal(k, m.shape, m.dtype))(keys, mu)
+    draw = mu + noise / jnp.sqrt(state.ps[:, None, :])
+    return jnp.where(mask, draw, Y)
 
 
 def gibbs_sweep(

@@ -26,7 +26,8 @@ from jax import lax
 
 from dcfm_tpu.config import ModelConfig, RunConfig
 from dcfm_tpu.models.adapt import adapt_rank
-from dcfm_tpu.models.conditionals import covariance_blocks, gibbs_sweep, local_sum
+from dcfm_tpu.models.conditionals import (
+    covariance_blocks, gibbs_sweep, impute_missing_y, local_sum)
 from dcfm_tpu.models.priors import Prior
 from dcfm_tpu.models.state import SamplerState, init_state
 
@@ -290,8 +291,17 @@ def run_chunk(
     thin = sched[1].astype(jnp.int32)
 
     def body(carry: ChainCarry, it_key: jax.Array) -> tuple[ChainCarry, None]:
+        if cfg.impute_missing:
+            # data-augmentation site: complete the NaN entries from their
+            # conditional given the CURRENT state; every conditional and
+            # the chain trace below then see the completed matrix
+            with jax.named_scope("impute_missing"):
+                Yc = impute_missing_y(it_key, Y, carry.state, cfg.rho,
+                                      shard_offset=shard_offset)
+        else:
+            Yc = Y
         state = gibbs_sweep(
-            it_key, Y, carry.state, cfg, prior,
+            it_key, Yc, carry.state, cfg, prior,
             shard_offset=shard_offset, reduce_fn=reduce_fn)
         it = carry.iteration + 1  # 1-based, like the reference
         if cfg.rank_adapt:
@@ -375,8 +385,8 @@ def run_chunk(
                 (carry.sigma_acc, carry.sigma_sq_acc, carry.draws))
         with jax.named_scope("health_trace"):
             health = _health_update(carry.health, _health_now(state, prior))
-            trace = _trace_now(Y, state, reduce_fn, carry.sigma_acc.shape[1],
-                               cfg.rho)
+            trace = _trace_now(Yc, state, reduce_fn,
+                               carry.sigma_acc.shape[1], cfg.rho)
         return ChainCarry(state, sigma_acc, it, health, sigma_sq_acc,
                           draw_bufs), trace
 

@@ -32,7 +32,10 @@ import numpy as np
 class PreprocessResult:
     """Sharded data plus everything needed to invert the preprocessing."""
 
-    data: np.ndarray            # (g, n, P) float32 - shard-major layout
+    data: np.ndarray            # (g, n, P) float32 - shard-major layout;
+                                # NaN marks a missing entry (imputed on
+                                # device each sweep - ModelConfig.
+                                # impute_missing)
     perm: np.ndarray            # (p_used,) column j of shard layout = kept[perm[j]]
     inv_perm: np.ndarray        # (p_used,) inverse of perm
     col_mean: np.ndarray        # (g, P) per-column means (0 where not standardized)
@@ -41,6 +44,7 @@ class PreprocessResult:
     zero_cols: np.ndarray       # indices of dropped all-zero columns
     n_pad: int                  # number of dummy padding columns appended
     p_original: int             # caller's p before filtering/padding
+    n_missing: int = 0          # NaN entries in the kept data (0 = complete)
 
     @property
     def num_shards(self) -> int:
@@ -75,8 +79,25 @@ def preprocess(
     if Y.ndim != 2:
         raise ValueError(f"Y must be (n, p), got shape {Y.shape}")
     n, p = Y.shape
+    nan_mask = np.isnan(Y)
+    n_missing = int(nan_mask.sum())
+    if np.isinf(Y).any():
+        raise ValueError(
+            "Y contains infinite entries (NaN marks a missing value and is "
+            "imputed; inf is unrepresentable data and must be cleaned)")
+    if n_missing:
+        obs = n - nan_mask.sum(axis=0)
+        too_few = obs < (2 if standardize else 1)
+        if too_few.any():
+            raise ValueError(
+                f"columns {np.flatnonzero(too_few).tolist()[:10]} have "
+                f"fewer than {2 if standardize else 1} observed entries - "
+                "nothing to standardize or anchor imputation on; drop "
+                "them first")
 
     # --- zero-column filter (reference :31-39) ---
+    # NaN != 0 is True, so a column of NaNs + zeros counts as nonzero and
+    # is kept (it carries observations only through imputation anchors).
     nonzero = np.any(Y != 0, axis=0)
     kept_cols = np.flatnonzero(nonzero)
     zero_cols = np.flatnonzero(~nonzero)
@@ -113,9 +134,16 @@ def preprocess(
         Yk[:, perm].reshape(n, g, P).transpose(1, 0, 2))
 
     # --- per-column center/scale (reference :56-59), stats retained ---
+    # With missing entries the stats come from the OBSERVED values only
+    # (nanmean/nanvar); NaN survives the arithmetic and flows to the
+    # device, where the sweep imputes it each iteration.
     if standardize:
-        col_mean = data.mean(axis=1)                      # (g, P)
-        col_var = data.var(axis=1, ddof=1)                # matches MATLAB var
+        if n_missing:
+            col_mean = np.nanmean(data, axis=1)           # (g, P)
+            col_var = np.nanvar(data, axis=1, ddof=1)
+        else:
+            col_mean = data.mean(axis=1)                  # (g, P)
+            col_var = data.var(axis=1, ddof=1)            # matches MATLAB var
         col_scale = np.sqrt(np.maximum(col_var, 1e-12))
         data = (data - col_mean[:, None, :]) / col_scale[:, None, :]
     else:
@@ -132,6 +160,7 @@ def preprocess(
         zero_cols=zero_cols,
         n_pad=n_pad,
         p_original=p,
+        n_missing=n_missing,
     )
 
 

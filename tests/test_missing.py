@@ -1,0 +1,152 @@
+"""Missing-data handling: NaN entries are imputed each sweep by Gibbs data
+augmentation (Y_miss | state ~ N((eta Lam')_miss, 1/ps) - models/
+conditionals.impute_missing_y, auto-enabled by fit() on NaN input).
+
+The reference has no missing-data story: a NaN in Y propagates through
+every MATLAB update and silently poisons the chain.  Here NaN is the
+missing-value marker end to end - it survives standardization (observed-
+only stats) and the reduced-precision upload, and the device derives the
+mask from the data itself, so no extra array crosses the link.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from tests.conftest import make_synthetic
+
+from dcfm_tpu import BackendConfig, FitConfig, ModelConfig, RunConfig, fit
+from dcfm_tpu.utils.preprocess import preprocess
+
+
+def _mcar(Y, frac, seed=0):
+    rng = np.random.default_rng(seed)
+    Ym = Y.astype(np.float32).copy()
+    mask = rng.random(Y.shape) < frac
+    # keep every column anchored by >= 2 observations
+    for j in np.flatnonzero(mask.sum(0) > Y.shape[0] - 2):
+        mask[: Y.shape[0] - 2, j] = False
+    Ym[mask] = np.nan
+    return Ym, mask
+
+
+def _cfg(mesh=0, **model_kw):
+    return FitConfig(
+        model=ModelConfig(num_shards=4, factors_per_shard=3, rho=0.8,
+                          **model_kw),
+        run=RunConfig(burnin=150, mcmc=150, thin=2, seed=0),
+        backend=BackendConfig(mesh_devices=mesh))
+
+
+def test_missing_data_recovers_covariance():
+    """20% MCAR missingness: the fit stays finite and recovers the truth
+    nearly as well as the complete-data fit."""
+    Y, St = make_synthetic(150, 48, 3, seed=51)
+    Ym, mask = _mcar(Y, 0.2, seed=1)
+    res_c = fit(Y, _cfg())
+    res_m = fit(Ym, _cfg())
+    assert res_m.preprocess.n_missing == int(mask.sum())
+    assert np.isfinite(res_m.Sigma).all()
+    assert res_m.stats.nonfinite_count == 0
+
+    def err(r):
+        return np.linalg.norm(r.Sigma - St) / np.linalg.norm(St)
+
+    e_c, e_m = err(res_c), err(res_m)
+    assert e_m < 0.5
+    # losing 20% of entries costs accuracy, but not catastrophically
+    assert e_m < 2.5 * e_c + 0.1, (e_c, e_m)
+
+
+def test_missing_mesh_matches_vmap():
+    """The imputation site folds per-shard keys from the global shard
+    index, so mesh and single-device layouts stay chain-identical on
+    missing data too."""
+    import jax
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 virtual devices")
+    Y, _ = make_synthetic(60, 32, 2, seed=53)
+    Ym, _ = _mcar(Y, 0.15, seed=2)
+    m = ModelConfig(num_shards=4, factors_per_shard=2, rho=0.7)
+    r = RunConfig(burnin=20, mcmc=20, thin=2, seed=1)
+    res1 = fit(Ym, FitConfig(model=m, run=r))
+    res4 = fit(Ym, FitConfig(model=m, run=r,
+                             backend=BackendConfig(mesh_devices=4)))
+    np.testing.assert_allclose(res1.sigma_blocks, res4.sigma_blocks,
+                               rtol=1e-3, atol=1e-4)
+
+
+def test_missing_checkpoint_resume_bitwise(tmp_path, monkeypatch):
+    """Kill/resume on missing data reproduces the uninterrupted run - the
+    imputation draws derive from the global iteration key."""
+    import dcfm_tpu.api as api
+
+    Y, _ = make_synthetic(50, 24, 2, seed=57)
+    Ym, _ = _mcar(Y, 0.1, seed=3)
+    base = FitConfig(
+        model=ModelConfig(num_shards=2, factors_per_shard=2, rho=0.6),
+        run=RunConfig(burnin=16, mcmc=16, thin=2, seed=0, chunk_size=8))
+    full = fit(Ym, base)
+
+    ck = str(tmp_path / "miss.npz")
+    cfg_ck = dataclasses.replace(base, checkpoint_path=ck)
+    real = api.save_checkpoint
+    calls = {"n": 0}
+
+    def killing(*a, **k):
+        real(*a, **k)
+        calls["n"] += 1
+        if calls["n"] == 2:
+            raise RuntimeError("boom")
+
+    monkeypatch.setattr(api, "save_checkpoint", killing)
+    with pytest.raises(RuntimeError, match="boom"):
+        fit(Ym, cfg_ck)
+    monkeypatch.setattr(api, "save_checkpoint", real)
+    resumed = fit(Ym, dataclasses.replace(cfg_ck, resume=True))
+    np.testing.assert_array_equal(full.sigma_blocks, resumed.sigma_blocks)
+
+
+def test_observed_only_standardization():
+    """Standardization stats must come from observed entries only."""
+    rng = np.random.default_rng(5)
+    Y = rng.normal(3.0, 2.0, size=(200, 8)).astype(np.float32)
+    Ym = Y.copy()
+    Ym[::3, 0] = np.nan                        # a third of column 0 missing
+    pre = preprocess(Ym, num_shards=2, permute=False, seed=0)
+    # observed mean/scale of column 0, not nan-poisoned and not the
+    # complete-data values
+    obs = Ym[~np.isnan(Ym[:, 0]), 0]
+    np.testing.assert_allclose(pre.col_mean.reshape(-1)[0], obs.mean(),
+                               rtol=1e-5)
+    np.testing.assert_allclose(pre.col_scale.reshape(-1)[0],
+                               obs.std(ddof=1), rtol=1e-4)
+    assert np.isfinite(pre.col_mean).all() and np.isfinite(pre.col_scale).all()
+    # NaN markers survive into the sharded data for the device-side mask
+    assert np.isnan(pre.data).sum() == np.isnan(Ym).sum()
+
+
+def test_rejects_inf_and_underobserved_columns():
+    Y = np.ones((10, 6), np.float32) + np.random.default_rng(0).normal(
+        size=(10, 6)).astype(np.float32)
+    Yi = Y.copy()
+    Yi[0, 0] = np.inf
+    with pytest.raises(ValueError, match="infinite"):
+        preprocess(Yi, num_shards=2)
+    Yn = Y.copy()
+    Yn[:-1, 2] = np.nan                        # one observed entry only
+    with pytest.raises(ValueError, match="fewer than 2 observed"):
+        preprocess(Yn, num_shards=2)
+
+
+def test_complete_data_unchanged_by_feature():
+    """A complete-data fit must not change because the feature exists:
+    impute_missing stays off and results match a fit with the flag
+    force-enabled (whose mask is empty)."""
+    Y, _ = make_synthetic(60, 24, 2, seed=59)
+    r1 = fit(Y, _cfg())
+    assert r1.preprocess.n_missing == 0
+    r2 = fit(Y, _cfg(impute_missing=True))     # empty mask: where() no-ops
+    np.testing.assert_array_equal(r1.sigma_blocks, r2.sigma_blocks)
