@@ -103,6 +103,12 @@ class FitResult:
     # reconstruction uses the same rule as the accumulated mean - see
     # covariance_credible_interval.
     draws: Optional[dict] = None
+    # (n, p) posterior-mean completed data matrix, set when the input had
+    # missing (NaN) entries: observed entries are the caller's EXACT
+    # values, NaN positions hold the average of the per-sweep imputation
+    # draws over saved draws (chains pooled), mapped back to the caller's
+    # coordinates and scale.
+    Y_imputed: Optional[np.ndarray] = None
 
     @functools.cached_property
     def sigma_blocks(self) -> np.ndarray:
@@ -712,6 +718,22 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
         if d.H is not None:
             draws["H"] = np.asarray(d.H)
 
+    Y_imputed = None
+    if carry.y_imp_acc is not None:
+        from dcfm_tpu.utils.preprocess import restore_data_matrix
+        yi = np.asarray(jax.device_get(
+            _replicate_jit(mesh)(carry.y_imp_acc) if multiproc
+            else carry.y_imp_acc), np.float32)
+        if C > 1:
+            yi = yi.mean(axis=0)        # pool the chains' posterior means
+        rec = restore_data_matrix(yi / max(n_saved, 1), pre,
+                                  destandardize=True)
+        # observed entries are the caller's exact values; only the NaN
+        # positions take the posterior-mean imputation
+        Y_imputed = np.array(Y, np.float32, copy=True)
+        miss = np.isnan(Y_imputed)
+        Y_imputed[miss] = rec[miss]
+
     Sigma_sd = sd_upper = None
     if carry.sigma_sq_acc is not None:
         # entrywise posterior SD from the accumulated first/second moments,
@@ -749,6 +771,7 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
         Sigma_sd=Sigma_sd,
         sd_upper_panels=sd_upper,
         draws=draws,
+        Y_imputed=Y_imputed,
     )
 
 

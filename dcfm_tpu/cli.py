@@ -104,6 +104,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="output .npy for the covariance estimate")
     f.add_argument("--raw-coords", action="store_true",
                    help="skip de-standardization (correlation-scale output)")
+    f.add_argument("--imputed-out", default=None, metavar="PATH",
+                   help="when Y has NaN entries (imputed each sweep by "
+                        "Gibbs data augmentation), also write the "
+                        "posterior-mean completed (n, p) matrix here "
+                        "(.npy; observed entries pass through exactly)")
     f.add_argument("--draws-out", default=None, metavar="PATH",
                    help="also retain every thinned post-burn-in draw of "
                         "(Lambda, ps, X) and write them to this .npz "
@@ -173,6 +178,12 @@ def main(argv=None) -> int:
         np.save(args.out, Sigma)
     if args.draws_out and write_files:
         np.savez(args.draws_out, **res.draws)
+    if args.imputed_out:
+        if res.Y_imputed is None:
+            raise SystemExit("--imputed-out set but Y has no missing "
+                             "(NaN) entries")
+        if write_files:
+            np.save(args.imputed_out, res.Y_imputed)
     sd_out = None
     if res.Sigma_sd is not None:
         root, ext = os.path.splitext(args.out)
@@ -195,6 +206,7 @@ def main(argv=None) -> int:
         "effective_rank_mean": float(np.asarray(res.stats.rank_mean)),
         "zero_cols_dropped": int(res.preprocess.zero_cols.size),
         "padded_cols": int(res.preprocess.n_pad),
+        "missing_entries": int(res.preprocess.n_missing),
         # None (JSON null) for non-finite diagnostics: bare NaN is invalid
         # JSON (RFC 8259) and would break consumers exactly when a diverged
         # chain makes the report matter most.

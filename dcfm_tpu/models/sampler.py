@@ -74,6 +74,11 @@ class ChainCarry(NamedTuple):
     sigma_sq_acc: Optional[jax.Array] = None
     # Thinned draw ring (see DrawBuffers), or None when store_draws is off.
     draws: Optional[DrawBuffers] = None
+    # (Gl, n, P) running SUM over saved draws of the COMPLETED data matrix
+    # (observed entries pass through; NaN positions carry that sweep's
+    # imputation draw), or None when ModelConfig.impute_missing is off.
+    # Divided by the saved count at fetch -> FitResult.Y_imputed.
+    y_imp_acc: Optional[jax.Array] = None
 
 
 class ChainStats(NamedTuple):
@@ -253,7 +258,9 @@ def init_chain(
                       health=_health_init(Gl, dtype),
                       sigma_sq_acc=(jnp.zeros_like(sigma_acc)
                                     if cfg.posterior_sd else None),
-                      draws=draws)
+                      draws=draws,
+                      y_imp_acc=(jnp.zeros((Gl, n, P), dtype)
+                                 if cfg.impute_missing else None))
 
 
 def run_chunk(
@@ -308,7 +315,11 @@ def run_chunk(
             state = adapt_rank(it_key, state, it, burnin, cfg)
 
         def accumulate(accs):
-            acc, acc_sq, draws = accs
+            acc, acc_sq, draws, y_imp = accs
+            if y_imp is not None:
+                # posterior-mean imputation: sum the completed matrix over
+                # saved draws (observed entries are constant across draws)
+                y_imp = y_imp + Yc
             Lam_all = gather_fn(state.Lambda)
             if cfg.estimator == "scaled":
                 eta = (jnp.sqrt(cfg.rho) * state.X[None]
@@ -376,19 +387,20 @@ def run_chunk(
                     X=lax.dynamic_update_slice_in_dim(
                         draws.X, state.X[None], idx, axis=0),
                     H=H_bufs)
-            return acc, acc_sq, draws
+            return acc, acc_sq, draws, y_imp
 
         save = jnp.logical_and(it > burnin, (it - burnin) % thin == 0)
         with jax.named_scope("combine"):
-            sigma_acc, sigma_sq_acc, draw_bufs = lax.cond(
+            sigma_acc, sigma_sq_acc, draw_bufs, y_imp_acc = lax.cond(
                 save, accumulate, lambda a: a,
-                (carry.sigma_acc, carry.sigma_sq_acc, carry.draws))
+                (carry.sigma_acc, carry.sigma_sq_acc, carry.draws,
+                 carry.y_imp_acc))
         with jax.named_scope("health_trace"):
             health = _health_update(carry.health, _health_now(state, prior))
             trace = _trace_now(Yc, state, reduce_fn,
                                carry.sigma_acc.shape[1], cfg.rho)
         return ChainCarry(state, sigma_acc, it, health, sigma_sq_acc,
-                          draw_bufs), trace
+                          draw_bufs, y_imp_acc), trace
 
     keys = jax.vmap(lambda i: jax.random.fold_in(key, i))(
         carry.iteration + jnp.arange(num_iters))

@@ -114,7 +114,8 @@ def build_mesh_chain(
         return ChainCarry(state=state_spec, sigma_acc=sh_c, iteration=rep,
                           health=sh_c,
                           sigma_sq_acc=sh_c if cfg.posterior_sd else None,
-                          draws=draws_spec)
+                          draws=draws_spec,
+                          y_imp_acc=sh_c if cfg.impute_missing else None)
 
     # Build a template of the prior pytree structure to spec it out.
     import jax.numpy as jnp  # noqa: F811

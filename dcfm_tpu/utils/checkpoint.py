@@ -47,7 +47,9 @@ from dcfm_tpu.config import (
 # estimator + store_draws), changing the carry leaf count; v3 checkpoints
 # with draws would otherwise die on a missing-leaf KeyError instead of
 # the friendly version refusal.
-_FORMAT_VERSION = 4
+# v5: ChainCarry gained y_imp_acc (posterior-mean imputation accumulator,
+# present when the data has missing entries).
+_FORMAT_VERSION = 5
 
 
 def data_fingerprint(data: np.ndarray) -> str:

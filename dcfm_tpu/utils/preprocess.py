@@ -164,6 +164,35 @@ def preprocess(
     )
 
 
+def restore_data_matrix(
+    data_shard: np.ndarray,
+    pre: PreprocessResult,
+    *,
+    destandardize: bool = True,
+) -> np.ndarray:
+    """(g, n, P) shard-major data-space matrix -> (n, p_original) caller
+    coordinates: de-standardize, undo the shard layout and permutation,
+    drop padding columns, zero-fill the dropped all-zero columns.  The
+    row-space inverse of :func:`preprocess` (restore_covariance is the
+    column-pair-space one)."""
+    g, n, P = data_shard.shape
+    if (g, P) != (pre.num_shards, pre.shard_size):
+        raise ValueError(
+            f"expected ({pre.num_shards}, n, {pre.shard_size}), got "
+            f"{data_shard.shape}")
+    arr = data_shard
+    if destandardize:
+        arr = (arr * pre.col_scale[:, None, :]
+               + pre.col_mean[:, None, :])
+    arr = np.ascontiguousarray(
+        np.transpose(arr, (1, 0, 2))).reshape(n, pre.p_used)
+    arr = arr[:, pre.inv_perm]          # permuted -> kept(+padding) order
+    p_kept = pre.p_used - pre.n_pad
+    out = np.zeros((n, pre.p_original), arr.dtype)
+    out[:, pre.kept_cols] = arr[:, :p_kept]
+    return out
+
+
 def caller_to_shard_index(pre: PreprocessResult, idx) -> np.ndarray:
     """Caller-coordinate column indices -> shard-coordinate positions.
 

@@ -157,6 +157,31 @@ def test_cli_profile_dir_writes_trace(tmp_path, capsys, data_npy):
     assert files, "profile dir is empty - jax.profiler trace not written"
 
 
+def test_cli_imputed_out(tmp_path, capsys, data_npy):
+    _, Y, _ = data_npy
+    Ym = Y.astype(np.float32).copy()
+    rng = np.random.default_rng(7)
+    mask = rng.random(Ym.shape) < 0.15
+    Ym[mask] = np.nan
+    path = str(tmp_path / "Ym.npy")
+    np.save(path, Ym)
+    out = str(tmp_path / "s_m.npy")
+    imp = str(tmp_path / "imputed.npy")
+    rc, meta = _run(capsys, [
+        "fit", path, "-g", "2", "-k", "6", "--burnin", "30", "--mcmc", "30",
+        "--thin", "2", "--imputed-out", imp, "--out", out])
+    assert rc == 0
+    assert meta["missing_entries"] == int(mask.sum())
+    Yi = np.load(imp)
+    assert Yi.shape == Y.shape and np.isfinite(Yi).all()
+    np.testing.assert_array_equal(Yi[~mask], Ym[~mask])
+    # complete data + --imputed-out is a friendly refusal
+    p_complete, _, _ = data_npy
+    with pytest.raises(SystemExit, match="no missing"):
+        main(["fit", p_complete, "-g", "2", "-k", "6", "--burnin", "4",
+              "--mcmc", "4", "--imputed-out", imp, "--out", out])
+
+
 def test_cli_resume_without_checkpoint_errors(data_npy):
     path, _, _ = data_npy
     with pytest.raises(SystemExit):
