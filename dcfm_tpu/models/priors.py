@@ -145,6 +145,20 @@ def make_mgp(cfg: ModelConfig) -> Prior:
 # lam_jh ~ N(0, lam2_jh * tau2);  sqrt(lam2) ~ C+(0,1);  sqrt(tau2) ~ C+(0,s).
 # With auxiliaries nu_jh, xi every conditional is inverse-gamma.
 
+# Float32 guards for the horseshoe hierarchy.  A column DEACTIVATED by
+# rank adaptation has no data anchor: its (lam2, nu) auxiliary pair is a
+# free-running sample of the half-Cauchy prior, whose heavy tails walk
+# lam2 to f32 underflow (exactly 0) within a few hundred sweeps - and
+# then the tau2 rate computes lam_sq/lam2 = 0/0 = NaN, poisoning the
+# whole chain (caught by an e2e horseshoe + rank_adapt probe; the
+# anchored no-adaptation chain reaches these tails only with measure
+# ~1e-15 per draw).  State clamps sit far outside any statistically
+# visible range; the derived row precision is additionally bounded like
+# the DL prior's so the Lambda-update Cholesky stays well-scaled.
+_HS_TINY, _HS_HUGE = 1e-30, 1e30
+_HS_MAX_PRECISION = 1e12
+
+
 def make_horseshoe(cfg: ModelConfig) -> Prior:
     s2 = cfg.horseshoe.global_scale ** 2
 
@@ -162,21 +176,31 @@ def make_horseshoe(cfg: ModelConfig) -> Prior:
         lam_sq = Lam * Lam
         tau2 = state["tau2"]
 
-        lam2 = inverse_gamma_rate(
-            k1, 1.0, 1.0 / state["nu"] + 0.5 * lam_sq / tau2)
-        nu = inverse_gamma_rate(k2, 1.0, 1.0 + 1.0 / lam2)
+        lam2 = jnp.clip(inverse_gamma_rate(
+            k1, 1.0, 1.0 / state["nu"] + 0.5 * lam_sq / tau2),
+            _HS_TINY, _HS_HUGE)
+        nu = jnp.clip(inverse_gamma_rate(k2, 1.0, 1.0 + 1.0 / lam2),
+                      _HS_TINY, _HS_HUGE)
         # tau2's shape counts only loadings that exist: P per active column
         # (all K columns when adaptation is off); deactivated columns'
-        # lam_sq is 0 by masking, so the rate needs no correction.
+        # lam_sq is 0 by masking, so the rate needs no correction (their
+        # lam_sq/lam2 term is exactly 0 - lam2 is clamped above 0).
         n_act = float(K) if active is None else jnp.sum(active)
-        tau2 = inverse_gamma_rate(
+        tau2 = jnp.clip(inverse_gamma_rate(
             k3, 0.5 * (P * n_act + 1),
-            1.0 / state["xi"] + 0.5 * jnp.sum(lam_sq / lam2))
-        xi = inverse_gamma_rate(k4, 1.0, 1.0 / s2 + 1.0 / tau2)
+            1.0 / state["xi"] + 0.5 * jnp.sum(lam_sq / lam2)),
+            _HS_TINY, _HS_HUGE)
+        xi = jnp.clip(inverse_gamma_rate(k4, 1.0, 1.0 / s2 + 1.0 / tau2),
+                      _HS_TINY, _HS_HUGE)
         return {"lam2": lam2, "nu": nu, "tau2": tau2, "xi": xi}
 
     def row_precision(state):
-        return 1.0 / (state["lam2"] * state["tau2"])
+        # clamped like the DL prior's (see _DL_MAX_PRECISION): var floor
+        # 1e-12 is still "shrunk to zero" for standardized data, and the
+        # ceiling keeps the K x K Cholesky away from inf/0 diagonals for
+        # unanchored (deactivated) coordinates
+        return 1.0 / jnp.clip(state["lam2"] * state["tau2"],
+                              1.0 / _HS_MAX_PRECISION, _HS_MAX_PRECISION)
 
     def health(state):
         # |log tau^2|: global horseshoe scale collapse/blowup watch

@@ -136,6 +136,33 @@ def test_rank_adapt_dl_recovers_true_rank():
         assert np.all(Lam[m][:, act[m] == 0] == 0)
 
 
+def test_rank_adapt_horseshoe_recovers_true_rank():
+    """Horseshoe + rank adaptation - BASELINE config 5's exact prior/knob
+    combination, pinned at unit scale (pod scale runs it too).  Also the
+    regression test for a real NaN bug: a deactivated column's (lam2, nu)
+    auxiliary pair free-runs the half-Cauchy prior with no data anchor,
+    walked lam2 to f32 underflow (exactly 0), and the tau2 rate then
+    computed 0/0 - the horseshoe state clamps in models/priors.py keep
+    the unanchored loop inside float32."""
+    k_true = 2
+    Y, St = make_synthetic(200, 48, k_true, seed=43)
+    cfg = FitConfig(
+        model=ModelConfig(num_shards=2, factors_per_shard=2 * k_true, rho=0.9,
+                          prior="horseshoe", rank_adapt=True,
+                          adapt=AdaptConfig(a0=-0.5, a1=-2e-3, eps=0.15,
+                                            prop=0.9)),
+        run=RunConfig(burnin=600, mcmc=200, thin=1, seed=0))
+    res = fit(Y, cfg)
+    assert res.stats.nonfinite_count == 0
+    assert res.stats.rank_mean <= k_true + 1.0
+    assert res.stats.rank_min >= 1
+    assert _rel_frob(res.Sigma, St) < 0.35
+    act = np.asarray(res.state.active)
+    Lam = np.asarray(res.state.Lambda)
+    for m in range(act.shape[0]):
+        assert np.all(Lam[m][:, act[m] == 0] == 0)
+
+
 def test_rank_adapt_mesh_matches_vmap():
     """Adaptation is per-shard-local; the mesh layout must reproduce the
     single-device chain bitwise, mask included."""
