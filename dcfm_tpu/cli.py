@@ -136,6 +136,10 @@ def main(argv=None) -> int:
     initialize_from_env()
 
     Y = _load(args.data)
+    if args.imputed_out and not np.isnan(Y).any():
+        # fail BEFORE the fit, not after a multi-minute chain has run
+        raise SystemExit("--imputed-out set but Y has no missing (NaN) "
+                         "entries")
     if args.factors % args.shards:
         raise SystemExit(
             f"--factors {args.factors} must be divisible by --shards "
@@ -178,12 +182,8 @@ def main(argv=None) -> int:
         np.save(args.out, Sigma)
     if args.draws_out and write_files:
         np.savez(args.draws_out, **res.draws)
-    if args.imputed_out:
-        if res.Y_imputed is None:
-            raise SystemExit("--imputed-out set but Y has no missing "
-                             "(NaN) entries")
-        if write_files:
-            np.save(args.imputed_out, res.Y_imputed)
+    if args.imputed_out and write_files:
+        np.save(args.imputed_out, res.Y_imputed)
     sd_out = None
     if res.Sigma_sd is not None:
         root, ext = os.path.splitext(args.out)
