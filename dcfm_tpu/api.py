@@ -47,9 +47,11 @@ from dcfm_tpu.utils.checkpoint import (
     save_checkpoint, save_checkpoint_multiprocess)
 from dcfm_tpu import native
 from dcfm_tpu.utils.estimate import (
-    assemble_from_upper, assembly_maps, extract_upper_blocks,
-    full_blocks_from_upper, upper_pair_indices)
-from dcfm_tpu.utils.preprocess import PreprocessResult, preprocess
+    assemble_from_upper, assembly_maps, draw_covariance_entries,
+    extract_upper_blocks, full_blocks_from_upper, upper_pair_indices)
+from dcfm_tpu.utils.preprocess import (
+    PreprocessResult, caller_to_shard_index, preprocess,
+    restore_data_matrix)
 
 
 @dataclasses.dataclass
@@ -147,8 +149,6 @@ class FitResult:
         """
         if self.draws is None:
             raise ValueError("run with RunConfig(store_draws=True)")
-        from dcfm_tpu.utils.estimate import draw_covariance_entries
-        from dcfm_tpu.utils.preprocess import caller_to_shard_index
         rows = np.asarray(rows, np.int64)
         cols = np.asarray(cols, np.int64)
         rows, cols = np.broadcast_arrays(rows, cols)
@@ -720,7 +720,6 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
 
     Y_imputed = None
     if carry.y_imp_acc is not None:
-        from dcfm_tpu.utils.preprocess import restore_data_matrix
         yi = np.asarray(jax.device_get(
             _replicate_jit(mesh)(carry.y_imp_acc) if multiproc
             else carry.y_imp_acc), np.float32)

@@ -129,7 +129,7 @@ def _lam_rows_kernel(e_ref, pk_ref, out_ref, *, K: int):
     All refs are rank-2 with 8-aligned sublane counts (Mosaic's block
     constraint; leading-singleton rank-3 blocks also measured ~40x slower
     per grid step): e_ref (Kr, K) zero-row-padded, pk_ref (Kp, TILE)
-    packing [plam; ey; z; ps] row-slabs, out (K8, TILE).
+    packing [plam; ey; z; ps] row-slabs, out (Kr, TILE).
     """
     plam_ref = pk_ref[0:K, :]                            # (K, TILE)
     ey_ref = pk_ref[K:2 * K, :]
@@ -238,7 +238,6 @@ def _lam_update_jit(E, plam, ps, EYt, Zn, interpret, tile):
     # (Kp = 3K+1 rounded up to 8), and E pads its rows to Kr = 8-aligned.
     Kp = ((3 * K + 1 + 7) // 8) * 8
     Kr = ((K + 7) // 8) * 8
-    K8 = Kr
     packed = jnp.concatenate([
         jnp.transpose(plam, (0, 2, 1)),                  # rows 0..K-1
         jnp.transpose(EYt, (0, 2, 1)),                   # rows K..2K-1
@@ -257,12 +256,12 @@ def _lam_update_jit(E, plam, ps, EYt, Zn, interpret, tile):
             pl.BlockSpec((Kp, tile), lambda g, t: (g, t),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((K8, tile), lambda g, t: (g, t),
+        out_specs=pl.BlockSpec((Kr, tile), lambda g, t: (g, t),
                                memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((G * K8, Pp), dtype),
+        out_shape=jax.ShapeDtypeStruct((G * Kr, Pp), dtype),
         interpret=interpret,
     )(E_flat, packed)
-    return jnp.transpose(out.reshape(G, K8, Pp)[:, :K, :P],
+    return jnp.transpose(out.reshape(G, Kr, Pp)[:, :K, :P],
                          (0, 2, 1))                      # (G, P, K)
 
 
