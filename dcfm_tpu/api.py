@@ -45,10 +45,9 @@ from dcfm_tpu.utils.checkpoint import (
     checkpoint_compatible, data_fingerprint, load_checkpoint,
     load_checkpoint_multiprocess, proc_path, read_checkpoint_meta,
     save_checkpoint, save_checkpoint_multiprocess)
-from dcfm_tpu import native
 from dcfm_tpu.utils.estimate import (
-    assemble_from_upper, assembly_maps, draw_covariance_entries,
-    extract_upper_blocks, full_blocks_from_upper, upper_pair_indices)
+    assemble_from_q8, assemble_from_upper, dequantize_panels,
+    draw_covariance_entries, extract_upper_blocks, full_blocks_from_upper)
 from dcfm_tpu.utils.preprocess import (
     PreprocessResult, caller_to_shard_index, preprocess,
     restore_data_matrix)
@@ -59,11 +58,6 @@ class FitResult:
     Sigma: np.ndarray              # (p, p) posterior-mean covariance in the
                                    # caller's coordinates (de-permuted,
                                    # de-standardized, zero cols reinserted)
-    # (g(g+1)/2, P, P) upper-triangle block panels as fetched from the
-    # device (chain-averaged); the dense (g, g, P, P) grid is derived
-    # lazily via .sigma_blocks - at p=50k the grid is ~10 GB that most
-    # callers never need.
-    upper_panels: np.ndarray
     preprocess: PreprocessResult
     state: Any                     # final SamplerState (host pytree); leaves
                                    # gain a leading chain axis if num_chains>1
@@ -84,11 +78,11 @@ class FitResult:
     # "chain_s", "fetch_s", "assemble_s"}.  On a tunneled device the fetch
     # is usually the dominant term and fluctuates with link bandwidth;
     # separating it from chain_s is what distinguishes a code regression
-    # from link weather.  assemble_s is host CPU time only - in quant8
-    # mode the native assembler runs inside the transfer's shadow, so it
-    # does not add to wall-clock on top of fetch_s.  init_s covers state
-    # init or checkpoint load (incl. the init executable load on a
-    # tunneled device).
+    # from link weather.  assemble_s is host CPU wall-clock after the
+    # fetch (the output-row-major native assembler, ~0.3 s at p=10k in
+    # quant8 mode - dequant folded in, so no separate dequant pass).
+    # init_s covers state init or checkpoint load (incl. the init
+    # executable load on a tunneled device).
     phase_seconds: Optional[dict] = None
     # (p, p) entrywise posterior standard deviation of the covariance, in
     # the caller's coordinates; set when ModelConfig.posterior_sd is on.
@@ -106,11 +100,31 @@ class FitResult:
     # covariance_credible_interval.
     draws: Optional[dict] = None
     # (n, p) posterior-mean completed data matrix, set when the input had
-    # missing (NaN) entries: observed entries are the caller's EXACT
-    # values, NaN positions hold the average of the per-sweep imputation
+    # missing (NaN) entries: observed entries are the caller's values
+    # (float32), NaN positions hold the average of the per-sweep imputation
     # draws over saved draws (chains pooled), mapped back to the caller's
     # coordinates and scale.
     Y_imputed: Optional[np.ndarray] = None
+    # Backing storage for the lazy .upper_panels property: exactly one of
+    # _upper_f32 (full-precision fetch paths) or the (_q8_panels,
+    # _q8_scales) pair (default quant8 fetch) is set.  Keeping the int8
+    # panels + per-panel scales instead of dequantized float32 is 4x less
+    # memory AND removes a ~p^2/2-entry dequant write from the fit() hot
+    # path - Sigma is assembled straight from the int8 slices by the
+    # native one-pass assembler, so most callers never pay the dequant.
+    _upper_f32: Optional[np.ndarray] = None
+    _q8_panels: Optional[np.ndarray] = None
+    _q8_scales: Optional[np.ndarray] = None
+
+    @functools.cached_property
+    def upper_panels(self) -> np.ndarray:
+        """(g(g+1)/2, P, P) float32 upper-triangle block panels as fetched
+        from the device (chain-averaged).  Under the default quant8 fetch
+        the panels are stored int8 and dequantized here on first access;
+        the dense (g, g, P, P) grid is derived lazily via .sigma_blocks."""
+        if self._upper_f32 is not None:
+            return self._upper_f32
+        return dequantize_panels(self._q8_panels, self._q8_scales)
 
     @functools.cached_property
     def sigma_blocks(self) -> np.ndarray:
@@ -289,23 +303,18 @@ def _upload_host_array(data: np.ndarray, upload_dtype: str) -> np.ndarray:
     return data.astype(ml_dtypes.bfloat16)
 
 
-def _quant8_fetch_assemble(q_dev, scale_dev, g: int, pre: PreprocessResult,
-                           n_slices: int = 8):
-    """Streamed quantized fetch: dequantize to the float32 upper panels
-    (the FitResult contract) and scatter each slice into the final
-    covariance while later slices are still crossing the link.
+def _quant8_fetch(q_dev, scale_dev, n_slices: int = 8):
+    """Pipelined quantized fetch: pull the int8 panels to host in slices
+    with every ``copy_to_host_async`` issued up front, so the link stays
+    saturated while each arrived slice is memcpy'd into place.
 
     The device->host transfer is the wall-clock bottleneck of a real fit
-    (the panels are ~p^2/2 entries); slicing the quantized array and
-    issuing ``copy_to_host_async`` for every slice up front lets the native
-    int8 assembler (dcfm_tpu/native: dequant folded into the one-pass
-    scatter) run entirely in the transfer's shadow.
+    (the panels are ~p^2/2 entries); assembly itself is NOT overlapped with
+    the transfer anymore - the output-row-major native assembler needs the
+    full canonical panel set and is fast enough (~0.3 s at p=10k, vs ~7 s
+    for the old streamed per-entry scatter) that hiding it buys nothing.
 
-    Returns (upper_f32, Sigma-or-None, timing); None means the native
-    library is unavailable and the caller should assemble from
-    ``upper_f32``.  ``timing`` splits the drain into {"fetch_s"} (blocked
-    waiting on the link) and {"assemble_s"} (host CPU in dequant +
-    assembly, which runs in the next slice's transfer shadow).
+    Returns (q_host int8 (n_pairs, P, P), scales (n_pairs,), fetch_s).
     """
     scales = np.asarray(scale_dev)                   # (n_pairs,) tiny
     n_pairs, P, _ = q_dev.shape
@@ -313,31 +322,14 @@ def _quant8_fetch_assemble(q_dev, scale_dev, g: int, pre: PreprocessResult,
     slices = [q_dev[a:b] for a, b in zip(bounds[:-1], bounds[1:]) if b > a]
     for s in slices:
         s.copy_to_host_async()
-    r, c = upper_pair_indices(g)
-    upper = np.empty((n_pairs, P, P), np.float32)
-    out = None
-    if native.available():
-        col_scale, out_map, p_out = assembly_maps(
-            pre, g, P, destandardize=True, reinsert_zero_cols=True)
-        out = np.zeros((p_out, p_out), np.float32)
-    ok = out is not None
+    q_host = np.empty((n_pairs, P, P), np.int8)
     pos = 0
-    fetch_s = assemble_s = 0.0
+    t = time.perf_counter()
     for s in slices:
-        t = time.perf_counter()
         qh = np.asarray(s)                           # waits for this slice
-        fetch_s += time.perf_counter() - t
-        a, b = pos, pos + qh.shape[0]
-        sc = scales[a:b]
-        t = time.perf_counter()
-        upper[a:b] = qh.astype(np.float32) * (sc[:, None, None] / 127.0)
-        if ok:
-            ok = native.assemble_q8_partial(
-                qh, sc, r[a:b], c[a:b], col_scale, out_map, out)
-        assemble_s += time.perf_counter() - t
-        pos = b
-    timing = {"fetch_s": fetch_s, "assemble_s": assemble_s}
-    return upper, (out if ok else None), timing
+        q_host[pos:pos + qh.shape[0]] = qh
+        pos += qh.shape[0]
+    return q_host, scales, time.perf_counter() - t
 
 
 def _diagnose(trace_arr: np.ndarray, done: int, run: RunConfig) -> dict:
@@ -675,8 +667,8 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
     inv_count = np.float32(1.0 / max(n_saved, 1))
 
     def _fetch_upper(acc):
-        # non-quant8 modes only; the quant8 fetch goes through the streamed
-        # _quant8_fetch_assemble path below (single home for the dequant).
+        # non-quant8 modes only; the quant8 fetch goes through
+        # _quant8_fetch + utils/estimate.assemble_from_q8 below.
         out = _fetch_jit(m.num_shards, C, fetch_mode, fetch_mesh)(
             acc, inv_count)
         return np.asarray(out).astype(np.float32, copy=False)
@@ -685,19 +677,25 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
     # with zero rows/cols for all-zero input columns (variance of a constant
     # is 0) - indices never shift (the reference's Q7 drops them silently).
     # assemble_from_upper: the native one-pass conquer assembler (NumPy
-    # fallback inside).  The quant8 path streams: assembly of slice k runs
-    # while slice k+1 is still on the device->host link.
+    # fallback inside).  The quant8 path assembles Sigma STRAIGHT from the
+    # int8 panels (dequant folded into the native pass); the float32 upper
+    # panels exist only lazily behind FitResult.upper_panels.
+    upper = q8_panels = q8_scales = None
     if fetch_mode == "quant8":
         q_dev, scale_dev = _fetch_jit(m.num_shards, C, "quant8", fetch_mesh)(
             carry.sigma_acc, inv_count)
-        upper, Sigma, f_timing = _quant8_fetch_assemble(
-            q_dev, scale_dev, m.num_shards, pre)
-        phase["fetch_s"] += f_timing["fetch_s"]
-        phase["assemble_s"] += f_timing["assemble_s"]
+        q8_panels, q8_scales, fetch_s = _quant8_fetch(q_dev, scale_dev)
+        phase["fetch_s"] += fetch_s
+        t_as = time.perf_counter()
+        Sigma = assemble_from_q8(q8_panels, q8_scales, pre,
+                                 destandardize=True, reinsert_zero_cols=True)
         if Sigma is None:
-            t_as = time.perf_counter()
+            # no native library: dequantize once and keep the f32 panels
+            # as the FitResult backing store (they exist anyway)
+            upper = dequantize_panels(q8_panels, q8_scales)
+            q8_panels = q8_scales = None
             Sigma = assemble_from_upper(upper, pre, reinsert_zero_cols=True)
-            phase["assemble_s"] += time.perf_counter() - t_as
+        phase["assemble_s"] += time.perf_counter() - t_as
     else:
         t_f = time.perf_counter()
         upper = _fetch_upper(carry.sigma_acc)
@@ -719,7 +717,11 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
             draws["H"] = np.asarray(d.H)
 
     Y_imputed = None
-    if carry.y_imp_acc is not None:
+    # gated on the input actually having NaN entries: a user may force
+    # impute_missing=True on complete data (the carry then has the
+    # accumulator leaf), but the FitResult contract is "set when the input
+    # had missing entries"
+    if carry.y_imp_acc is not None and pre.n_missing:
         yi = np.asarray(jax.device_get(
             _replicate_jit(mesh)(carry.y_imp_acc) if multiproc
             else carry.y_imp_acc), np.float32)
@@ -754,7 +756,9 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
 
     return FitResult(
         Sigma=Sigma,
-        upper_panels=upper,
+        _upper_f32=upper,
+        _q8_panels=q8_panels,
+        _q8_scales=q8_scales,
         preprocess=pre,
         state=state,
         stats=stats,

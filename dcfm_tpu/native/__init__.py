@@ -2,8 +2,8 @@
 
 The TPU compute path is JAX/XLA; the host-side runtime around it is native
 where it is hot: the final covariance assembly (utils/estimate.py) is a
-memory-bound O(p^2) scatter that NumPy needs four passes for and this
-extension does in one (see assemble.cpp).
+memory-bound O(p^2) stitch that NumPy needs four passes for and this
+extension does in one output-row-major pass (see assemble.cpp).
 
 Build model: zero-dependency on-demand compilation.  pybind11 is not
 available in the image, so the extension is a plain ``extern "C"`` shared
@@ -60,14 +60,17 @@ def _load() -> Optional[ctypes.CDLL]:
                     if os.path.exists(tmp):
                         os.unlink(tmp)
             lib = ctypes.CDLL(_LIB)
-            fn = lib.assemble_covariance
+            # "_rowmajor" names version the ABI: a stale prebuilt .so with
+            # the older argument lists fails the lookup here and degrades
+            # to the NumPy path instead of segfaulting through a
+            # mismatched signature.
+            fn = lib.assemble_covariance_rowmajor
             fn.restype = None
             fn.argtypes = [
                 ctypes.POINTER(ctypes.c_float),   # upper
                 ctypes.c_int64,                   # n_pairs
                 ctypes.c_int64,                   # P
-                ctypes.POINTER(ctypes.c_int32),   # r_idx
-                ctypes.POINTER(ctypes.c_int32),   # c_idx
+                ctypes.c_int64,                   # g
                 ctypes.POINTER(ctypes.c_float),   # scale
                 ctypes.POINTER(ctypes.c_int64),   # map
                 ctypes.POINTER(ctypes.c_float),   # out
@@ -77,15 +80,14 @@ def _load() -> Optional[ctypes.CDLL]:
             # quantized path must keep the float32 assembler usable - only
             # the q8 entry degrades to the NumPy fallback.
             try:
-                fnq = lib.assemble_covariance_q8
+                fnq = lib.assemble_covariance_q8_rowmajor
                 fnq.restype = None
                 fnq.argtypes = [
                     ctypes.POINTER(ctypes.c_int8),    # upper (quantized)
                     ctypes.POINTER(ctypes.c_float),   # panel_scale
                     ctypes.c_int64,                   # n_pairs
                     ctypes.c_int64,                   # P
-                    ctypes.POINTER(ctypes.c_int32),   # r_idx
-                    ctypes.POINTER(ctypes.c_int32),   # c_idx
+                    ctypes.c_int64,                   # g
                     ctypes.POINTER(ctypes.c_float),   # scale
                     ctypes.POINTER(ctypes.c_int64),   # map
                     ctypes.POINTER(ctypes.c_float),   # out
@@ -108,18 +110,29 @@ def _ptr(a: np.ndarray, ctype):
     return a.ctypes.data_as(ctypes.POINTER(ctype))
 
 
+def g_from_pairs(n_pairs: int) -> int:
+    """Invert n_pairs = g(g+1)/2, validating that n_pairs is a full
+    upper triangle (the single home for this derivation)."""
+    g = int(round((np.sqrt(8 * n_pairs + 1) - 1) / 2))
+    if n_pairs != g * (g + 1) // 2:
+        raise ValueError(
+            f"{n_pairs} pairs is not a full upper triangle (g={g})")
+    return g
+
+
 def assemble_covariance(
     upper: np.ndarray,
-    r_idx: np.ndarray,
-    c_idx: np.ndarray,
     scale: np.ndarray,
     out_map: np.ndarray,
     p_out: int,
 ) -> Optional[np.ndarray]:
     """One-pass upper-panels -> final (p_out, p_out) covariance.
 
-    Returns None when the native library is unavailable (callers fall back
-    to the NumPy path).  See assemble.cpp for the argument contract.
+    ``upper`` must hold the FULL g(g+1)/2 upper-triangle panel set in
+    jnp.triu_indices order (utils/estimate.extract_upper_blocks output) -
+    the row-major kernel derives each pair's (r, c) from that canonical
+    order.  Returns None when the native library is unavailable (callers
+    fall back to the NumPy path).  See assemble.cpp for the contract.
     """
     lib = _load()
     if lib is None:
@@ -127,13 +140,8 @@ def assemble_covariance(
     n_pairs, P, P2 = upper.shape
     if P != P2:
         raise ValueError(f"upper blocks must be square, got {upper.shape}")
-    g = int(r_idx.max()) + 1 if n_pairs else 0
-    if n_pairs != g * (g + 1) // 2:
-        raise ValueError(
-            f"{n_pairs} pairs is not a full upper triangle (g={g})")
+    g = g_from_pairs(n_pairs)
     upper = np.ascontiguousarray(upper, np.float32)
-    r_idx = np.ascontiguousarray(r_idx, np.int32)
-    c_idx = np.ascontiguousarray(c_idx, np.int32)
     scale = np.ascontiguousarray(scale, np.float32)
     out_map = np.ascontiguousarray(out_map, np.int64)
     if scale.shape != (g * P,) or out_map.shape != (g * P,):
@@ -142,64 +150,58 @@ def assemble_covariance(
     if out_map.max() >= p_out:
         raise ValueError("map index beyond p_out")
     out = np.zeros((p_out, p_out), np.float32)
-    lib.assemble_covariance(
-        _ptr(upper, ctypes.c_float), n_pairs, P,
-        _ptr(r_idx, ctypes.c_int32), _ptr(c_idx, ctypes.c_int32),
+    lib.assemble_covariance_rowmajor(
+        _ptr(upper, ctypes.c_float), n_pairs, P, g,
         _ptr(scale, ctypes.c_float), _ptr(out_map, ctypes.c_int64),
         _ptr(out, ctypes.c_float), p_out)
     return out
 
 
-def assemble_q8_partial(
+def assemble_q8(
     q_panels: np.ndarray,
     panel_scale: np.ndarray,
-    r_idx: np.ndarray,
-    c_idx: np.ndarray,
     scale: np.ndarray,
     out_map: np.ndarray,
     out: np.ndarray,
 ) -> bool:
-    """Scatter a SUBSET of int8-quantized panels into a caller-owned output.
+    """Assemble the final covariance STRAIGHT from int8-quantized panels.
 
-    Streaming building block: api.fit fetches the quantized accumulator in
-    slices and assembles each while the next is still on the link.  The
-    dequantization (entry * panel_scale/127) folds into the same pass.
-    ``out`` must be a pre-zeroed C-contiguous (p_out, p_out) float32 array,
-    shared across calls.  Returns False when the native library is
-    unavailable (caller falls back to the NumPy path).
+    The dequantization (entry * panel_scale/127) folds into the same
+    output-row-major pass as the stitch/de-permute/de-standardize, so the
+    default quant8 fetch path never materializes the float32 panels
+    (api.FitResult.upper_panels dequantizes lazily only if accessed).
+    ``q_panels`` must be the FULL canonical triu panel set; ``out`` must be
+    a pre-zeroed C-contiguous (p_out, p_out) float32 array.  Returns False
+    when the native library is unavailable (caller falls back to the NumPy
+    dequant + assemble path).
     """
     lib = _load()
-    if lib is None or not hasattr(lib, "assemble_covariance_q8"):
+    if lib is None or not hasattr(lib, "assemble_covariance_q8_rowmajor"):
         return False
     n_pairs, P, P2 = q_panels.shape
     if P != P2:
         raise ValueError(f"panels must be square, got {q_panels.shape}")
     if q_panels.dtype != np.int8:
         raise ValueError(f"expected int8 panels, got {q_panels.dtype}")
+    g = g_from_pairs(n_pairs)
     if not (out.flags.c_contiguous and out.dtype == np.float32
             and out.ndim == 2 and out.shape[0] == out.shape[1]):
         raise ValueError("out must be C-contiguous square float32")
     if panel_scale.shape != (n_pairs,):
         raise ValueError(
             f"panel_scale must be ({n_pairs},), got {panel_scale.shape}")
-    if len(r_idx) != n_pairs or len(c_idx) != n_pairs:
-        raise ValueError("r_idx/c_idx must have one entry per panel")
     q_panels = np.ascontiguousarray(q_panels, np.int8)
     panel_scale = np.ascontiguousarray(panel_scale, np.float32)
-    r_idx = np.ascontiguousarray(r_idx, np.int32)
-    c_idx = np.ascontiguousarray(c_idx, np.int32)
     scale = np.ascontiguousarray(scale, np.float32)
     out_map = np.ascontiguousarray(out_map, np.int64)
-    g = int(max(r_idx.max(), c_idx.max())) + 1 if n_pairs else 0
-    if scale.shape[0] < g * P or out_map.shape[0] < g * P:
+    if scale.shape != (g * P,) or out_map.shape != (g * P,):
         raise ValueError(
-            f"scale/map too short for shard index {g - 1} at P={P}")
+            f"scale/map must be ({g * P},), got {scale.shape}/{out_map.shape}")
     if out_map.max() >= out.shape[0]:
         raise ValueError("map index beyond out")
-    lib.assemble_covariance_q8(
+    lib.assemble_covariance_q8_rowmajor(
         _ptr(q_panels, ctypes.c_int8), _ptr(panel_scale, ctypes.c_float),
-        n_pairs, P,
-        _ptr(r_idx, ctypes.c_int32), _ptr(c_idx, ctypes.c_int32),
+        n_pairs, P, g,
         _ptr(scale, ctypes.c_float), _ptr(out_map, ctypes.c_int64),
         _ptr(out, ctypes.c_float), out.shape[0])
     return True

@@ -7,126 +7,128 @@
 // permutation (quirk Q5), undo the per-column standardization, and
 // re-insert zero columns (quirk Q7).  In NumPy that is four O(p^2)
 // memory-bound passes (mirror, transpose-stitch, scale, gather/scatter) -
-// ~6 s at p=10k on this host.  This translation unit does all of it in ONE
-// pass over the fetched panels: each upper block entry is read once,
-// scaled, and scattered (with its symmetric mirror) straight into its
-// final position.
+// ~6 s at p=10k on this host.
+//
+// Loop order is the whole design.  A naive scatter walks the panels and
+// writes each entry to its final position AND its transposed mirror; under
+// the feature permutation the mirror store strides across the entire
+// (p_out, p_out) output, so nearly every 4-byte write misses cache and TLB
+// (~5 s at p=10k, measured - it was the largest line in the round-3 bench).
+// Here the loops run OUTPUT-ROW-major instead: for each source shard r and
+// local row i, the full output row is produced in one visit by walking all
+// g panels that touch shard r (pair (min(r,c), max(r,c)) is recomputed from
+// the canonical upper-triangle order, so no mirror store is ever needed).
+// Writes stay inside one ~4*p_out-byte row (cache-resident) and the g
+// panels touched repeat across the P rows of shard r, so the read working
+// set (~g*P*P elements) lives in L2/L3.  Entry math per element is
+// identical to the one-pass scatter; only the store pattern changed.
 //
 // Shapes/contracts (all row-major, caller-validated in native/__init__.py):
-//   upper:  (n_pairs, P, P) float32, pair k holds block (r_idx[k], c_idx[k])
-//           with r_idx[k] <= c_idx[k] (jnp.triu_indices order).
+//   upper:  (n_pairs, P, P), pair k holds block (r_k, c_k) with r_k <= c_k
+//           in jnp.triu_indices order (k = r*g - r(r-1)/2 + (c-r)), which
+//           is exactly what utils/estimate.extract_upper_blocks fetches.
 //   scale:  (g*P,) float32 per-shard-coordinate de-standardization scales
 //           (all ones when destandardize is off).
 //   map:    (g*P,) int64: shard coordinate -> output row/col, -1 = dropped
 //           (padding columns, quirk Q6).
 //   out:    (p_out, p_out) float32, pre-zeroed by the caller.
 //
-// Diagonal blocks (r == c) are averaged with their transpose so the output
-// is exactly symmetric (the reference re-symmetrizes every accumulation,
-// divideconquer.m:195; here symmetry is by construction).
+// Exact symmetry by construction: entry (i, j) and its mirror (j, i) read
+// the same panel element (or, on diagonal blocks, the commutative sum
+// blk[ij] + blk[ji]) and multiply by the commutative product
+// scale_i * scale_j in an association-identical order, so the two IEEE
+// results are bit-equal without a symmetrization pass (the reference
+// re-symmetrizes every accumulation, divideconquer.m:195).
 
 #include <cstdint>
 
-extern "C" {
+namespace {
 
-// int8 variant: panels arrive max-abs quantized from the device (one
-// float32 scale per panel, entries in [-127, 127] - see api._fetch_jit).
-// Dequantization folds into the same single pass: entry * panel_scale/127
-// * row_scale * col_scale, so the quantized fetch never needs a separate
-// host-side dequant sweep before assembly.  Callable on any subset of
-// pairs (streaming: overlap link transfer of slice k+1 with assembly of
-// slice k); `out` is caller-allocated and pre-zeroed once.
-void assemble_covariance_q8(
-    const int8_t* upper,
-    const float* panel_scale,
-    int64_t n_pairs,
-    int64_t P,
-    const int32_t* r_idx,
-    const int32_t* c_idx,
-    const float* scale,
-    const int64_t* map,
-    float* out,
-    int64_t p_out) {
+// T = float (full-precision panels, panel_scale == nullptr) or int8_t
+// (max-abs quantized panels, one float32 scale per panel - see
+// api._fetch_jit; dequantization entry * panel_scale/127 folds into the
+// same pass, so the quantized fetch never needs a host-side dequant sweep).
+template <typename T>
+void assemble_rowmajor(const T* upper, const float* panel_scale,
+                       int64_t n_pairs, int64_t P, int64_t g,
+                       const float* scale, const int64_t* map, float* out,
+                       int64_t p_out) {
   const int64_t PP = P * P;
-  for (int64_t k = 0; k < n_pairs; ++k) {
-    const int8_t* blk = upper + k * PP;
-    const float pscale = panel_scale[k] / 127.0f;
-    const int64_t br = static_cast<int64_t>(r_idx[k]) * P;
-    const int64_t bc = static_cast<int64_t>(c_idx[k]) * P;
-    const bool diag = r_idx[k] == c_idx[k];
+  (void)n_pairs;
+  for (int64_t r = 0; r < g; ++r) {
+    const int64_t br = r * P;
     for (int64_t i = 0; i < P; ++i) {
       const int64_t mi = map[br + i];
       if (mi < 0) continue;
-      const float si = scale[br + i] * pscale;
-      const int8_t* row = blk + i * P;
+      const float si = scale[br + i];
       float* out_row = out + mi * p_out;
-      if (diag) {
-        for (int64_t j = i; j < P; ++j) {
-          const int64_t mj = map[bc + j];
-          if (mj < 0) continue;
-          const float v = 0.5f *
-              (static_cast<float>(row[j]) + static_cast<float>(blk[j * P + i]))
-              * si * scale[bc + j];
-          out_row[mj] = v;
-          out[mj * p_out + mi] = v;
-        }
-      } else {
-        for (int64_t j = 0; j < P; ++j) {
-          const int64_t mj = map[bc + j];
-          if (mj < 0) continue;
-          const float v = static_cast<float>(row[j]) * si * scale[bc + j];
-          out_row[mj] = v;
-          out[mj * p_out + mi] = v;
+      for (int64_t c = 0; c < g; ++c) {
+        const int64_t a = r < c ? r : c;
+        const int64_t b = r < c ? c : r;
+        const int64_t k = a * g - a * (a - 1) / 2 + (b - a);
+        const T* blk = upper + k * PP;
+        const float ps =
+            panel_scale ? panel_scale[k] / 127.0f : 1.0f;
+        const int64_t bc = c * P;
+        if (c == r) {
+          // diagonal block: average with the transpose so float-level
+          // einsum asymmetry cannot leak into the output
+          for (int64_t j = 0; j < P; ++j) {
+            const int64_t mj = map[bc + j];
+            if (mj < 0) continue;
+            const float v = 0.5f * (static_cast<float>(blk[i * P + j]) +
+                                    static_cast<float>(blk[j * P + i]));
+            out_row[mj] = v * ps * (si * scale[bc + j]);
+          }
+        } else if (c > r) {
+          // we are the panel's row side: contiguous panel-row read
+          const T* row = blk + i * P;
+          for (int64_t j = 0; j < P; ++j) {
+            const int64_t mj = map[bc + j];
+            if (mj < 0) continue;
+            out_row[mj] = static_cast<float>(row[j]) * ps *
+                          (si * scale[bc + j]);
+          }
+        } else {
+          // we are the panel's column side: strided read, panel-resident
+          for (int64_t j = 0; j < P; ++j) {
+            const int64_t mj = map[bc + j];
+            if (mj < 0) continue;
+            out_row[mj] = static_cast<float>(blk[j * P + i]) * ps *
+                          (si * scale[bc + j]);
+          }
         }
       }
     }
   }
 }
 
-void assemble_covariance(
-    const float* upper,
-    int64_t n_pairs,
-    int64_t P,
-    const int32_t* r_idx,
-    const int32_t* c_idx,
-    const float* scale,
-    const int64_t* map,
-    float* out,
-    int64_t p_out) {
-  const int64_t PP = P * P;
-  for (int64_t k = 0; k < n_pairs; ++k) {
-    const float* blk = upper + k * PP;
-    const int64_t br = static_cast<int64_t>(r_idx[k]) * P;
-    const int64_t bc = static_cast<int64_t>(c_idx[k]) * P;
-    const bool diag = r_idx[k] == c_idx[k];
-    for (int64_t i = 0; i < P; ++i) {
-      const int64_t mi = map[br + i];
-      if (mi < 0) continue;
-      const float si = scale[br + i];
-      const float* row = blk + i * P;
-      float* out_row = out + mi * p_out;
-      if (diag) {
-        // upper triangle of the block only; average with the transpose so
-        // float-level einsum asymmetry cannot leak into the output
-        for (int64_t j = i; j < P; ++j) {
-          const int64_t mj = map[bc + j];
-          if (mj < 0) continue;
-          const float v =
-              0.5f * (row[j] + blk[j * P + i]) * si * scale[bc + j];
-          out_row[mj] = v;
-          out[mj * p_out + mi] = v;
-        }
-      } else {
-        for (int64_t j = 0; j < P; ++j) {
-          const int64_t mj = map[bc + j];
-          if (mj < 0) continue;
-          const float v = row[j] * si * scale[bc + j];
-          out_row[mj] = v;
-          out[mj * p_out + mi] = v;
-        }
-      }
-    }
-  }
+}  // namespace
+
+extern "C" {
+
+// "_rowmajor" symbol names version the ABI: the loader binds by name, so a
+// stale prebuilt _assemble.so from an older source (different argument
+// list under the same name) degrades to the NumPy fallback instead of
+// being called through a mismatched signature.
+void assemble_covariance_rowmajor(const float* upper, int64_t n_pairs,
+                                  int64_t P, int64_t g, const float* scale,
+                                  const int64_t* map, float* out,
+                                  int64_t p_out) {
+  assemble_rowmajor<float>(upper, nullptr, n_pairs, P, g, scale, map, out,
+                           p_out);
+}
+
+// int8 variant: Sigma is assembled STRAIGHT from the quantized panels -
+// the float32 upper panels never materialize on the default fetch path
+// (FitResult.upper_panels dequantizes lazily on first access).
+void assemble_covariance_q8_rowmajor(const int8_t* upper,
+                                     const float* panel_scale,
+                                     int64_t n_pairs, int64_t P, int64_t g,
+                                     const float* scale, const int64_t* map,
+                                     float* out, int64_t p_out) {
+  assemble_rowmajor<int8_t>(upper, panel_scale, n_pairs, P, g, scale, map,
+                            out, p_out);
 }
 
 }  // extern "C"

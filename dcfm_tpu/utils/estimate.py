@@ -114,13 +114,12 @@ def assemble_from_upper(
     order per entry) when the native library is unavailable.
     """
     n_pairs, P, _ = upper.shape
-    g = int(round((np.sqrt(8 * n_pairs + 1) - 1) / 2))
+    g = native.g_from_pairs(n_pairs)
     if native.available():
-        r, c = upper_pair_indices(g)
         scale, out_map, p_out = assembly_maps(
             pre, g, P, destandardize=destandardize,
             reinsert_zero_cols=reinsert_zero_cols)
-        out = native.assemble_covariance(upper, r, c, scale, out_map, p_out)
+        out = native.assemble_covariance(upper, scale, out_map, p_out)
         if out is not None:
             return out
     if g * P != pre.p_used:
@@ -130,6 +129,43 @@ def assemble_from_upper(
         stitch_blocks(full_blocks_from_upper(upper, g), symmetrize=False),
         pre, destandardize=destandardize,
         reinsert_zero_cols=reinsert_zero_cols)
+
+
+def dequantize_panels(q_panels: np.ndarray,
+                      panel_scale: np.ndarray) -> np.ndarray:
+    """int8 max-abs-quantized panels -> float32 (api._fetch_jit inverse):
+    entry * panel_scale/127, one scale per panel.  The single home for the
+    host-side dequant convention."""
+    return q_panels.astype(np.float32) * (
+        np.asarray(panel_scale, np.float32)[:, None, None] / 127.0)
+
+
+def assemble_from_q8(
+    q_panels: np.ndarray,
+    panel_scale: np.ndarray,
+    pre: PreprocessResult,
+    *,
+    destandardize: bool = True,
+    reinsert_zero_cols: bool = False,
+) -> Optional[np.ndarray]:
+    """Final covariance STRAIGHT from int8-quantized panels (native path).
+
+    The dequant folds into the native one-pass output-row-major assembly,
+    so the float32 panels never materialize.  Returns None when the native
+    q8 kernel is unavailable - the caller dequantizes
+    (:func:`dequantize_panels`) and uses :func:`assemble_from_upper`.
+    """
+    if not native.available():
+        return None
+    n_pairs, P, _ = q_panels.shape
+    g = native.g_from_pairs(n_pairs)
+    scale, out_map, p_out = assembly_maps(
+        pre, g, P, destandardize=destandardize,
+        reinsert_zero_cols=reinsert_zero_cols)
+    out = np.zeros((p_out, p_out), np.float32)
+    if native.assemble_q8(q_panels, panel_scale, scale, out_map, out):
+        return out
+    return None
 
 
 def _pool_chain_axis(draws: dict) -> dict:

@@ -134,7 +134,7 @@ def child_ext(process_id: int) -> None:
     """Multi-host chain extension: run a short schedule to completion with
     per-process checkpoints, then resume with a LONGER mcmc and verify the
     extended estimate matches an uninterrupted full-length run (the raw-sum
-    accumulators make this exact; utils/checkpoint.py format v4)."""
+    accumulators - utils/checkpoint.py format v3+ - make this exact)."""
     os.environ["XLA_FLAGS"] = (
         f"--xla_force_host_platform_device_count={DEVS_PER_PROC}")
     import jax

@@ -179,3 +179,6 @@ def test_complete_data_unchanged_by_feature():
     assert r1.preprocess.n_missing == 0
     r2 = fit(Y, _cfg(impute_missing=True))     # empty mask: where() no-ops
     np.testing.assert_array_equal(r1.sigma_blocks, r2.sigma_blocks)
+    # the FitResult contract is "Y_imputed set when the input had missing
+    # entries" - forcing the flag on complete data must not populate it
+    assert r2.Y_imputed is None

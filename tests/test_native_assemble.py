@@ -53,6 +53,48 @@ def test_native_matches_numpy(destd, reinsert):
     np.testing.assert_array_equal(got, got.T)   # exactly symmetric
 
 
+def test_native_q8_matches_dequant_then_assemble():
+    """The int8 fast path (dequant folded into the output-row-major pass)
+    must match dequantize-first + float32 assembly entry-for-entry."""
+    rng = np.random.default_rng(1)
+    g = 4
+    Y, _ = make_synthetic(30, 26, 2, seed=3)
+    Y[:, 11] = 0.0
+    pre = preprocess(Y, g, seed=0)
+    P = pre.p_used // g
+    n_pairs = g * (g + 1) // 2
+    q = rng.integers(-127, 128, size=(n_pairs, P, P)).astype(np.int8)
+    pscale = rng.uniform(0.1, 3.0, size=n_pairs).astype(np.float32)
+    from dcfm_tpu.utils.estimate import assembly_maps
+    scale, out_map, p_out = assembly_maps(
+        pre, g, P, destandardize=True, reinsert_zero_cols=True)
+    out = np.zeros((p_out, p_out), np.float32)
+    assert native.assemble_q8(q, pscale, scale, out_map, out)
+    upper = q.astype(np.float32) * (pscale[:, None, None] / 127.0)
+    want = assemble_from_upper(upper, pre, destandardize=True,
+                               reinsert_zero_cols=True)
+    np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-6)
+    np.testing.assert_array_equal(out, out.T)   # exactly symmetric
+
+
+def test_lazy_upper_panels_quant8_fit():
+    """A quant8 fit stores int8 panels; .upper_panels dequantizes lazily
+    and the derived covariance matches Sigma (assembled straight from
+    int8) to quantization accuracy."""
+    from dcfm_tpu import BackendConfig, FitConfig, ModelConfig, RunConfig, fit
+
+    Y, _ = make_synthetic(40, 22, 2, seed=7)
+    res = fit(Y, FitConfig(
+        model=ModelConfig(num_shards=2, factors_per_shard=2, rho=0.7),
+        run=RunConfig(burnin=15, mcmc=15, thin=1, seed=0),
+        backend=BackendConfig(fetch_dtype="quant8")))
+    assert res._q8_panels is not None and res._q8_panels.dtype == np.int8
+    assert "upper_panels" not in res.__dict__   # not yet materialized
+    want = res.covariance(destandardize=True, reinsert_zero_cols=True)
+    assert "upper_panels" in res.__dict__       # lazy dequant ran once
+    np.testing.assert_allclose(res.Sigma, want, rtol=1e-5, atol=1e-6)
+
+
 def test_native_end_to_end_in_fit():
     """fit() routes through the assembler; the result must match the
     sigma_blocks-based covariance() method (the NumPy path)."""
