@@ -131,10 +131,11 @@ def main():
         "seconds": round(seconds, 2),
         # Phase split (FitResult.phase_seconds): chain_s is the Gibbs
         # compute (the code under test), fetch_s is the device->host panel
-        # transfer (rides the tunnel - see tunnel_MBps), assemble_s is host
-        # CPU that in quant8 mode runs inside the transfer's shadow.
-        # Round-over-round regressions should be judged on chain_s;
-        # fetch_s/upload_s swings track tunnel_MBps.
+        # transfer (rides the tunnel - see tunnel_MBps), assemble_s is
+        # real host CPU wall-clock after the fetch (~0.33 s at this shape:
+        # the output-row-major int8->Sigma native pass).  Round-over-round
+        # regressions should be judged on chain_s (gated below) and
+        # assemble_s; fetch_s/upload_s swings track tunnel_MBps.
         "chain_s": round(res.phase_seconds["chain_s"], 2),
         "upload_s": round(res.phase_seconds["upload_s"], 2),
         "fetch_s": round(res.phase_seconds["fetch_s"], 2),
@@ -144,14 +145,31 @@ def main():
         "tunnel_MBps": round(tunnel_mbps, 2),
     }
     print(json.dumps(result))
-    # Accuracy guard: speed cannot be bought with a broken sampler.  The
-    # CPU-baseline anchors (BASELINE.md: twin err 0.10-0.23, observed here
-    # ~0.12) put a healthy run well under 0.3; beyond that is regression.
-    if not np.isfinite(err) or err > 0.3:
-        print(f"ACCURACY REGRESSION: rel frob err {err:.3f} > 0.3",
+    # Regression gates - this script exits non-zero so the driver FAILS on
+    # a real compute regression instead of recording it as tunnel weather:
+    # * accuracy: healthy runs measure 0.118 at this shape (twin anchors
+    #   0.095-0.227 at other shapes, BASELINE.md); 0.18 = 1.5x the
+    #   measured value, so a sampler degraded by ~50%+ fails loudly.
+    # * chain_s: the Gibbs compute is the code under test and does NOT
+    #   ride the tunnel; measured 1.36-1.45 s across rounds 3-4, so 2.5 s
+    #   (~1.8x) means the sweep or the accumulation genuinely regressed.
+    # The tight bounds only hold at the default north-star shape; an env-
+    # overridden quick run (e.g. BENCH_ITERS=100 sanity checks) keeps the
+    # loose accuracy guard and skips the chain_s budget.
+    default_shape = (P_TOTAL, G, N, K_TOTAL, ITERS) == (
+        10_000, 64, 500, 512, 1000)
+    err_bound = 0.18 if default_shape else 0.3
+    status = 0
+    if not np.isfinite(err) or err > err_bound:
+        print(f"ACCURACY REGRESSION: rel frob err {err:.3f} > {err_bound}",
               file=sys.stderr)
-        return 1
-    return 0
+        status = 1
+    if default_shape and res.phase_seconds["chain_s"] > 2.5:
+        print(f"CHAIN REGRESSION: chain_s {res.phase_seconds['chain_s']:.2f}"
+              " > 2.5 s at the bench shape (tunnel-independent budget)",
+              file=sys.stderr)
+        status = 1
+    return status
 
 
 if __name__ == "__main__":

@@ -42,8 +42,9 @@ from dcfm_tpu.parallel.mesh import make_mesh, shards_per_device
 from dcfm_tpu.parallel.multihost import place_sharded_global
 from dcfm_tpu.parallel.shard import build_mesh_chain, place_sharded
 from dcfm_tpu.utils.checkpoint import (
-    checkpoint_compatible, data_fingerprint, load_checkpoint,
-    load_checkpoint_multiprocess, proc_path, read_checkpoint_meta,
+    AsyncCheckpointWriter, checkpoint_compatible, data_fingerprint,
+    discover_checkpoint, load_checkpoint, load_checkpoint_multiprocess,
+    load_checkpoint_resharded, proc_path, read_checkpoint_meta,
     save_checkpoint, save_checkpoint_multiprocess)
 from dcfm_tpu.utils.estimate import (
     assemble_from_q8, assemble_from_upper, dequantize_panels,
@@ -75,14 +76,18 @@ class FitResult:
     # chunk_seconds[0] includes compilation.
     chunk_seconds: Optional[list] = None
     # Phase-resolved wall-clock: {"preprocess_s", "upload_s", "init_s",
-    # "chain_s", "fetch_s", "assemble_s"}.  On a tunneled device the fetch
+    # "chain_s", "fetch_s", "assemble_s", "checkpoint_s"}.  On a tunneled
+    # device the fetch
     # is usually the dominant term and fluctuates with link bandwidth;
     # separating it from chain_s is what distinguishes a code regression
     # from link weather.  assemble_s is host CPU wall-clock after the
     # fetch (the output-row-major native assembler, ~0.3 s at p=10k in
     # quant8 mode - dequant folded in, so no separate dequant pass).
     # init_s covers state init or checkpoint load (incl. the init
-    # executable load on a tunneled device).
+    # executable load on a tunneled device).  checkpoint_s is the
+    # chain-visible cost of write-behind saves (snapshot dispatch + joins);
+    # the background fetch/write itself overlaps the next chunk's compute
+    # (utils/checkpoint.AsyncCheckpointWriter).
     phase_seconds: Optional[dict] = None
     # (p, p) entrywise posterior standard deviation of the covariance, in
     # the caller's coordinates; set when ModelConfig.posterior_sd is on.
@@ -457,9 +462,27 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
     def _resume_state(init_fn, Yd):
         """-> (carry, done).  resume=True demands a compatible checkpoint;
         resume="auto" (elastic recovery) falls back to a fresh start when
-        the checkpoint is missing or incompatible."""
+        the checkpoint is missing or incompatible.
+
+        A plain single-process file is preferred; absent that, a complete
+        ``path.procK-of-N`` set written by an N-process run is resharded
+        onto this process (topology-flexible resume - an N-host pod's
+        chain continues on one host, checkpoint.load_checkpoint_resharded).
+        """
         auto = cfg.resume == "auto"
-        if cfg.resume and os.path.exists(cfg.checkpoint_path):
+        source = None
+        if cfg.resume:
+            # One discovery picks the most-progressed source among the
+            # plain file and any .procK-of-N set (checkpoint.
+            # discover_checkpoint); in auto mode an unreadable candidate
+            # is just another reason to start fresh.
+            try:
+                source = discover_checkpoint(cfg.checkpoint_path,
+                                             prefer_plain=True)
+            except Exception:
+                if not auto:
+                    raise
+        if source is not None:
             # Compatibility first (friendly refusal on config/data mismatch),
             # then load into an eval_shape template - the real init never
             # runs, so no wasted compile and no doubled accumulator peak.
@@ -467,8 +490,10 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
             # just another reason to start fresh - the elastic-recovery
             # contract must survive library upgrades, not crash-loop on
             # them.
+            kind, found = source
             try:
-                meta = read_checkpoint_meta(cfg.checkpoint_path)
+                meta = read_checkpoint_meta(
+                    cfg.checkpoint_path if kind == "plain" else found[1][0])
                 reason = checkpoint_compatible(meta, cfg, fingerprint)
             except Exception:
                 if not auto:
@@ -481,15 +506,18 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
                 # behind a healthy meta entry) - same auto-mode fallback
                 try:
                     template = jax.eval_shape(init_fn, k_init, Yd)
-                    carry, meta = load_checkpoint(
-                        cfg.checkpoint_path, template)
+                    carry, meta = (
+                        load_checkpoint(cfg.checkpoint_path, template)
+                        if kind == "plain" else
+                        load_checkpoint_resharded(found[1], template))
                     return carry, int(meta["iteration"])
                 except Exception:
                     if not auto:
                         raise
         elif cfg.resume and not auto:
             raise FileNotFoundError(
-                f"resume=True but no checkpoint at {cfg.checkpoint_path}")
+                f"resume=True but no checkpoint at {cfg.checkpoint_path} "
+                "(or any .procK-of-N set)")
         return init_fn(k_init, Yd), 0
 
     def _resume_state_multiproc(init_fn, Yd):
@@ -508,42 +536,94 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
         auto = cfg.resume == "auto"
         carry0 = init_fn(k_init, Yd)
         loaded, failure = None, None
-        my_path = proc_path(cfg.checkpoint_path, jax.process_index(),
-                            jax.process_count())
-        if cfg.resume and os.path.exists(my_path):
+        if cfg.resume:
+            # One discovery picks the most-progressed source among any
+            # .procK-of-N set and a plain single-process file
+            # (checkpoint.discover_checkpoint); a set written at THIS
+            # process count resumes shard-locally, anything else is
+            # resharded (topology-flexible elastic recovery; needs a
+            # shared checkpoint filesystem).  The rule is deterministic
+            # from file contents, so all processes agree, and the SAME
+            # source object flows into the loader - the set that was
+            # compatibility-checked is the set that loads.
+            meta_path = None
             try:
-                meta = read_checkpoint_meta(my_path)
-                reason = checkpoint_compatible(meta, cfg, fingerprint)
-                if reason is not None:
-                    failure = f"refusing to resume: {reason}"
-                else:
-                    # free the init buffers before the load materializes
-                    # the checkpointed copies - no doubled accumulator peak
-                    template = jax.tree.map(
-                        lambda a: jax.ShapeDtypeStruct(
-                            a.shape, a.dtype, sharding=a.sharding), carry0)
-                    jax.tree.map(lambda a: a.delete(), carry0)
-                    carry0 = None
-                    loaded = load_checkpoint_multiprocess(
-                        cfg.checkpoint_path, template)
+                source = discover_checkpoint(cfg.checkpoint_path,
+                                             prefer_plain=False)
+                if source is not None:
+                    meta_path = (cfg.checkpoint_path
+                                 if source[0] == "plain" else source[1][1][0])
             except Exception as e:
+                source = None
                 failure = f"checkpoint unreadable: {e}"
-        elif cfg.resume:
-            failure = f"no checkpoint at {my_path}"
+            my_path = proc_path(cfg.checkpoint_path, jax.process_index(),
+                                jax.process_count())
+            if source is None and os.path.exists(my_path):
+                # Per-host local checkpoint disks: discovery needs the
+                # whole set visible, but the SAME-topology fast path only
+                # ever reads this process's own file - fall back to it.
+                # Every process sees the same condition (each its own
+                # file), and the collective iteration agreement below
+                # still refuses mixed states.
+                try:
+                    n = jax.process_count()
+                    it = int(read_checkpoint_meta(my_path)["iteration"])
+                    source = ("set", (n, [proc_path(cfg.checkpoint_path,
+                                                    i, n)
+                                          for i in range(n)], it))
+                    meta_path, failure = my_path, None
+                except Exception as e:
+                    failure = failure or f"checkpoint unreadable: {e}"
+            if source is not None:
+                kind, found = source
+                try:
+                    meta = read_checkpoint_meta(meta_path)
+                    reason = checkpoint_compatible(meta, cfg, fingerprint)
+                    if reason is not None:
+                        failure = f"refusing to resume: {reason}"
+                    else:
+                        # free the init buffers before the load materializes
+                        # the checkpointed copies - no doubled accumulator
+                        # peak
+                        template = jax.tree.map(
+                            lambda a: jax.ShapeDtypeStruct(
+                                a.shape, a.dtype, sharding=a.sharding),
+                            carry0)
+                        jax.tree.map(lambda a: a.delete(), carry0)
+                        carry0 = None
+                        loaded = load_checkpoint_multiprocess(
+                            cfg.checkpoint_path, template, source=source)
+                except Exception as e:
+                    failure = f"checkpoint unreadable: {e}"
+            elif failure is None:
+                failure = (f"no checkpoint at {cfg.checkpoint_path} "
+                           "(or any .procK-of-N set)")
 
         from jax.experimental import multihost_utils
+        # Agreement is on the full SOURCE SIGNATURE (iteration, kind,
+        # writer count), not the iteration alone: with per-host local
+        # disks two processes can resolve different checkpoint sources
+        # whose iterations coincide (e.g. a stale set from an earlier
+        # topology beside the current one) - same-iteration-different-
+        # source would still be a mixed chain state.
         my_iter = int(loaded[1]["iteration"]) if loaded is not None else -1
-        all_iters = multihost_utils.process_allgather(
-            np.asarray([my_iter], np.int64)).reshape(-1)
-        agree = my_iter >= 0 and bool(np.all(all_iters == my_iter))
+        kind_code = -1 if loaded is None else (0 if source[0] == "plain"
+                                               else 1)
+        src_count = (-1 if loaded is None or source[0] == "plain"
+                     else source[1][0])
+        my_sig = np.asarray([my_iter, kind_code, src_count], np.int64)
+        all_sigs = multihost_utils.process_allgather(my_sig)
+        agree = my_iter >= 0 and bool(np.all(all_sigs == my_sig[None, :]))
         if agree:
             return loaded[0], my_iter
         if cfg.resume and not auto:
             raise ValueError(
                 failure or "resume=True but the per-process checkpoints "
-                f"disagree on the iteration ({all_iters.tolist()}) - a "
-                "crash between two processes' saves; delete the files or "
-                "use resume='auto' to restart fresh")
+                "disagree on the resume source "
+                f"({all_sigs.tolist()} as [iteration, kind, count] rows) - "
+                "a crash between two processes' saves, or mixed stale "
+                "files; delete the files or use resume='auto' to restart "
+                "fresh")
         if carry0 is None:   # init was freed for a load that was discarded
             carry0 = init_fn(k_init, Yd)
         return carry0, 0
@@ -558,15 +638,36 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
         traces = []
         chunk_secs = []
         executed = run.total_iters - done
-        for ni in _chunks(executed):
+        # Write-behind checkpointing: each chunk-boundary save snapshots
+        # the carry on device and fetches/writes in a background thread,
+        # so the next chunk's compute overlaps the save instead of
+        # stalling on it.  checkpoint_s is the CHAIN-VISIBLE cost only
+        # (snapshot dispatch + any join on a still-running previous save
+        # + the final durability join); the hidden background fetch rides
+        # the device->host link concurrently with compute.
+        writer = AsyncCheckpointWriter() if cfg.checkpoint_path else None
+        save_fn = (save_checkpoint_multiprocess if multiproc
+                   else save_checkpoint)
+        chunk_lens = _chunks(executed)
+        for ci, ni in enumerate(chunk_lens):
             tc = time.perf_counter()
             carry, stats, trace = get_chunk_fn(ni)(k_chain, Yd, carry, sched)
             traces.append(np.asarray(trace))
             chunk_secs.append(time.perf_counter() - tc)
-            if cfg.checkpoint_path:
-                (save_checkpoint_multiprocess if multiproc
-                 else save_checkpoint)(cfg.checkpoint_path, carry, cfg,
-                                       fingerprint=fingerprint)
+            # cadence: every k-th boundary, plus always the last (so a
+            # finished run is resumable as a no-op)
+            due = ((ci + 1) % cfg.checkpoint_every_chunks == 0
+                   or ci == len(chunk_lens) - 1)
+            if writer is not None and due:
+                t_ck = time.perf_counter()
+                writer.submit(save_fn, cfg.checkpoint_path, carry, cfg,
+                              fingerprint=fingerprint)
+                phase["checkpoint_s"] += time.perf_counter() - t_ck
+        if writer is not None:
+            # the last save must be durable before fit() returns
+            t_ck = time.perf_counter()
+            writer.wait()
+            phase["checkpoint_s"] += time.perf_counter() - t_ck
         return carry, stats, executed, traces, chunk_secs, done
 
     C = run.num_chains
@@ -576,7 +677,8 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
     profile_ctx = (jax.profiler.trace(cfg.backend.profile_dir)
                    if cfg.backend.profile_dir else contextlib.nullcontext())
     phase = {"preprocess_s": preprocess_s, "upload_s": 0.0, "init_s": 0.0,
-             "chain_s": 0.0, "fetch_s": 0.0, "assemble_s": 0.0}
+             "chain_s": 0.0, "fetch_s": 0.0, "assemble_s": 0.0,
+             "checkpoint_s": 0.0}
     t0 = time.perf_counter()
     with profile_ctx:
         if use_mesh:

@@ -117,6 +117,10 @@ def build_parser() -> argparse.ArgumentParser:
     f.add_argument("--checkpoint", default=None, metavar="PATH",
                    help="write the chain state here at every chunk boundary "
                         "(--chunk-size is the cadence)")
+    f.add_argument("--checkpoint-every", type=int, default=1, metavar="K",
+                   help="save every K-th chunk boundary instead of every "
+                        "one (the final chunk always saves); raise this "
+                        "when the snapshot transfer outlasts a chunk")
     f.add_argument("--resume", action="store_true",
                    help="resume from --checkpoint when it exists (a resumed "
                         "chain is bitwise-identical to an uninterrupted one)")
@@ -169,6 +173,7 @@ def main(argv=None) -> int:
         permute=not args.no_permute,
         checkpoint_path=args.checkpoint,
         resume=resume,
+        checkpoint_every_chunks=args.checkpoint_every,
     )
     res = fit(Y, cfg)
     Sigma = (res.covariance(destandardize=False)

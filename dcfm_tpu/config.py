@@ -254,6 +254,15 @@ class FitConfig:
     # config and it picks up where it died.
     checkpoint_path: Optional[str] = None
     resume: "bool | str" = False  # False | True | "auto"
+    # Save every k-th chunk boundary (the final chunk always saves, so a
+    # finished run stays resumable-as-noop).  Saves are write-behind
+    # (utils/checkpoint.AsyncCheckpointWriter), but each snapshot still
+    # crosses the device->host link; on a slow link, raise this so the
+    # transfer of one save finishes inside the compute of the next k
+    # chunks - measured at the p=10k bench shape over a ~3.5 MB/s tunnel,
+    # a 406 MB snapshot per 250-iteration chunk serializes the chain
+    # behind the link (README Performance).
+    checkpoint_every_chunks: int = 1
 
 
 def validate(cfg: FitConfig, n: int, p: int) -> None:
@@ -316,6 +325,10 @@ def validate(cfg: FitConfig, n: int, p: int) -> None:
             f"resume must be False, True, or 'auto', got {cfg.resume!r}")
     if cfg.resume and not cfg.checkpoint_path:
         raise ValueError("resume requires checkpoint_path")
+    if cfg.checkpoint_every_chunks < 1:
+        raise ValueError(
+            f"checkpoint_every_chunks must be >= 1, got "
+            f"{cfg.checkpoint_every_chunks}")
     if cfg.backend.fetch_dtype not in ("float32", "bfloat16", "float16",
                                        "quant8"):
         raise ValueError(

@@ -26,10 +26,13 @@ import dataclasses
 import hashlib
 import json
 import os
+import re
 import tempfile
-from typing import Any, Optional, Tuple
+import threading
+from typing import Any, Callable, Optional, Tuple
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from dcfm_tpu.config import (
@@ -170,6 +173,154 @@ def proc_path(path: str, process_index: int, process_count: int) -> str:
     return f"{path}.proc{process_index}-of-{process_count}"
 
 
+def find_multiprocess_checkpoint(
+        path: str) -> Optional[Tuple[int, list, int]]:
+    """Discover the best COMPLETE per-process checkpoint set for ``path``.
+
+    Returns ``(process_count, [file paths in process order], iteration)``
+    or None.  Requires every ``path.procK-of-N`` of a set to be visible (a
+    shared checkpoint filesystem - the usual pod arrangement; files live
+    on their writer's disk otherwise and resharding is impossible by
+    construction).
+
+    Selection when several complete sets coexist (e.g. saved at N=2, later
+    resumed and re-saved at N=1): most chain progress wins (highest saved
+    iteration), then a set matching the current process count, then the
+    smaller set.  The rule is deterministic from file contents only, so
+    every process of an SPMD resume picks the same set without
+    coordination.
+
+    If candidate sets exist but NONE is readable (e.g. all are an older
+    format version), the first read error is raised so the user sees the
+    friendly version refusal instead of "no checkpoint".
+    """
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    if not os.path.isdir(d):
+        return None
+    pat = re.compile(re.escape(os.path.basename(path))
+                     + r"\.proc(\d+)-of-(\d+)$")
+    by_count: dict = {}
+    for f in os.listdir(d):
+        m = pat.match(f)
+        if m:
+            by_count.setdefault(int(m.group(2)), set()).add(int(m.group(1)))
+    best = None
+    first_err = None
+    for count, idxs in by_count.items():
+        if idxs != set(range(count)):
+            continue                      # incomplete set: not loadable
+        try:
+            it = int(read_checkpoint_meta(proc_path(path, 0, count))
+                     ["iteration"])
+        except Exception as e:           # unreadable/old-format set
+            first_err = first_err or e
+            continue
+        key = (it, count == jax.process_count(), -count)
+        if best is None or key > best[0]:
+            best = (key, count, it)
+    if best is None:
+        if first_err is not None:
+            raise ValueError(f"checkpoint set unreadable: {first_err}")
+        return None
+    count, it = best[1], best[2]
+    return count, [proc_path(path, i, count) for i in range(count)], it
+
+
+def discover_checkpoint(path: str, *, prefer_plain: bool):
+    """Pick the resume source with the most chain progress among a plain
+    single-process file and any complete ``.procK-of-N`` set (one home for
+    the rule, so a stale set never shadows a newer plain file or vice
+    versa).  Returns ``("plain", None)``, ``("set", (count, paths, it))``,
+    or None; ties go to the caller's native kind (``prefer_plain``).
+
+    An unreadable candidate of one kind never masks a valid one of the
+    other (a stale old-format set beside a fresh plain file, or a
+    truncated plain file beside a valid set); the read error is raised
+    only when NO candidate is loadable, so the user sees the friendly
+    refusal instead of "no checkpoint".
+    """
+    err, found, plain_it = None, None, None
+    try:
+        found = find_multiprocess_checkpoint(path)
+    except Exception as e:
+        err = e
+    if os.path.exists(path):
+        try:
+            plain_it = int(read_checkpoint_meta(path)["iteration"])
+        except Exception as e:
+            err = err or e
+    if found is None and plain_it is None:
+        if err is not None:
+            raise ValueError(f"checkpoint unreadable: {err}")
+        return None
+    if found is None:
+        return ("plain", None)
+    if plain_it is None:
+        return ("set", found)
+    if plain_it == found[2]:
+        return ("plain", None) if prefer_plain else ("set", found)
+    return ("plain", None) if plain_it > found[2] else ("set", found)
+
+
+def load_checkpoint_resharded(
+        paths: list, carry_template: Any) -> Tuple[Any, dict]:
+    """Assemble a complete per-process checkpoint set into FULL host
+    arrays, independent of the topology that wrote it.
+
+    The save format keys every sharded leaf's blocks by their global
+    offsets (save_checkpoint_multiprocess), so N files carry everything
+    needed to rebuild each leaf whole: replicated leaves come from file 0,
+    sharded leaves are scatter-filled from every file's blocks (identical
+    overlaps from cross-process replication just overwrite in place).
+    Memory: each leaf is materialized whole on this host - fine for the
+    carry pytree (the accumulator dominates at p^2 f32), which is the same
+    footprint the single-process path already pays.
+
+    Returns ``(host carry pytree, metadata of file 0)``; raises if the
+    files disagree on the saved iteration (a crash landed between two
+    processes' saves - the set is not a consistent chain state).
+    """
+    template_leaves, treedef = jax.tree.flatten(carry_template)
+    full = [None] * len(template_leaves)
+    metas = []
+    for fp in paths:
+        with np.load(fp) as z:
+            meta = json.loads(bytes(z["__meta__"]).decode())
+            if meta["version"] != _FORMAT_VERSION:
+                raise ValueError(f"checkpoint format v{meta['version']} != "
+                                 f"v{_FORMAT_VERSION}")
+            metas.append(meta)
+            lm = meta["leaf_meta"]
+            if len(lm) != len(template_leaves):
+                raise ValueError(
+                    f"checkpoint has {len(lm)} leaves, carry has "
+                    f"{len(template_leaves)} - config mismatch?")
+            for i, tpl in enumerate(template_leaves):
+                want = tuple(np.shape(tpl))
+                if lm[i]["mode"] == "replicated":
+                    if full[i] is None:
+                        arr = z[f"leaf_{i}"]
+                        if tuple(arr.shape) != want:
+                            raise ValueError(
+                                f"checkpoint leaf {i} shape {arr.shape} != "
+                                f"expected {want}")
+                        full[i] = arr
+                else:
+                    if full[i] is None:
+                        full[i] = np.empty(want, np.dtype(tpl.dtype))
+                    for j, off in enumerate(lm[i]["offsets"]):
+                        b = z[f"leaf_{i}_s{j}"]
+                        sl = tuple(slice(o, o + s)
+                                   for o, s in zip(off, b.shape))
+                        full[i][sl] = b
+    iters = {int(m["iteration"]) for m in metas}
+    if len(iters) != 1:
+        raise ValueError(
+            f"per-process checkpoints disagree on the iteration "
+            f"({sorted(iters)}) - a crash between two processes' saves")
+    return jax.tree.unflatten(treedef, full), metas[0]
+
+
 def save_checkpoint_multiprocess(
     path: str,
     carry: Any,
@@ -214,28 +365,64 @@ def save_checkpoint_multiprocess(
                   meta, payload)
 
 
-def load_checkpoint_multiprocess(path: str, carry_like: Any) -> Tuple[Any, dict]:
-    """Load this process's shard-local checkpoint into concrete global arrays.
+def load_checkpoint_multiprocess(path: str, carry_like: Any,
+                                 source=None) -> Tuple[Any, dict]:
+    """Load a checkpoint into concrete global arrays on this process.
+
+    ``source`` is a prior :func:`discover_checkpoint` result; passing it
+    avoids a second directory scan and guarantees the set that was
+    compatibility-checked is the set that loads (no scan/load race).
 
     ``carry_like`` supplies each leaf's shape/dtype AND target sharding -
     either a concrete carry or (cheaper) a pytree of
     ``jax.ShapeDtypeStruct(..., sharding=...)`` derived from one - because
     unlike the single-process loader, host numpy leaves cannot simply be
     fed back into the jitted chunk here: a multi-process jit cannot
-    consume non-addressable full arrays.  Each sharded leaf is rebuilt
-    with ``jax.make_array_from_callback``, looking shards up by their
-    saved global offsets.
+    consume non-addressable full arrays.
+
+    Fast path (the set was written by exactly this many processes): each
+    process reads only its own ``path.procK-of-N`` file and rebuilds its
+    sharded leaves with ``jax.make_array_from_callback``, looking shards
+    up by their saved global offsets - no cross-host traffic, p^2/N
+    footprint per host.
+
+    Reshard path (topology-flexible elastic resume): when the best
+    available set was written by a DIFFERENT process count - or only a
+    plain single-process file exists - every process assembles the full
+    host arrays from all files (load_checkpoint_resharded; the offsets
+    stored with every block make this topology-independent) and places
+    its own shards from them.  Costs one full-carry materialization per
+    host; correctness needs a shared checkpoint filesystem.
     """
+    if source is None:
+        source = discover_checkpoint(path, prefer_plain=False)
+    if source is None:
+        raise FileNotFoundError(
+            f"no complete checkpoint set at {path}(.procK-of-N)")
+    kind, found = source
+    if kind == "plain" or found[0] != jax.process_count():
+        leaves_like, treedef = jax.tree.flatten(carry_like)
+        if kind == "set":
+            host, meta = load_checkpoint_resharded(found[1], carry_like)
+        else:
+            # plain file from a single-process run, resharded onto N
+            host, meta = load_checkpoint(path, carry_like)
+        out = []
+        for tpl, arr in zip(leaves_like, jax.tree.leaves(host)):
+            sh = getattr(tpl, "sharding", None)
+            if sh is not None:
+                arr = jax.make_array_from_callback(
+                    tuple(np.shape(tpl)), sh,
+                    lambda idx, _a=np.asarray(arr): _a[idx])
+            out.append(arr)
+        return jax.tree.unflatten(treedef, out), meta
+
     target = proc_path(path, jax.process_index(), jax.process_count())
     with np.load(target) as z:
         meta = json.loads(bytes(z["__meta__"]).decode())
         if meta["version"] != _FORMAT_VERSION:
             raise ValueError(
                 f"checkpoint format v{meta['version']} != v{_FORMAT_VERSION}")
-        if meta["process_count"] != jax.process_count():
-            raise ValueError(
-                f"checkpoint written by {meta['process_count']} processes, "
-                f"resuming with {jax.process_count()}")
         leaves_like, treedef = jax.tree.flatten(carry_like)
         lm = meta["leaf_meta"]
         if len(lm) != len(leaves_like):
@@ -268,6 +455,81 @@ def load_checkpoint_multiprocess(path: str, carry_like: Any) -> Tuple[Any, dict]
                 out.append(jax.make_array_from_callback(
                     tpl.shape, tpl.sharding, cb))
         return jax.tree.unflatten(treedef, out), meta
+
+
+@jax.jit
+def _copy_tree(tree):
+    # identity copy into fresh buffers; output shardings follow the inputs,
+    # so this works unchanged for single-device, mesh, and multi-process
+    # carries.  One global jit: it re-traces per pytree structure and is
+    # cached thereafter.
+    return jax.tree.map(jnp.copy, tree)
+
+
+def device_snapshot(carry: Any) -> Any:
+    """On-device copy of the carry with its device->host drain started.
+
+    Donation-safety is the point: the chain's chunk function donates its
+    carry argument, so the live carry cannot be fetched concurrently with
+    the next chunk.  A fresh on-device copy (sub-ms HBM traffic) taken
+    BEFORE the next chunk is dispatched has independent buffers; the
+    ``copy_to_host_async`` calls here start its transfer immediately so a
+    background writer's ``device_get`` overlaps the next chunk's compute
+    instead of serializing after it.
+    """
+    snap = _copy_tree(carry)
+    for leaf in jax.tree.leaves(snap):
+        if not isinstance(leaf, jax.Array):
+            continue
+        if leaf.is_fully_addressable:
+            leaf.copy_to_host_async()
+        else:
+            for s in leaf.addressable_shards:
+                s.data.copy_to_host_async()
+    return snap
+
+
+class AsyncCheckpointWriter:
+    """Write-behind checkpoint saves: the chain thread snapshots the carry
+    on device and hands the fetch + atomic file write to a background
+    thread, so the next chunk's compute runs concurrently with the save
+    (the reference persists nothing - SURVEY.md section 5 - so the bar
+    here is purely "checkpoint cadence must not cost chain time").
+
+    At most one save is in flight: ``submit`` joins the previous save
+    first, bounding the extra footprint to one carry copy on device plus
+    one on host.  ``wait()`` must be called before the results are used /
+    fit() returns, making the last file durable; a failed background save
+    re-raises there (or on the next submit) rather than being swallowed.
+    """
+
+    def __init__(self):
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+
+    def submit(self, save_fn: Callable[..., None], path: str, carry: Any,
+               cfg: "FitConfig", *, fingerprint: str) -> None:
+        self.wait()
+        snap = device_snapshot(carry)
+
+        def run():
+            try:
+                save_fn(path, snap, cfg, fingerprint=fingerprint)
+            except BaseException as e:   # surfaced by wait()
+                self._error = e
+
+        self._thread = threading.Thread(
+            target=run, name="dcfm-checkpoint-writer", daemon=True)
+        self._thread.start()
+
+    def wait(self) -> None:
+        t = self._thread
+        if t is not None:
+            t.join()
+            self._thread = None
+        if self._error is not None:
+            e, self._error = self._error, None
+            raise e
 
 
 def checkpoint_compatible(

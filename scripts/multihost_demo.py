@@ -102,22 +102,13 @@ def child_ck(process_id: int) -> None:
     ref = api.fit(Y, FitConfig(model=model, run=run,
                                backend=BackendConfig(mesh_devices=0)))
 
-    real = api.save_checkpoint_multiprocess
-    calls = {"n": 0}
-
-    def killing(*a, **k):
-        real(*a, **k)
-        calls["n"] += 1
-        if calls["n"] == 1:
-            raise RuntimeError("simulated crash mid-chain")
-
-    api.save_checkpoint_multiprocess = killing
+    restore = _crash_after_first_save("save_checkpoint_multiprocess")
     try:
         api.fit(Y, cfg(False))
         raise SystemExit("simulated crash did not fire")
     except RuntimeError:
         pass
-    api.save_checkpoint_multiprocess = real
+    restore()
 
     res = api.fit(Y, cfg("auto"))            # elastic resume mid-chain
     diff = float(np.abs(res.Sigma - ref.Sigma).max())
@@ -168,38 +159,253 @@ def child_ext(process_id: int) -> None:
     }), flush=True)
 
 
-def parent_ext() -> int:
-    t0 = time.perf_counter()
+def _crash_after_first_save(attr: str):
+    """Monkeypatch api.<attr> so the first checkpoint save completes and
+    then raises - the shared crash simulation for every recovery demo.
+    Returns a restore() callable."""
+    import dcfm_tpu.api as api
+    real = getattr(api, attr)
+    calls = {"n": 0}
+
+    def killing(*a, **k):
+        real(*a, **k)
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("simulated crash mid-chain")
+
+    setattr(api, attr, killing)
+    return lambda: setattr(api, attr, real)
+
+
+def _child_env() -> dict:
+    """Environment for spawned pieces: inherit, strip the parent's
+    XLA_FLAGS (children set their own device counts), repo on PYTHONPATH."""
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)
     env["PYTHONPATH"] = os.pathsep.join(
         [_REPO] + [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
                    if p])
+    return env
+
+
+def _spawn_children(flag: str, tag: str, env: dict, timeout: int = 480):
+    """Spawn NPROC children with ``flag`` and collect their ``tag``-prefixed
+    JSON result lines.  Returns {pid: result} or None on any failure.
+    Children are killed on timeout/failure so a sibling blocked in
+    distributed rendezvous never leaks (it would hold the coordinator port
+    and poison the next run)."""
+    procs = [subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), flag, str(i)],
+        env=env, cwd=_REPO, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True) for i in range(NPROC)]
+    results = {}
+    try:
+        for i, proc in enumerate(procs):
+            out, _ = proc.communicate(timeout=timeout)
+            if proc.returncode != 0:
+                print(f"{flag} child {i} rc={proc.returncode}\n{out[-2000:]}",
+                      file=sys.stderr)
+                return None
+            for line in out.splitlines():
+                if line.startswith(tag + " "):
+                    results[i] = json.loads(line[len(tag) + 1:])
+    finally:
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
+    if len(results) != NPROC:
+        print(f"missing {tag} results", file=sys.stderr)
+        return None
+    return results
+
+
+def _resh_workload():
+    """Deterministic workload shared by every piece of the reshard demo."""
+    import numpy as np
+    from dcfm_tpu import ModelConfig, RunConfig
+    rng = np.random.default_rng(SEED)
+    p = G * P_SHARD
+    Y = rng.standard_normal((N, p)).astype(np.float32)
+    model = ModelConfig(num_shards=G, factors_per_shard=K, rho=0.9)
+    run = RunConfig(burnin=4, mcmc=2, thin=1, seed=SEED, chunk_size=2)
+    ckpath = os.path.join(os.environ["MULTIHOST_DEMO_DIR"], "resh.ck")
+    return model, run, Y, ckpath
+
+
+def child_resh(process_id: int) -> None:
+    """Reshard demo, phase 1: a 2-process run crashes right after its
+    first per-process checkpoint save, leaving a complete
+    ``resh.ck.procK-of-2`` set at iteration 2 for the parent's
+    single-process resharded resume."""
+    os.environ["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={DEVS_PER_PROC}")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from dcfm_tpu.parallel import multihost
+    multihost.initialize(f"127.0.0.1:{PORT}", NPROC, process_id)
+
+    import dcfm_tpu.api as api
+    from dcfm_tpu import BackendConfig, FitConfig
+    model, run, Y, ckpath = _resh_workload()
+
+    _crash_after_first_save("save_checkpoint_multiprocess")
+    try:
+        api.fit(Y, FitConfig(model=model, run=run,
+                             backend=BackendConfig(mesh_devices=0),
+                             checkpoint_path=ckpath))
+        raise SystemExit("simulated crash did not fire")
+    except RuntimeError:
+        pass
+    print("CHILD_RESH " + json.dumps({"pid": process_id, "saved": True}),
+          flush=True)
+
+
+def child_resh_resume(process_id: int) -> None:
+    """Reshard demo, reverse direction: 2 processes resume a PLAIN
+    single-process checkpoint (load_checkpoint_multiprocess reshard path)
+    and finish the chain."""
+    os.environ["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={DEVS_PER_PROC}")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from dcfm_tpu.parallel import multihost
+    multihost.initialize(f"127.0.0.1:{PORT}", NPROC, process_id)
+
+    import numpy as np
+    import dcfm_tpu.api as api
+    from dcfm_tpu import BackendConfig, FitConfig
+    model, run, Y, ckpath = _resh_workload()
+    res = api.fit(Y, FitConfig(model=model, run=run,
+                               backend=BackendConfig(mesh_devices=0),
+                               checkpoint_path=ckpath, resume=True))
+    np.save(os.path.join(os.environ["MULTIHOST_DEMO_DIR"],
+                         f"resh_sigma_{process_id}.npy"), res.Sigma)
+    print("CHILD_RESHR " + json.dumps({
+        "pid": process_id, "ran_tail": res.iters_per_sec > 0}), flush=True)
+
+
+def _resh_single(mode: str) -> None:
+    """Single-process (8 virtual devices) pieces of the reshard demo:
+    'ref' = uninterrupted reference run; 'resume' = topology-flexible
+    resume of the 2-process set on ONE process; 'save' = crash after the
+    first (plain-file) save, leaving a mid-chain single-process
+    checkpoint."""
+    import numpy as np
+    import dcfm_tpu.api as api
+    from dcfm_tpu import BackendConfig, FitConfig
+    model, run, Y, ckpath = _resh_workload()
+    be = BackendConfig(mesh_devices=NPROC * DEVS_PER_PROC)
+    out_dir = os.environ["MULTIHOST_DEMO_DIR"]
+    if mode == "ref":
+        res = api.fit(Y, FitConfig(model=model, run=run, backend=be))
+        np.save(os.path.join(out_dir, "ref.npy"), res.Sigma)
+    elif mode == "resume":
+        res = api.fit(Y, FitConfig(model=model, run=run, backend=be,
+                                   checkpoint_path=ckpath, resume=True))
+        assert res.iters_per_sec > 0, "resume was a no-op; nothing resharded"
+        np.save(os.path.join(out_dir, "resumed.npy"), res.Sigma)
+    elif mode == "save":
+        _crash_after_first_save("save_checkpoint")
+        try:
+            api.fit(Y, FitConfig(model=model, run=run, backend=be,
+                                 checkpoint_path=ckpath))
+            raise SystemExit("simulated crash did not fire")
+        except RuntimeError:
+            pass
+    else:
+        raise SystemExit(f"unknown mode {mode}")
+    print("RESH_SINGLE_OK " + mode, flush=True)
+
+
+def parent_resh() -> int:
+    """Topology-flexible resume, both directions, against one reference:
+
+    forward: save at 2 processes (crash mid-chain) -> resume on 1 process
+    x 8 devices -> finish; reverse: save single-process (plain file) ->
+    resume across 2 processes -> finish.  Both finished Sigmas must match
+    the uninterrupted single-process run to cross-topology tolerance
+    (Gloo's cross-process reductions associate sums differently than the
+    single-process all-reduce by ulps - same bound as the base demo).
+    """
+    t0 = time.perf_counter()
+    env = _child_env()
+    import numpy as np
+
+    def run_single(mode, env):
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--resh-single",
+             mode], env=env, cwd=_REPO, capture_output=True, text=True,
+            timeout=480)
+        if out.returncode != 0 or f"RESH_SINGLE_OK {mode}" not in out.stdout:
+            print(f"single-process {mode} failed\n" + out.stdout[-1500:]
+                  + out.stderr[-1500:], file=sys.stderr)
+            return False
+        return True
+
     with tempfile.TemporaryDirectory() as tmp:
         env["MULTIHOST_DEMO_DIR"] = tmp
-        procs = [subprocess.Popen(
-            [sys.executable, os.path.abspath(__file__), "--child-ext",
-             str(i)],
-            env=env, cwd=_REPO, stdout=subprocess.PIPE,
-            stderr=subprocess.STDOUT, text=True) for i in range(NPROC)]
-        results = {}
-        try:
-            for i, proc in enumerate(procs):
-                out, _ = proc.communicate(timeout=480)
-                if proc.returncode != 0:
-                    print(f"ext child {i} rc={proc.returncode}\n"
-                          f"{out[-2000:]}", file=sys.stderr)
-                    return 1
-                for line in out.splitlines():
-                    if line.startswith("CHILD_EXT "):
-                        results[i] = json.loads(line[len("CHILD_EXT "):])
-        finally:
-            for proc in procs:
-                if proc.poll() is None:
-                    proc.kill()
-                    proc.wait()
-    if len(results) != NPROC:
-        print("missing CHILD_EXT results", file=sys.stderr)
+        env["MULTIHOST_DEMO_PORT"] = str(PORT)
+        # reference (uninterrupted, single-process)
+        if not run_single("ref", env):
+            return 1
+        ref = np.load(os.path.join(tmp, "ref.npy"))
+        # forward: 2-proc crash-after-save -> 1-proc resharded resume
+        if _spawn_children("--child-resh", "CHILD_RESH", env) is None:
+            return 1
+        set_files = [os.path.join(tmp, f"resh.ck.proc{i}-of-{NPROC}")
+                     for i in range(NPROC)]
+        if not all(os.path.exists(f) for f in set_files):
+            print("2-process checkpoint set missing", file=sys.stderr)
+            return 1
+        if not run_single("resume", env):
+            return 1
+        fwd = np.load(os.path.join(tmp, "resumed.npy"))
+        if not np.allclose(fwd, ref, rtol=1e-4, atol=1e-5):
+            print("forward reshard (2 procs -> 1) Sigma mismatch "
+                  f"(max {np.abs(fwd - ref).max()})", file=sys.stderr)
+            return 1
+
+    with tempfile.TemporaryDirectory() as tmp:
+        env["MULTIHOST_DEMO_DIR"] = tmp
+        env["MULTIHOST_DEMO_PORT"] = str(PORT + 2)
+        # reverse: 1-proc plain-file save -> 2-proc resharded resume
+        if not run_single("save", env):
+            return 1
+        if not os.path.exists(os.path.join(tmp, "resh.ck")):
+            print("plain mid-chain checkpoint missing", file=sys.stderr)
+            return 1
+        results = _spawn_children("--child-resh-resume", "CHILD_RESHR", env)
+        if results is None:
+            return 1
+        if not all(r["ran_tail"] for r in results.values()):
+            print("2-process resume was a no-op", file=sys.stderr)
+            return 1
+        sig = [np.load(os.path.join(tmp, f"resh_sigma_{i}.npy"))
+               for i in range(NPROC)]
+        if not np.allclose(sig[0], sig[1], rtol=1e-6, atol=1e-7):
+            print("resumed process Sigmas disagree", file=sys.stderr)
+            return 1
+        if not np.allclose(sig[0], ref, rtol=1e-4, atol=1e-5):
+            print("reverse reshard (1 proc -> 2) Sigma mismatch "
+                  f"(max {np.abs(sig[0] - ref).max()})", file=sys.stderr)
+            return 1
+
+    print(json.dumps({
+        "demo": "topology-flexible resume: 2->1 and 1->2 process reshard",
+        "seconds": round(time.perf_counter() - t0, 1),
+        "ok": True,
+    }))
+    return 0
+
+
+def parent_ext() -> int:
+    t0 = time.perf_counter()
+    env = _child_env()
+    with tempfile.TemporaryDirectory() as tmp:
+        env["MULTIHOST_DEMO_DIR"] = tmp
+        results = _spawn_children("--child-ext", "CHILD_EXT", env)
+    if results is None:
         return 1
     ok = all(r["extended_vs_uninterrupted_maxdiff"] == 0.0 and r["ran_tail"]
              for r in results.values())
@@ -214,36 +420,11 @@ def parent_ext() -> int:
 
 def parent_ck() -> int:
     t0 = time.perf_counter()
-    env = dict(os.environ)
-    env.pop("XLA_FLAGS", None)
-    env["PYTHONPATH"] = os.pathsep.join(
-        [_REPO] + [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
-                   if p])
-    import numpy as np
+    env = _child_env()
     with tempfile.TemporaryDirectory() as tmp:
         env["MULTIHOST_DEMO_DIR"] = tmp
-        procs = [subprocess.Popen(
-            [sys.executable, os.path.abspath(__file__), "--child-ck", str(i)],
-            env=env, cwd=_REPO, stdout=subprocess.PIPE,
-            stderr=subprocess.STDOUT, text=True) for i in range(NPROC)]
-        results = {}
-        try:
-            for i, proc in enumerate(procs):
-                out, _ = proc.communicate(timeout=480)
-                if proc.returncode != 0:
-                    print(f"ck child {i} rc={proc.returncode}\n{out[-2000:]}",
-                          file=sys.stderr)
-                    return 1
-                for line in out.splitlines():
-                    if line.startswith("CHILD_CK "):
-                        results[i] = json.loads(line[len("CHILD_CK "):])
-        finally:
-            for proc in procs:
-                if proc.poll() is None:
-                    proc.kill()
-                    proc.wait()
-    if len(results) != NPROC:
-        print("missing CHILD_CK results", file=sys.stderr)
+        results = _spawn_children("--child-ck", "CHILD_CK", env)
+    if results is None:
         return 1
     ok = all(r["resumed_vs_uninterrupted_maxdiff"] <= 1e-6
              and r["finished_resume_noop"]
@@ -259,32 +440,12 @@ def parent_ck() -> int:
 
 def parent() -> int:
     t0 = time.perf_counter()
-    env = dict(os.environ)
-    env.pop("XLA_FLAGS", None)
-    env["PYTHONPATH"] = os.pathsep.join(
-        [_REPO] + [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
-                   if p])
+    env = _child_env()
     import numpy as np
     with tempfile.TemporaryDirectory() as tmp:
         env["MULTIHOST_DEMO_DIR"] = tmp
-        procs = [subprocess.Popen(
-            [sys.executable, os.path.abspath(__file__), "--child", str(i)],
-            env=env, cwd=_REPO, stdout=subprocess.PIPE,
-            stderr=subprocess.STDOUT, text=True) for i in range(NPROC)]
-        try:
-            for i, proc in enumerate(procs):
-                out, _ = proc.communicate(timeout=480)
-                if proc.returncode != 0:
-                    print(f"child {i} rc={proc.returncode}\n{out[-2000:]}",
-                          file=sys.stderr)
-                    return 1
-        finally:
-            # never leak a sibling blocked in distributed rendezvous (it
-            # would hold the coordinator port and poison the next run)
-            for proc in procs:
-                if proc.poll() is None:
-                    proc.kill()
-                    proc.wait()
+        if _spawn_children("--child", "CHILD_RESULT", env) is None:
+            return 1
         sigmas = [np.load(os.path.join(tmp, f"sigma_{i}.npy"))
                   for i in range(NPROC)]
 
@@ -341,9 +502,21 @@ if __name__ == "__main__":
         child_ck(int(sys.argv[2]))
     elif len(sys.argv) > 2 and sys.argv[1] == "--child-ext":
         child_ext(int(sys.argv[2]))
+    elif len(sys.argv) > 2 and sys.argv[1] == "--child-resh":
+        child_resh(int(sys.argv[2]))
+    elif len(sys.argv) > 2 and sys.argv[1] == "--child-resh-resume":
+        child_resh_resume(int(sys.argv[2]))
+    elif len(sys.argv) > 2 and sys.argv[1] == "--resh-single":
+        os.environ["XLA_FLAGS"] = ("--xla_force_host_platform_device_count="
+                                   f"{NPROC * DEVS_PER_PROC}")
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        _resh_single(sys.argv[2])
     elif len(sys.argv) > 1 and sys.argv[1] == "--ck":
         sys.exit(parent_ck())
     elif len(sys.argv) > 1 and sys.argv[1] == "--ext":
         sys.exit(parent_ext())
+    elif len(sys.argv) > 1 and sys.argv[1] == "--resh":
+        sys.exit(parent_resh())
     else:
         sys.exit(parent())

@@ -354,3 +354,256 @@ def test_save_load_roundtrip_and_fingerprint(tmp_path):
         load_checkpoint(path, bad)
 
     assert data_fingerprint(np.zeros((2, 3, 4), np.float32)) != fp
+
+
+def test_async_writer_overlaps_saves():
+    """The write-behind writer must return from submit() while the save
+    still runs (the chain does not stall on a save whose cadence exceeds
+    its duration) and must surface the carry values as of the snapshot."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    from dcfm_tpu.utils.checkpoint import AsyncCheckpointWriter
+
+    writer = AsyncCheckpointWriter()
+    done = []
+
+    def slow_save(path, carry, cfg, *, fingerprint):
+        time.sleep(0.6)
+        done.append(float(np.asarray(jax.tree.leaves(carry)[0]).sum()))
+
+    carry = {"a": jnp.arange(4.0)}
+    t0 = time.perf_counter()
+    writer.submit(slow_save, "unused", carry, None, fingerprint="f")
+    assert time.perf_counter() - t0 < 0.3   # returned mid-save
+    time.sleep(0.7)                         # "next chunk compute"
+    t0 = time.perf_counter()
+    writer.submit(slow_save, "unused", carry, None, fingerprint="f")
+    assert time.perf_counter() - t0 < 0.3   # previous save already done
+    writer.wait()
+    assert done == [6.0, 6.0]
+
+
+def test_async_writer_error_surfaces():
+    """A failed background save must raise at wait(), not vanish."""
+    import jax.numpy as jnp
+
+    from dcfm_tpu.utils.checkpoint import AsyncCheckpointWriter
+
+    writer = AsyncCheckpointWriter()
+
+    def bad_save(path, carry, cfg, *, fingerprint):
+        raise OSError("disk full")
+
+    writer.submit(bad_save, "unused", {"a": jnp.zeros(2)}, None,
+                  fingerprint="f")
+    with pytest.raises(OSError, match="disk full"):
+        writer.wait()
+    # the error is consumed: the writer is reusable afterwards
+    writer.wait()
+
+
+def test_checkpoint_phase_recorded(tmp_path, data):
+    """fit() reports the chain-visible checkpoint cost as its own phase
+    and the write-behind save still leaves a durable, resumable file."""
+    ck = str(tmp_path / "phase.npz")
+    res = fit(data, dataclasses.replace(_cfg(), checkpoint_path=ck))
+    assert "checkpoint_s" in res.phase_seconds
+    assert res.phase_seconds["checkpoint_s"] >= 0.0
+    import os
+    assert os.path.exists(ck)
+    # a resume-from-finished run loads the file and executes nothing
+    res2 = fit(data, dataclasses.replace(
+        _cfg(), checkpoint_path=ck, resume=True))
+    assert res2.iters_per_sec == 0.0
+    np.testing.assert_array_equal(res.sigma_blocks, res2.sigma_blocks)
+
+
+def _fake_proc_file(path, i, n, iteration, payload=None, leaf_meta=None):
+    """Fabricate a minimal valid per-process checkpoint file."""
+    from dcfm_tpu.utils.checkpoint import _FORMAT_VERSION, _atomic_savez
+    from dcfm_tpu.utils.checkpoint import proc_path
+    _atomic_savez(proc_path(path, i, n), {
+        "version": _FORMAT_VERSION, "config": {}, "treedef": "",
+        "iteration": iteration, "fingerprint": "f",
+        "process_index": i, "process_count": n,
+        "leaf_meta": leaf_meta or [],
+    }, payload or {})
+
+
+def test_find_multiprocess_checkpoint_selection(tmp_path):
+    from dcfm_tpu.utils.checkpoint import find_multiprocess_checkpoint
+
+    base = str(tmp_path / "chain.ck")
+    assert find_multiprocess_checkpoint(base) is None
+    # incomplete 2-set: not loadable
+    _fake_proc_file(base, 0, 2, iteration=10)
+    assert find_multiprocess_checkpoint(base) is None
+    # complete 1-set at lower iteration: selected (only complete set)
+    _fake_proc_file(base, 0, 1, iteration=4)
+    count, paths, it = find_multiprocess_checkpoint(base)
+    assert count == 1 and len(paths) == 1 and it == 4
+    # completing the 2-set: most progress wins despite count mismatch
+    _fake_proc_file(base, 1, 2, iteration=10)
+    count, paths, it = find_multiprocess_checkpoint(base)
+    assert count == 2 and len(paths) == 2 and it == 10
+    # equal progress: the set matching this process count (1) wins
+    _fake_proc_file(base, 0, 1, iteration=10)
+    count, _, _ = find_multiprocess_checkpoint(base)
+    assert count == 1
+
+
+def test_load_checkpoint_resharded_lossless(tmp_path):
+    """Blocks scattered across a 2-process set reassemble bitwise into the
+    full leaves, regardless of which file holds which shard."""
+    from dcfm_tpu.utils.checkpoint import load_checkpoint_resharded
+
+    rng = np.random.default_rng(0)
+    base = str(tmp_path / "chain.ck")
+    sharded = rng.standard_normal((4, 6)).astype(np.float32)
+    replicated = rng.standard_normal((3,)).astype(np.float32)
+    # file 0 owns rows 0:2, file 1 owns rows 2:4; both carry `replicated`
+    lm = [{"mode": "sharded", "offsets": [[0, 0]]},
+          {"mode": "replicated"}]
+    _fake_proc_file(base, 0, 2, 8, payload={
+        "leaf_0_s0": sharded[0:2], "leaf_1": replicated}, leaf_meta=lm)
+    lm1 = [{"mode": "sharded", "offsets": [[2, 0]]},
+           {"mode": "replicated"}]
+    _fake_proc_file(base, 1, 2, 8, payload={
+        "leaf_0_s0": sharded[2:4], "leaf_1": replicated}, leaf_meta=lm1)
+
+    template = (np.zeros((4, 6), np.float32), np.zeros(3, np.float32))
+    from dcfm_tpu.utils.checkpoint import find_multiprocess_checkpoint
+    count, paths, _ = find_multiprocess_checkpoint(base)
+    assert count == 2
+    loaded, meta = load_checkpoint_resharded(paths, template)
+    np.testing.assert_array_equal(loaded[0], sharded)
+    np.testing.assert_array_equal(loaded[1], replicated)
+    assert meta["iteration"] == 8
+
+    # iteration disagreement (crash between saves) must refuse
+    _fake_proc_file(base, 1, 2, 9, payload={
+        "leaf_0_s0": sharded[2:4], "leaf_1": replicated}, leaf_meta=lm1)
+    with pytest.raises(ValueError, match="disagree on the iteration"):
+        load_checkpoint_resharded(paths, template)
+
+
+def test_single_process_resume_from_proc_set(tmp_path, data):
+    """fit() resumes from a per-process checkpoint SET when no plain file
+    exists (forward reshard onto one process), bitwise-identically - the
+    set here is 1-process, so no cross-topology reduction ulps apply."""
+    from dcfm_tpu.utils.checkpoint import (
+        _FORMAT_VERSION, _atomic_savez, proc_path)
+
+    res_full = fit(data, _cfg())
+
+    # run to completion with a plain checkpoint, then transcribe it into
+    # a proc0-of-1 set (what save_checkpoint_multiprocess would write)
+    ck = str(tmp_path / "chain.npz")
+    fit(data, dataclasses.replace(_cfg(), checkpoint_path=ck))
+    import json as _json
+    with np.load(ck) as z:
+        meta = _json.loads(bytes(z["__meta__"]).decode())
+        leaves = {k: z[k] for k in z.files if k != "__meta__"}
+    meta["process_index"], meta["process_count"] = 0, 1
+    meta["leaf_meta"] = [{"mode": "replicated"} for _ in leaves]
+    _atomic_savez(proc_path(ck, 0, 1), meta, leaves)
+    import os
+    os.unlink(ck)
+
+    res = fit(data, dataclasses.replace(
+        _cfg(), checkpoint_path=ck, resume=True))
+    assert res.iters_per_sec == 0.0          # finished set: no-op resume
+    np.testing.assert_array_equal(res_full.sigma_blocks, res.sigma_blocks)
+
+
+def test_checkpoint_cadence(tmp_path, monkeypatch, data):
+    """checkpoint_every_chunks saves every k-th boundary plus the final
+    chunk, and the finished file still supports the no-op resume."""
+    import dcfm_tpu.api as api
+
+    calls = {"n": 0}
+    real = api.save_checkpoint
+
+    def counting(*a, **k):
+        calls["n"] += 1
+        real(*a, **k)
+
+    monkeypatch.setattr(api, "save_checkpoint", counting)
+    ck = str(tmp_path / "cadence.npz")
+    cfg = dataclasses.replace(_cfg(), checkpoint_path=ck,
+                              checkpoint_every_chunks=3)
+    fit(data, cfg)                       # 4 chunks of 8: saves at 3 and 4
+    assert calls["n"] == 2
+    res2 = fit(data, dataclasses.replace(cfg, resume=True))
+    assert res2.iters_per_sec == 0.0
+
+
+def test_validate_rejects_bad_cadence(data):
+    from dcfm_tpu.config import validate
+    cfg = dataclasses.replace(_cfg(), checkpoint_path="x",
+                              checkpoint_every_chunks=0)
+    with pytest.raises(ValueError, match="checkpoint_every_chunks"):
+        validate(cfg, *data.shape)
+
+
+def test_discover_checkpoint_progress_rule(tmp_path):
+    """Most chain progress wins across KINDS too: a stale proc set never
+    shadows a newer plain file, and vice versa; ties go to the caller's
+    native kind."""
+    import json as _json
+
+    from dcfm_tpu.utils.checkpoint import (
+        _FORMAT_VERSION, _atomic_savez, discover_checkpoint)
+
+    base = str(tmp_path / "chain.ck")
+
+    def plain_file(iteration):
+        _atomic_savez(base, {
+            "version": _FORMAT_VERSION, "config": {}, "treedef": "",
+            "iteration": iteration, "fingerprint": "f"}, {})
+
+    plain_file(5)
+    _fake_proc_file(base, 0, 2, iteration=9)
+    _fake_proc_file(base, 1, 2, iteration=9)
+    kind, found = discover_checkpoint(base, prefer_plain=True)
+    assert kind == "set" and found[0] == 2      # newer set beats stale plain
+    plain_file(12)
+    kind, _ = discover_checkpoint(base, prefer_plain=False)
+    assert kind == "plain"                      # newer plain beats stale set
+    plain_file(9)
+    assert discover_checkpoint(base, prefer_plain=True)[0] == "plain"
+    assert discover_checkpoint(base, prefer_plain=False)[0] == "set"
+
+
+def test_unreadable_candidate_never_masks_valid_one(tmp_path):
+    """A corrupt/old-format candidate of one kind must not block resuming
+    a valid candidate of the other kind (discover_checkpoint contract)."""
+    from dcfm_tpu.utils.checkpoint import discover_checkpoint
+
+    base = str(tmp_path / "chain.ck")
+    # corrupt plain file beside a valid complete set -> the set wins
+    with open(base, "wb") as f:
+        f.write(b"not an npz")
+    _fake_proc_file(base, 0, 2, iteration=7)
+    _fake_proc_file(base, 1, 2, iteration=7)
+    kind, found = discover_checkpoint(base, prefer_plain=True)
+    assert kind == "set" and found[2] == 7
+    import os
+    os.unlink(base)
+    # old-format set beside a valid plain file -> the plain file wins
+    from dcfm_tpu.utils.checkpoint import _atomic_savez
+    for i in range(2):
+        _atomic_savez(f"{base}.proc{i}-of-2", {
+            "version": 1, "iteration": 3}, {})
+    from dcfm_tpu.utils.checkpoint import _FORMAT_VERSION
+    _atomic_savez(base, {"version": _FORMAT_VERSION, "config": {},
+                         "treedef": "", "iteration": 5,
+                         "fingerprint": "f"}, {})
+    assert discover_checkpoint(base, prefer_plain=True)[0] == "plain"
+    # nothing valid at all -> the read error surfaces, not "no checkpoint"
+    os.unlink(base)
+    with pytest.raises(ValueError, match="unreadable"):
+        discover_checkpoint(base, prefer_plain=True)

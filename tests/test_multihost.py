@@ -70,6 +70,29 @@ def test_multihost_chain_extension():
     assert '"ok": true' in proc.stdout
 
 
+@pytest.mark.slow
+def test_multihost_topology_flexible_resume():
+    # both reshard directions: a 2-process checkpoint set resumed on 1
+    # process x 8 devices, and a plain single-process file resumed across
+    # 2 processes - each finished Sigma pinned against one uninterrupted
+    # reference (cross-topology reduction-order tolerance)
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [_REPO] + [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+                   if p])
+    env["MULTIHOST_DEMO_PORT"] = "29871"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "scripts", "multihost_demo.py"),
+         "--resh"],
+        # ~7 sequential JAX subprocess phases (4 single-process fits + 2
+        # two-child distributed runs), each with its own cold start - the
+        # outer budget must cover their sum, unlike the 1-phase siblings
+        env=env, cwd=_REPO, capture_output=True, text=True, timeout=1200)
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    assert '"ok": true' in proc.stdout
+
+
 def test_initialize_from_env_noop_without_vars():
     # in-process check of the no-op contract (no coordinator set)
     env_backup = {k: os.environ.pop(k, None)
