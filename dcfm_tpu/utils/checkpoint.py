@@ -86,6 +86,7 @@ def _config_from_json(d: dict) -> FitConfig:
         pad_to_shards=d["pad_to_shards"],
         checkpoint_path=d.get("checkpoint_path"),
         resume=d.get("resume", False),
+        checkpoint_every_chunks=d.get("checkpoint_every_chunks", 1),
     )
 
 
@@ -210,9 +211,17 @@ def find_multiprocess_checkpoint(
         if idxs != set(range(count)):
             continue                      # incomplete set: not loadable
         try:
-            it = int(read_checkpoint_meta(proc_path(path, 0, count))
-                     ["iteration"])
-        except Exception as e:           # unreadable/old-format set
+            # every file's iteration, not just proc 0's: a TORN set (crash
+            # between two processes' saves) is as unloadable as a missing
+            # one and must not shadow a valid other candidate
+            its = {int(read_checkpoint_meta(proc_path(path, i, count))
+                       ["iteration"]) for i in range(count)}
+            if len(its) != 1:
+                raise ValueError(
+                    f"per-process checkpoints disagree on the iteration "
+                    f"({sorted(its)}) - a crash between saves")
+            it = its.pop()
+        except Exception as e:           # unreadable/old-format/torn set
             first_err = first_err or e
             continue
         key = (it, count == jax.process_count(), -count)

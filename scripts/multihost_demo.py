@@ -185,6 +185,12 @@ def _child_env() -> dict:
     env["PYTHONPATH"] = os.pathsep.join(
         [_REPO] + [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
                    if p])
+    # persistent XLA compile cache (shared with tests/bench): the demo's
+    # wall-clock is compile-dominated; repeat runs skip straight to the
+    # chains.  Safe across concurrent children (atomic cache writes).
+    env.setdefault("JAX_COMPILATION_CACHE_DIR",
+                   os.path.join(_REPO, ".jax_cache"))
+    env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
     return env
 
 

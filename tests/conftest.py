@@ -21,6 +21,21 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent XLA compilation cache: the suite's wall-clock is dominated by
+# COMPILES, not iterations (a fresh mesh program costs 30-50 s on this
+# 1-core box; the chains themselves run in seconds).  Cache keys include
+# platform/flags/jax version, so CPU test executables coexist safely with
+# bench.py's TPU entries.  First run pays full price and fills the cache;
+# repeat runs (the common case while developing) skip straight to
+# execution.  Opt out with DCFM_NO_COMPILE_CACHE=1 for a cold-cache
+# timing.
+if not os.environ.get("DCFM_NO_COMPILE_CACHE"):
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 

@@ -607,3 +607,26 @@ def test_unreadable_candidate_never_masks_valid_one(tmp_path):
     os.unlink(base)
     with pytest.raises(ValueError, match="unreadable"):
         discover_checkpoint(base, prefer_plain=True)
+
+
+def test_torn_set_does_not_shadow_valid_plain(tmp_path):
+    """A complete-but-torn set (files at different iterations - a crash
+    landed between two processes' saves) is unloadable and must not
+    shadow a valid plain checkpoint, even when its proc-0 iteration is
+    the highest number on disk."""
+    from dcfm_tpu.utils.checkpoint import (
+        _FORMAT_VERSION, _atomic_savez, discover_checkpoint)
+
+    base = str(tmp_path / "chain.ck")
+    _fake_proc_file(base, 0, 2, iteration=20)   # torn: 20 vs 10
+    _fake_proc_file(base, 1, 2, iteration=10)
+    _atomic_savez(base, {"version": _FORMAT_VERSION, "config": {},
+                         "treedef": "", "iteration": 15,
+                         "fingerprint": "f"}, {})
+    kind, _ = discover_checkpoint(base, prefer_plain=False)
+    assert kind == "plain"
+    # with no plain file the torn set surfaces its refusal, not "none"
+    import os
+    os.unlink(base)
+    with pytest.raises(ValueError, match="disagree on the iteration"):
+        discover_checkpoint(base, prefer_plain=False)

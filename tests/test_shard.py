@@ -29,7 +29,7 @@ def _run(Y, m, r, mesh_devices=0):
 def test_mesh_matches_vmap_one_shard_per_device():
     Y, _ = make_synthetic(80, 160, 4, seed=2)
     m = ModelConfig(num_shards=8, factors_per_shard=3, rho=0.9)
-    r = RunConfig(burnin=30, mcmc=30, thin=1, seed=0)
+    r = RunConfig(burnin=15, mcmc=15, thin=1, seed=0)
     res1 = _run(Y, m, r)
     res8 = _run(Y, m, r, mesh_devices=8)
     np.testing.assert_allclose(
@@ -44,7 +44,7 @@ def test_mesh_matches_vmap_multiple_shards_per_device():
     """config-5 layout: more shards than devices -> vmap within shard_map."""
     Y, _ = make_synthetic(60, 160, 4, seed=4)
     m = ModelConfig(num_shards=16, factors_per_shard=2, rho=0.8)
-    r = RunConfig(burnin=20, mcmc=20, thin=1, seed=1)
+    r = RunConfig(burnin=10, mcmc=10, thin=1, seed=1)
     res1 = _run(Y, m, r)
     res8 = _run(Y, m, r, mesh_devices=8)
     np.testing.assert_allclose(
@@ -60,7 +60,7 @@ def test_mesh_dl_prior_statistically_equivalent():
     same truth to the same accuracy."""
     Y, St = make_synthetic(120, 64, 3, seed=8)
     m = ModelConfig(num_shards=4, factors_per_shard=3, rho=0.8, prior="dl")
-    r = RunConfig(burnin=150, mcmc=150, thin=1, seed=3)
+    r = RunConfig(burnin=80, mcmc=80, thin=1, seed=3)
     res1 = _run(Y, m, r)
     res4 = _run(Y, m, r, mesh_devices=4)
 
@@ -71,6 +71,25 @@ def test_mesh_dl_prior_statistically_equivalent():
     assert np.isfinite(res4.Sigma).all()
     assert e1 < 0.4 and e4 < 0.4
     assert abs(e1 - e4) < 0.1
+
+
+def test_mesh_dl_prior_short_chain_tight():
+    """Tight DL mesh-layout pin, complementing the statistical test above:
+    over a FEW sweeps the psum reduction-order ulps cannot have flipped a
+    GIG accept/reject branch yet (deterministic for a fixed seed on the
+    virtual mesh), so mesh and vmap layouts must agree to float noise.
+    A DL mesh-layout bug costing even ~0.01 rel err fails here, where the
+    statistical tolerances above would let it through."""
+    Y, _ = make_synthetic(60, 64, 3, seed=9)
+    m = ModelConfig(num_shards=4, factors_per_shard=3, rho=0.8, prior="dl")
+    r = RunConfig(burnin=1, mcmc=2, thin=1, seed=5)
+    res1 = _run(Y, m, r)
+    res4 = _run(Y, m, r, mesh_devices=4)
+    np.testing.assert_allclose(res1.sigma_blocks, res4.sigma_blocks,
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(res1.state.Lambda), np.asarray(res4.state.Lambda),
+        rtol=1e-4, atol=1e-5)
 
 
 def test_combine_chunks_matches_single_shot():
