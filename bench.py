@@ -140,6 +140,7 @@ def main():
         "upload_s": round(res.phase_seconds["upload_s"], 2),
         "fetch_s": round(res.phase_seconds["fetch_s"], 2),
         "assemble_s": round(res.phase_seconds["assemble_s"], 2),
+        "checkpoint_s": round(res.phase_seconds["checkpoint_s"], 2),
         "preprocess_s": round(res.phase_seconds["preprocess_s"], 2),
         "init_s": round(res.phase_seconds["init_s"], 2),
         "tunnel_MBps": round(tunnel_mbps, 2),

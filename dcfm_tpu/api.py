@@ -575,7 +575,6 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
                 except Exception as e:
                     failure = failure or f"checkpoint unreadable: {e}"
             if source is not None:
-                kind, found = source
                 try:
                     meta = read_checkpoint_meta(meta_path)
                     reason = checkpoint_compatible(meta, cfg, fingerprint)

@@ -121,13 +121,16 @@ class ModelConfig:
     # unusable - SURVEY.md section 7 "Numerics").
     combine_dtype: str = "float32"  # "float32" | "bfloat16"
     # Implementation of the Lambda-update batched K x K Cholesky sampler
-    # (the hot kernel, SURVEY.md C10).  "auto" picks the statically-unrolled
-    # elementwise XLA path for K <= 16 and lax.linalg beyond; "pallas" uses
-    # the fused sampler TPU kernel (ops/pallas_gaussian.py, interpreter
-    # mode off-TPU); "pallas-fused" additionally forms Q in-kernel
-    # (EXPERIMENTAL: saves the (P, K, K) HBM round-trip but measures
-    # slower - see README); "unrolled"/"lax" force those paths.  See
-    # scripts/bench_lambda_kernel.py for the measured comparison.
+    # (SURVEY.md C10).  "auto" picks the statically-unrolled elementwise
+    # XLA path for K <= 16 and lax.linalg beyond - use it.  The profiled
+    # truth (README "Where the sweep goes"): this op is ~13 us/iteration,
+    # under 1% of the sweep, and the hand-written TPU kernels are
+    # EXPERIMENTAL testbeds that measure at parity at best ("pallas",
+    # settled at K=8 AND K=16 - all three impls sit in the same
+    # 15-40 us tunnel-noise band, scripts/bench_lambda_kernel.py) or
+    # strictly slower ("pallas-fused", forms Q in-kernel; the lane
+    # broadcast of the shard-constant E dominates).  "auto" never selects
+    # either; they stay selectable for kernel development only.
     lambda_kernel: str = "auto"
     # Adaptive rank truncation (see AdaptConfig).  Off by default: the
     # reference model has a fixed per-shard factor budget.

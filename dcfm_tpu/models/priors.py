@@ -33,7 +33,8 @@ import jax.numpy as jnp
 from jax import lax
 
 from dcfm_tpu.config import ModelConfig
-from dcfm_tpu.ops.gamma import gamma_rate, inverse_gamma_rate
+from dcfm_tpu.ops.gamma import (
+    gamma_rate, gamma_rate_half_integer, inverse_gamma_rate)
 from dcfm_tpu.ops.gig import gig, inverse_gaussian
 
 
@@ -97,9 +98,20 @@ def make_mgp(cfg: ModelConfig) -> Prior:
         # masking) carry no loading observation: their psi redraws from the
         # prior Gamma(df/2, df/2), not the +1/2-shape conditional.
         a = jnp.ones((K,), lam2.dtype) if active is None else active
-        psijh = gamma_rate(
-            k_psi, c.df / 2 + 0.5 * a[None, :],
-            c.df / 2 + 0.5 * tauh[None, :] * lam2)
+        psi_rate = c.df / 2 + 0.5 * tauh[None, :] * lam2
+        if float(c.df).is_integer() and c.df <= 7:
+            # half-integer shapes (df + active = integer <= 8): draw the
+            # exact chi^2 construction instead of the rejection sampler -
+            # this (P, K)-sized gamma is the biggest RNG site of the whole
+            # sweep, and the while_loop-free path measured ~25% off the
+            # sweep's device time at the bench shape (ops/gamma.py).
+            twice = (int(c.df)
+                     + jnp.broadcast_to(a[None, :], lam2.shape).astype(
+                         jnp.int32))
+            psijh = gamma_rate_half_integer(
+                k_psi, twice, psi_rate, max_twice=int(c.df) + 1)
+        else:
+            psijh = gamma_rate(k_psi, c.df / 2 + 0.5 * a[None, :], psi_rate)
 
         # delta_h | rest, sequential in h with tau recomputed after each
         # update (``divideconquer.m:154-165``, with Q4 fixed: everything here

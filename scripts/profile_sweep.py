@@ -1,0 +1,240 @@
+"""Per-conditional device-time budget of the Gibbs sweep on real hardware.
+
+Captures a ``jax.profiler`` trace of the jitted chunk function at the
+north-star bench shape and aggregates TPU device-op time by the
+``named_scope`` labels that models/conditionals.py puts on every
+conditional (z_update / x_update / lambda_update / prior_update /
+ps_update / combine).  This is the table the README's performance section
+publishes: where the ~1.4 ms/iteration sweep actually goes, measured on
+the chip rather than inferred.
+
+Run: python scripts/profile_sweep.py           (~1-2 min over the tunnel)
+Env: PROF_P/_G/_N/_K (bench shape default), PROF_ITERS (traced chunk
+length, default 50).
+"""
+
+import glob
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+P_TOTAL = int(os.environ.get("PROF_P", 10_000))
+G = int(os.environ.get("PROF_G", 64))
+N = int(os.environ.get("PROF_N", 500))
+K_TOTAL = int(os.environ.get("PROF_K", 512))
+ITERS = int(os.environ.get("PROF_ITERS", 50))
+
+SCOPES = ("z_update", "x_update", "lambda_update", "prior_update",
+          "ps_update", "combine")
+
+
+def _capture(tmpdir: str) -> float:
+    """Trace one compiled ITERS-iteration chunk; returns its wall seconds."""
+    import jax
+
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+    from dcfm_tpu import ModelConfig, RunConfig
+    from dcfm_tpu.api import _local_fns
+    from dcfm_tpu.models.sampler import schedule_array
+
+    rng = np.random.default_rng(0)
+    k_true = 8
+    L = (rng.standard_normal((P_TOTAL, k_true))
+         / np.sqrt(k_true)).astype(np.float32)
+    F = rng.standard_normal((N, k_true)).astype(np.float32)
+    Y = F @ L.T + 0.3 * rng.standard_normal((N, P_TOTAL)).astype(np.float32)
+
+    model = ModelConfig(num_shards=G, factors_per_shard=K_TOTAL // G,
+                        rho=0.9, combine_dtype="bfloat16")
+    # thin=5 like the bench: the traced chunk includes combine draws at
+    # the bench cadence, so "combine" shows at its amortized weight
+    run = RunConfig(burnin=0, mcmc=ITERS, thin=5, seed=0)
+    sched = schedule_array(run)
+
+    from dcfm_tpu.utils.preprocess import preprocess
+    pre = preprocess(Y, G, seed=0)
+    init_fn, chunk_fn = _local_fns(model, ITERS, 1, 0)
+    key = jax.random.key(0)
+    dev = jax.devices()[0]
+    Yd = jax.device_put(jax.numpy.asarray(pre.data), dev)
+    carry = jax.device_put(init_fn(key, Yd), dev)
+    # compile + warm.  Completion is forced with a real device->host fetch
+    # of the trace output (np.asarray), NOT block_until_ready: under the
+    # axon remote plugin block_until_ready returns early, which would let
+    # the warm call's device execution bleed into the traced window and
+    # double every measurement.  An output fetch cannot lie - the buffer
+    # only exists once the program finished.
+    out = chunk_fn(key, Yd, carry, sched)
+    np.asarray(out[2])
+    carry = out[0]
+    with jax.profiler.trace(tmpdir):
+        t0 = time.perf_counter()
+        out = chunk_fn(key, Yd, carry, sched)
+        np.asarray(out[2])
+        wall = time.perf_counter() - t0
+    return wall
+
+
+def _decode(buf: bytes) -> dict:
+    """Minimal protobuf wire decoder -> {field_number: [values]} (nested
+    messages stay raw bytes).  The image ships no xplane_pb2 bindings and
+    the tensorboard-plugin converter's pywrap entry point is broken, so
+    the xplane is read straight off the wire; the XPlane schema fields
+    used below were verified against a captured trace (see _aggregate)."""
+    import struct
+    out = {}
+    i, n = 0, len(buf)
+    while i < n:
+        key = 0
+        shift = 0
+        while True:
+            b = buf[i]
+            i += 1
+            key |= (b & 0x7f) << shift
+            shift += 7
+            if not b & 0x80:
+                break
+        field, wt = key >> 3, key & 7
+        if wt == 0:                       # varint
+            v = 0
+            shift = 0
+            while True:
+                b = buf[i]
+                i += 1
+                v |= (b & 0x7f) << shift
+                shift += 7
+                if not b & 0x80:
+                    break
+        elif wt == 2:                     # length-delimited
+            ln = 0
+            shift = 0
+            while True:
+                b = buf[i]
+                i += 1
+                ln |= (b & 0x7f) << shift
+                shift += 7
+                if not b & 0x80:
+                    break
+            v = buf[i:i + ln]
+            i += ln
+        elif wt == 1:                     # fixed64
+            v = struct.unpack("<d", buf[i:i + 8])[0]
+            i += 8
+        elif wt == 5:                     # fixed32
+            v = struct.unpack("<f", buf[i:i + 4])[0]
+            i += 4
+        else:
+            raise ValueError(f"unsupported wire type {wt}")
+        out.setdefault(field, []).append(v)
+    return out
+
+
+def _aggregate(tmpdir: str) -> dict:
+    """xplane.pb -> device-op microseconds per named_scope.
+
+    Schema (verified empirically on this jax/libtpu): XSpace.planes=1;
+    XPlane{name=2, lines=3, event_metadata=4 (map id=1 -> XEventMetadata
+    =2), stat_metadata=5 (map -> XStatMetadata{id=1, name=2})};
+    XLine{name=2, events=4}; XEvent{metadata_id=1, duration_ps=3};
+    XEventMetadata{id=1, stats=5}; XStat{metadata_id=1, str_value=5}.
+    The python-level named_scope path (z_update/...) lands in each op's
+    'tf_op' stat on its event METADATA; ops on the "XLA Ops" line are
+    leaves, so summing durations is double-count-free.
+    """
+    xplanes = glob.glob(os.path.join(tmpdir, "**", "*.xplane.pb"),
+                        recursive=True)
+    if not xplanes:
+        raise FileNotFoundError(f"no xplane.pb under {tmpdir}")
+    space = _decode(open(xplanes[0], "rb").read())
+    tpu = None
+    for pl in space.get(1, []):
+        p = _decode(pl)
+        if p.get(2, [b""])[0].startswith(b"/device:TPU"):
+            tpu = p
+            break
+    if tpu is None:
+        raise RuntimeError("no TPU plane in the trace")
+    # stat-metadata name -> id (ids are capture-specific)
+    stat_ids = {}
+    for e in tpu.get(5, []):
+        kv = _decode(e)
+        md = _decode(kv[2][0])
+        stat_ids[md.get(2, [b""])[0]] = kv[1][0]
+    tf_op_id = stat_ids.get(b"tf_op")
+    # event-metadata id -> scope path (the tf_op stat's string value)
+    scope_of = {}
+    for e in tpu.get(4, []):
+        kv = _decode(e)
+        md = _decode(kv[2][0])
+        path = b""
+        for st in md.get(5, []):
+            s = _decode(st)
+            if tf_op_id is not None and s.get(1, [None])[0] == tf_op_id:
+                path = s.get(5, [b""])[0]
+        scope_of[kv[1][0]] = path.decode(errors="replace")
+    totals = {s: 0.0 for s in SCOPES}
+    other = 0.0
+    total = 0.0
+    other_paths = {}
+    for ln in tpu.get(3, []):
+        line = _decode(ln)
+        if line.get(2, [b""])[0] != b"XLA Ops":
+            continue
+        for evb in line.get(4, []):
+            ev = _decode(evb)
+            dur_us = ev.get(3, [0])[0] / 1e6          # ps -> us
+            total += dur_us
+            path = scope_of.get(ev.get(1, [None])[0], "")
+            for s in SCOPES:
+                if s in path:
+                    totals[s] += dur_us
+                    break
+            else:
+                other += dur_us
+                # coarse attribution for the unscoped remainder: last two
+                # path components (scan plumbing, RNG, health stats, ...)
+                tag = "/".join(path.split("/")[-2:]) if path else "<none>"
+                other_paths[tag] = other_paths.get(tag, 0.0) + dur_us
+    top_other = dict(sorted(other_paths.items(), key=lambda kv: -kv[1])[:8])
+    return {"per_scope_us": totals, "other_us": other,
+            "device_total_us": total, "top_other_us": top_other}
+
+
+def main() -> int:
+    import jax
+    dev = jax.devices()[0]
+    with tempfile.TemporaryDirectory() as tmpdir:
+        wall = _capture(tmpdir)
+        agg = _aggregate(tmpdir)
+    per_iter = {s: round(v / ITERS, 1)
+                for s, v in agg["per_scope_us"].items()}
+    out = {
+        "artifact": "per-conditional device-time budget",
+        "device": str(dev),
+        "shape": {"p": P_TOTAL, "g": G, "n": N, "k": K_TOTAL,
+                  "iters_traced": ITERS, "thin": 5},
+        "wall_s_per_iter": round(wall / ITERS * 1e3, 3),   # ms
+        "device_us_per_iter_by_scope": per_iter,
+        "other_us_per_iter": round(agg["other_us"] / ITERS, 1),
+        "device_total_us_per_iter": round(
+            agg["device_total_us"] / ITERS, 1),
+        "top_other_us_per_iter": {k: round(v / ITERS, 1)
+                                  for k, v in agg["top_other_us"].items()},
+    }
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
