@@ -13,7 +13,38 @@ import jax.numpy as jnp
 
 
 def gamma_rate(key: jax.Array, shape, rate, *, sample_shape=None) -> jax.Array:
-    """Gamma(shape, rate) draws; broadcasts shape/rate like NumPy."""
+    """Gamma(shape, rate) draws; broadcasts shape/rate like NumPy.
+
+    Small STATIC half-integer shapes (2*shape integer, shape <= 2) take an
+    exact rejection-free path: Gamma(1, r) is Exp(r) = -log(U)/r and
+    Gamma(k/2, r) is chi^2_k/(2r), so no Marsaglia-Tsang ``while_loop``
+    runs.  This covers the horseshoe's shape-1 inverse-gamma auxiliaries
+    and the Dirichlet-Laplace phi draw (a = 1/2) - the per-sweep
+    (P, K)-sized gamma sites of those priors - the same construction that
+    took 44% off the MGP sweep (see :func:`gamma_rate_half_integer`, the
+    elementwise-shape variant).  Larger shapes keep ``jax.random.gamma``:
+    its rejection step accepts ~99% first-try there, while the chi^2 sum
+    would need 2*shape normals.
+    """
+    if (isinstance(shape, (int, float))
+            and float(2 * shape).is_integer() and 0 < shape <= 2):
+        rate = jnp.asarray(rate)
+        if sample_shape is None:
+            out_shape = tuple(rate.shape)
+        elif isinstance(sample_shape, int):
+            out_shape = (sample_shape,)     # the fallback accepts ints too
+        else:
+            out_shape = tuple(sample_shape)
+        tw = int(2 * shape)
+        if tw == 2:
+            # jax.random.exponential computes -log1p(-u): exact in the
+            # small-draw tail, which inverse_gamma_rate maps to the large
+            # tail the horseshoe clamps care about
+            g = jax.random.exponential(key, out_shape, jnp.float32)
+        else:
+            z = jax.random.normal(key, out_shape + (tw,), jnp.float32)
+            g = 0.5 * jnp.sum(z * z, axis=-1)
+        return g / jnp.broadcast_to(rate, out_shape)
     shape = jnp.asarray(shape)
     rate = jnp.asarray(rate)
     out_shape = sample_shape

@@ -110,12 +110,26 @@ def test_batched_sampler_large_k_fallback_moments(rng):
 
 
 def test_gamma_rate_convention():
-    """Gamma(shape, rate): mean = shape/rate, var = shape/rate^2 (quirk Q8)."""
-    shape, rate = 2.5, 4.0
-    x = np.asarray(gamma_rate(jax.random.key(2), shape, rate,
-                              sample_shape=(200000,)))
-    np.testing.assert_allclose(x.mean(), shape / rate, rtol=0.02)
-    np.testing.assert_allclose(x.var(), shape / rate**2, rtol=0.05)
+    """Gamma(shape, rate): mean = shape/rate, var = shape/rate^2 (quirk Q8).
+
+    Shapes 0.5/1.0/1.5/2.0 exercise the static rejection-free fast path
+    (chi^2 / exponential constructions); 2.5 exercises the
+    jax.random.gamma fallback - both branches must be the same
+    distribution."""
+    for shape, rate in [(0.5, 2.0), (1.0, 4.0), (1.5, 0.5), (2.0, 3.0),
+                        (2.5, 4.0)]:
+        x = np.asarray(gamma_rate(jax.random.key(2), shape, rate,
+                                  sample_shape=(200000,)))
+        assert np.isfinite(x).all() and (x > 0).all(), shape
+        np.testing.assert_allclose(x.mean(), shape / rate, rtol=0.03,
+                                   err_msg=f"shape={shape}")
+        np.testing.assert_allclose(x.var(), shape / rate**2, rtol=0.06,
+                                   err_msg=f"shape={shape}")
+    # int sample_shape accepted on both branches
+    assert gamma_rate(jax.random.key(4), 1.0, 1.0,
+                      sample_shape=64).shape == (64,)
+    assert gamma_rate(jax.random.key(4), 2.5, 1.0,
+                      sample_shape=64).shape == (64,)
 
 
 def test_inverse_gamma():
