@@ -92,9 +92,9 @@ class FitResult:
     # (p, p) entrywise posterior standard deviation of the covariance, in
     # the caller's coordinates; set when ModelConfig.posterior_sd is on.
     Sigma_sd: Optional[np.ndarray] = None
-    # (g(g+1)/2, P, P) entrywise-SD upper panels (shard coordinates); the
-    # dense grid is derived lazily via .sigma_sd_blocks.
-    sd_upper_panels: Optional[np.ndarray] = None
+    # entrywise-SD upper panels: see the lazy .sd_upper_panels property
+    # (backing fields _sd_upper_f32 / _sd_q8_panels / _sd_q8_scales below,
+    # mirroring the posterior-mean panels)
     # Thinned posterior draws (RunConfig.store_draws): {"Lambda": (S, g, P,
     # K), "ps": (S, g, P), "X": (S, n, K), "H": (S, g, g, K, K)} in shard
     # coordinates (permuted / standardized; use .preprocess to map back),
@@ -120,6 +120,21 @@ class FitResult:
     _upper_f32: Optional[np.ndarray] = None
     _q8_panels: Optional[np.ndarray] = None
     _q8_scales: Optional[np.ndarray] = None
+    _sd_upper_f32: Optional[np.ndarray] = None
+    _sd_q8_panels: Optional[np.ndarray] = None
+    _sd_q8_scales: Optional[np.ndarray] = None
+
+    @functools.cached_property
+    def sd_upper_panels(self) -> Optional[np.ndarray]:
+        """(g(g+1)/2, P, P) float32 entrywise-SD upper panels (shard
+        coordinates; ModelConfig.posterior_sd), dequantized lazily under
+        the quant8 fetch; None when posterior_sd was off.  The dense grid
+        is derived lazily via .sigma_sd_blocks."""
+        if self._sd_upper_f32 is not None:
+            return self._sd_upper_f32
+        if self._sd_q8_panels is None:
+            return None
+        return dequantize_panels(self._sd_q8_panels, self._sd_q8_scales)
 
     @functools.cached_property
     def upper_panels(self) -> np.ndarray:
@@ -268,15 +283,50 @@ def _fetch_jit(g: int, num_chains: int, mode: str, mesh=None):
     def prep(acc, inv_count):
         u = extract_upper_blocks(
             acc.mean(axis=0) if num_chains > 1 else acc, g=g) * inv_count
-        if mode == "quant8":
-            # Max-abs int8 per panel: one float32 scale per P x P block.
-            # Entry error <= scale/254, ~4e-3 of the panel max - far below
-            # Monte Carlo error; accumulation stayed float32 on device.
-            scale = jnp.max(jnp.abs(u), axis=(1, 2))        # (n_pairs,)
-            safe = jnp.where(scale > 0, scale, 1.0)[:, None, None]
-            q = jnp.round(u * (127.0 / safe)).astype(jnp.int8)
-            return q, scale
-        return u.astype(jnp.dtype(mode))
+        return _cast_for_link(u, mode)
+    if mesh is None:
+        return jax.jit(prep)
+    from jax.sharding import NamedSharding, PartitionSpec
+    return jax.jit(prep, out_shardings=NamedSharding(mesh, PartitionSpec()))
+
+
+def _cast_for_link(u, mode: str):
+    """Down-cast upper panels for the device->host link - the single
+    device-side home for the quantization convention that
+    utils/estimate.dequantize_panels and the native q8 assembler mirror.
+
+    quant8 is max-abs int8 per panel: one float32 scale per P x P block,
+    entry error <= scale/254, ~4e-3 of the panel max - far below Monte
+    Carlo error; accumulation stayed float32 on device."""
+    if mode == "quant8":
+        scale = jnp.max(jnp.abs(u), axis=(1, 2))            # (n_pairs,)
+        safe = jnp.where(scale > 0, scale, 1.0)[:, None, None]
+        q = jnp.round(u * (127.0 / safe)).astype(jnp.int8)
+        return q, scale
+    return u.astype(jnp.dtype(mode))
+
+
+@functools.lru_cache(maxsize=64)
+def _fetch_sd_jit(g: int, num_chains: int, mode: str, mesh=None):
+    """Jitted device-side posterior-SD fetch prep: the entrywise SD is
+    formed ON DEVICE in float32 from the raw first/second-moment sums
+    (Bessel-corrected over the pooled draw count), and only then
+    down-cast/quantized for the link.  Variance-by-differences cancels
+    catastrophically in reduced precision, so the subtraction must happen
+    at full precision - but an SD VALUE, like a covariance value, rounds
+    benignly; computing it on device is what lets posterior_sd runs use
+    the same quant8/f16 link optimizations as the mean (the old design
+    forced a full-f32 fetch of both moment panels instead, 4x the
+    bytes)."""
+    def prep(acc, acc_sq, inv_count, bessel):
+        if num_chains > 1:
+            acc, acc_sq = acc.mean(axis=0), acc_sq.mean(axis=0)
+        # upper panels first: the grid is exactly symmetric, so the
+        # variance/sqrt math runs on g(g+1)/2 panels instead of g^2
+        mean = extract_upper_blocks(acc, g=g) * inv_count
+        m2 = extract_upper_blocks(acc_sq, g=g) * inv_count
+        sd = jnp.sqrt(jnp.maximum(m2 - mean * mean, 0.0) * bessel)
+        return _cast_for_link(sd, mode)
     if mesh is None:
         return jax.jit(prep)
     from jax.sharding import NamedSharding, PartitionSpec
@@ -753,11 +803,11 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
     # optionally down-cast or int8-quantized (backend.fetch_dtype) on a slow
     # link.  Chains are averaged on device first (each chain is an
     # equal-weight posterior-mean estimate, so the mixture mean is the
-    # pooled estimate).  posterior_sd forces full-precision fetch: the SD
-    # comes from the E[X^2] - E[X]^2 difference, which reduced-precision
-    # moments cancel catastrophically (fetch rounding is benign only for a
-    # value reported directly, not for a variance-by-differences).
-    fetch_mode = "float32" if m.posterior_sd else cfg.backend.fetch_dtype
+    # pooled estimate).  posterior_sd uses the same link optimizations:
+    # the E[X^2] - E[X]^2 difference (which reduced precision would cancel
+    # catastrophically) is formed ON DEVICE in f32 (_fetch_sd_jit), so
+    # only direct SD values - benign to round - cross the link.
+    fetch_mode = cfg.backend.fetch_dtype
     # multi-process: replicate fetch outputs over the mesh (cross-host
     # all-gather inside the jit) so every process can materialize them
     fetch_mesh = mesh if multiproc else None
@@ -836,22 +886,41 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
         miss = np.isnan(Y_imputed)
         Y_imputed[miss] = rec[miss]
 
-    Sigma_sd = sd_upper = None
+    Sigma_sd = sd_upper = sd_q8 = sd_q8_scales = None
     if carry.sigma_sq_acc is not None:
-        # entrywise posterior SD from the accumulated first/second moments,
-        # Bessel-corrected over the pooled draw count; de-standardization
-        # scales an SD exactly like a covariance entry (linear in the
-        # scale product), so the same restore path applies.
+        # entrywise posterior SD, formed on device from the accumulated
+        # first/second moment sums (Bessel-corrected over the pooled draw
+        # count - _fetch_sd_jit); de-standardization scales an SD exactly
+        # like a covariance entry (linear in the scale product), so the
+        # same restore paths apply.
         n_draws = max(n_saved * C, 1)
-        t_f = time.perf_counter()
-        upper_sq = _fetch_upper(carry.sigma_sq_acc)
-        phase["fetch_s"] += time.perf_counter() - t_f
-        var_u = np.maximum(upper_sq - upper * upper, 0.0)
-        if n_draws > 1:
-            var_u *= n_draws / (n_draws - 1)
-        sd_upper = np.sqrt(var_u)
-        Sigma_sd = assemble_from_upper(sd_upper, pre,
-                                       reinsert_zero_cols=True)
+        bessel = np.float32(n_draws / (n_draws - 1) if n_draws > 1 else 1.0)
+        sd_fetch = _fetch_sd_jit(m.num_shards, C, fetch_mode, fetch_mesh)
+        if fetch_mode == "quant8":
+            q_dev, s_dev = sd_fetch(carry.sigma_acc, carry.sigma_sq_acc,
+                                    inv_count, bessel)
+            sd_q8, sd_q8_scales, fetch_s = _quant8_fetch(q_dev, s_dev)
+            phase["fetch_s"] += fetch_s
+            t_as = time.perf_counter()
+            Sigma_sd = assemble_from_q8(sd_q8, sd_q8_scales, pre,
+                                        destandardize=True,
+                                        reinsert_zero_cols=True)
+            if Sigma_sd is None:
+                sd_upper = dequantize_panels(sd_q8, sd_q8_scales)
+                sd_q8 = sd_q8_scales = None
+                Sigma_sd = assemble_from_upper(sd_upper, pre,
+                                               reinsert_zero_cols=True)
+            phase["assemble_s"] += time.perf_counter() - t_as
+        else:
+            t_f = time.perf_counter()
+            sd_upper = np.asarray(sd_fetch(
+                carry.sigma_acc, carry.sigma_sq_acc, inv_count,
+                bessel)).astype(np.float32, copy=False)
+            phase["fetch_s"] += time.perf_counter() - t_f
+            t_as = time.perf_counter()
+            Sigma_sd = assemble_from_upper(sd_upper, pre,
+                                           reinsert_zero_cols=True)
+            phase["assemble_s"] += time.perf_counter() - t_as
     seconds = time.perf_counter() - t0
     phase["chain_s"] = float(sum(chunk_secs))
 
@@ -873,7 +942,9 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
         chunk_seconds=chunk_secs,
         phase_seconds=phase,
         Sigma_sd=Sigma_sd,
-        sd_upper_panels=sd_upper,
+        _sd_upper_f32=sd_upper,
+        _sd_q8_panels=sd_q8,
+        _sd_q8_scales=sd_q8_scales,
         draws=draws,
         Y_imputed=Y_imputed,
     )

@@ -122,8 +122,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "one (the final chunk always saves); raise this "
                         "when the snapshot transfer outlasts a chunk")
     f.add_argument("--resume", action="store_true",
-                   help="resume from --checkpoint when it exists (a resumed "
-                        "chain is bitwise-identical to an uninterrupted one)")
+                   help="resume from --checkpoint when one exists - a "
+                        "plain file or a multi-process .procK-of-N set, "
+                        "resharded if the topology changed - starting "
+                        "fresh only when NONE exists; an existing but "
+                        "incompatible checkpoint is a hard refusal, never "
+                        "a silent restart (a same-topology resumed chain "
+                        "is bitwise-identical to an uninterrupted one)")
     return p
 
 
@@ -150,8 +155,19 @@ def main(argv=None) -> int:
             f"{args.shards} (k/g factors per shard)")
     if args.resume and not args.checkpoint:
         raise SystemExit("--resume requires --checkpoint")
-    resume = bool(args.resume and args.checkpoint
-                  and os.path.exists(args.checkpoint))
+    # Resume-if-anything-exists, STRICT once something does: when any
+    # checkpoint source is discoverable (plain file or .procK-of-N set),
+    # strict mode makes an incompatible checkpoint a hard refusal instead
+    # of a silent fresh start that would overwrite the old run's progress
+    # at the next save.  Only a truly absent checkpoint starts fresh.
+    resume = False
+    if args.resume:
+        from dcfm_tpu.utils.checkpoint import discover_checkpoint
+        try:
+            resume = discover_checkpoint(args.checkpoint,
+                                         prefer_plain=True) is not None
+        except Exception:
+            resume = True        # unreadable: let strict mode say why
     cfg = FitConfig(
         model=ModelConfig(
             num_shards=args.shards,

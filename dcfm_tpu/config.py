@@ -111,7 +111,8 @@ class ModelConfig:
     # - the uncertainty quantification the posterior-mean-only reference
     # throws away (``divideconquer.m:194`` keeps nothing but the mean).
     # Costs one extra (Gl, G, P, P) accumulator per device and a second
-    # upper-panel fetch.
+    # upper-panel fetch; the SD itself is formed on device in f32
+    # (api._fetch_sd_jit), so the fetch honors quant8/f16 like the mean.
     posterior_sd: bool = False
     # Input dtype for the combine-step block matmuls (the O(p^2 K) einsum
     # that dominates save iterations).  "bfloat16" feeds the MXU at native

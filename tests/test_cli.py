@@ -199,3 +199,21 @@ def test_cli_unsupported_format_errors(tmp_path):
     bad.write_text("1,2\n3,4\n")
     with pytest.raises(SystemExit):
         main(["fit", str(bad), "-g", "1", "-k", "2"])
+
+
+def test_cli_resume_refuses_incompatible_checkpoint(tmp_path, capsys,
+                                                    data_npy):
+    """--resume with an EXISTING but config-incompatible checkpoint must
+    hard-fail, never silently restart (the next save would overwrite the
+    old run's progress)."""
+    path, _, _ = data_npy
+    ck = str(tmp_path / "chain.npz")
+    rc, _ = _run(capsys, [
+        "fit", path, "-g", "2", "-k", "6", "--burnin", "16", "--mcmc",
+        "16", "--thin", "2", "--checkpoint", ck,
+        "--out", str(tmp_path / "a.npy")])
+    assert rc == 0
+    with pytest.raises(ValueError, match="refusing to resume"):
+        main(["fit", path, "-g", "3", "-k", "6", "--burnin", "16",
+              "--mcmc", "16", "--thin", "2", "--checkpoint", ck,
+              "--resume", "--out", str(tmp_path / "b.npy")])
