@@ -19,7 +19,12 @@ if "xla_force_host_platform_device_count" not in flags:
 
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+# DCFM_TPU_TESTS=1 opts into running the suite on the real accelerator
+# (the TPU lane: compiled-Mosaic pallas smoke and any test not needing 8
+# devices; mesh tests skip themselves on a 1-chip platform).  Default is
+# the CPU virtual-mesh platform, which the distributed tests require.
+if not os.environ.get("DCFM_TPU_TESTS"):
+    jax.config.update("jax_platforms", "cpu")
 
 # Persistent XLA compilation cache: the suite's wall-clock is dominated by
 # COMPILES, not iterations (a fresh mesh program costs 30-50 s on this

@@ -94,3 +94,21 @@ def test_posterior_sd_pools_chains_and_checkpoints(tmp_path):
                             resume="auto"))   # finished ckpt -> same result
     np.testing.assert_allclose(res.Sigma_sd, res2.Sigma_sd,
                                rtol=1e-5, atol=1e-7)
+
+
+def test_posterior_sd_quant8_with_chains():
+    """The device-side SD (api._fetch_sd_jit) pools the chain axis BEFORE
+    the moment difference; quant8 must agree with the float32 fetch to
+    quantization accuracy with num_chains > 1."""
+    from dcfm_tpu import BackendConfig
+
+    Y, _ = make_synthetic(40, 24, 2, seed=103)
+    m = ModelConfig(num_shards=2, factors_per_shard=2, rho=0.6,
+                    posterior_sd=True)
+    r = RunConfig(burnin=30, mcmc=30, thin=1, seed=0, num_chains=2)
+    sd32 = fit(Y, FitConfig(model=m, run=r)).posterior_sd()
+    sdq = fit(Y, FitConfig(
+        model=m, run=r,
+        backend=BackendConfig(fetch_dtype="quant8"))).posterior_sd()
+    rel = np.linalg.norm(sdq - sd32) / np.linalg.norm(sd32)
+    assert rel < 1e-2, rel
