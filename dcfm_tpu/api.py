@@ -264,6 +264,22 @@ def _mesh_fns(mesh, model: ModelConfig, num_iters: int, num_chains: int = 1,
                             num_stored_draws=num_stored_draws)
 
 
+def _cast_for_link(u, mode: str):
+    """Down-cast upper panels for the device->host link - the single
+    device-side home for the quantization convention that
+    utils/estimate.dequantize_panels and the native q8 assembler mirror.
+
+    quant8 is max-abs int8 per panel: one float32 scale per P x P block,
+    entry error <= scale/254, ~4e-3 of the panel max - far below Monte
+    Carlo error; accumulation stayed float32 on device."""
+    if mode == "quant8":
+        scale = jnp.max(jnp.abs(u), axis=(1, 2))            # (n_pairs,)
+        safe = jnp.where(scale > 0, scale, 1.0)[:, None, None]
+        q = jnp.round(u * (127.0 / safe)).astype(jnp.int8)
+        return q, scale
+    return u.astype(jnp.dtype(mode))
+
+
 @functools.lru_cache(maxsize=64)
 def _fetch_jit(g: int, num_chains: int, mode: str, mesh=None):
     """Jitted device-side fetch prep: chain-average, upper-triangle panel
@@ -288,22 +304,6 @@ def _fetch_jit(g: int, num_chains: int, mode: str, mesh=None):
         return jax.jit(prep)
     from jax.sharding import NamedSharding, PartitionSpec
     return jax.jit(prep, out_shardings=NamedSharding(mesh, PartitionSpec()))
-
-
-def _cast_for_link(u, mode: str):
-    """Down-cast upper panels for the device->host link - the single
-    device-side home for the quantization convention that
-    utils/estimate.dequantize_panels and the native q8 assembler mirror.
-
-    quant8 is max-abs int8 per panel: one float32 scale per P x P block,
-    entry error <= scale/254, ~4e-3 of the panel max - far below Monte
-    Carlo error; accumulation stayed float32 on device."""
-    if mode == "quant8":
-        scale = jnp.max(jnp.abs(u), axis=(1, 2))            # (n_pairs,)
-        safe = jnp.where(scale > 0, scale, 1.0)[:, None, None]
-        q = jnp.round(u * (127.0 / safe)).astype(jnp.int8)
-        return q, scale
-    return u.astype(jnp.dtype(mode))
 
 
 @functools.lru_cache(maxsize=64)
@@ -385,6 +385,29 @@ def _quant8_fetch(q_dev, scale_dev, n_slices: int = 8):
         q_host[pos:pos + qh.shape[0]] = qh
         pos += qh.shape[0]
     return q_host, scales, time.perf_counter() - t
+
+
+def _quant8_fetch_assemble(q_dev, scale_dev, pre: PreprocessResult, phase):
+    """quant8 fetch + native one-pass assembly to the final caller-
+    coordinate matrix - the shared path for the posterior-mean and
+    posterior-SD panels.  Returns ``(out, q8_panels, q8_scales, upper)``
+    with exactly one of the (int8 panels+scales, float32 upper) backings
+    set for the FitResult's lazy panel storage; updates ``phase`` fetch/
+    assemble entries in place."""
+    q8, scales, fetch_s = _quant8_fetch(q_dev, scale_dev)
+    phase["fetch_s"] += fetch_s
+    t_as = time.perf_counter()
+    out = assemble_from_q8(q8, scales, pre,
+                           destandardize=True, reinsert_zero_cols=True)
+    upper = None
+    if out is None:
+        # no native library: dequantize once and keep the f32 panels as
+        # the FitResult backing store (they exist anyway)
+        upper = dequantize_panels(q8, scales)
+        q8 = scales = None
+        out = assemble_from_upper(upper, pre, reinsert_zero_cols=True)
+    phase["assemble_s"] += time.perf_counter() - t_as
+    return out, q8, scales, upper
 
 
 def _diagnose(trace_arr: np.ndarray, done: int, run: RunConfig) -> dict:
@@ -835,18 +858,8 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
     if fetch_mode == "quant8":
         q_dev, scale_dev = _fetch_jit(m.num_shards, C, "quant8", fetch_mesh)(
             carry.sigma_acc, inv_count)
-        q8_panels, q8_scales, fetch_s = _quant8_fetch(q_dev, scale_dev)
-        phase["fetch_s"] += fetch_s
-        t_as = time.perf_counter()
-        Sigma = assemble_from_q8(q8_panels, q8_scales, pre,
-                                 destandardize=True, reinsert_zero_cols=True)
-        if Sigma is None:
-            # no native library: dequantize once and keep the f32 panels
-            # as the FitResult backing store (they exist anyway)
-            upper = dequantize_panels(q8_panels, q8_scales)
-            q8_panels = q8_scales = None
-            Sigma = assemble_from_upper(upper, pre, reinsert_zero_cols=True)
-        phase["assemble_s"] += time.perf_counter() - t_as
+        Sigma, q8_panels, q8_scales, upper = _quant8_fetch_assemble(
+            q_dev, scale_dev, pre, phase)
     else:
         t_f = time.perf_counter()
         upper = _fetch_upper(carry.sigma_acc)
@@ -899,18 +912,8 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
         if fetch_mode == "quant8":
             q_dev, s_dev = sd_fetch(carry.sigma_acc, carry.sigma_sq_acc,
                                     inv_count, bessel)
-            sd_q8, sd_q8_scales, fetch_s = _quant8_fetch(q_dev, s_dev)
-            phase["fetch_s"] += fetch_s
-            t_as = time.perf_counter()
-            Sigma_sd = assemble_from_q8(sd_q8, sd_q8_scales, pre,
-                                        destandardize=True,
-                                        reinsert_zero_cols=True)
-            if Sigma_sd is None:
-                sd_upper = dequantize_panels(sd_q8, sd_q8_scales)
-                sd_q8 = sd_q8_scales = None
-                Sigma_sd = assemble_from_upper(sd_upper, pre,
-                                               reinsert_zero_cols=True)
-            phase["assemble_s"] += time.perf_counter() - t_as
+            Sigma_sd, sd_q8, sd_q8_scales, sd_upper = _quant8_fetch_assemble(
+                q_dev, s_dev, pre, phase)
         else:
             t_f = time.perf_counter()
             sd_upper = np.asarray(sd_fetch(
