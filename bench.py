@@ -129,6 +129,10 @@ def main():
         # when the accuracy guard matters most.
         "rel_frob_err": round(err, 4) if np.isfinite(err) else None,
         "seconds": round(seconds, 2),
+        # The tunnel-independent headline: executed iters / chain_s.  The
+        # top-level "value" divides by e2e seconds (fetch included), so it
+        # moves with link weather; THIS number is the code's.
+        "chain_iters_per_sec": round(res.chain_iters_per_sec, 2),
         # Phase split (FitResult.phase_seconds): chain_s is the Gibbs
         # compute (the code under test), fetch_s is the device->host panel
         # transfer (rides the tunnel - see tunnel_MBps), assemble_s is

@@ -117,10 +117,24 @@ def build_parser() -> argparse.ArgumentParser:
     f.add_argument("--checkpoint", default=None, metavar="PATH",
                    help="write the chain state here at every chunk boundary "
                         "(--chunk-size is the cadence)")
-    f.add_argument("--checkpoint-every", type=int, default=1, metavar="K",
-                   help="save every K-th chunk boundary instead of every "
-                        "one (the final chunk always saves); raise this "
-                        "when the snapshot transfer outlasts a chunk")
+    f.add_argument("--checkpoint-every", default="auto", metavar="K",
+                   type=lambda v: v if v == "auto" else int(v),
+                   help="save every K-th chunk boundary (the final chunk "
+                        "always saves).  Default 'auto' measures the first "
+                        "save's drain and sizes K so one save's hidden "
+                        "write fits inside the compute it overlaps")
+    f.add_argument("--checkpoint-mode", default="full",
+                   choices=("full", "light"),
+                   help="'light' = state-only saves (MBs instead of the "
+                        "p^2-sized snapshot; viable on a slow link).  A "
+                        "light resume restores the chain exactly but "
+                        "restarts covariance accumulation at the "
+                        "checkpointed iteration")
+    f.add_argument("--checkpoint-full-every", type=int, default=0,
+                   metavar="N",
+                   help="in light mode, upgrade every N-th due save to a "
+                        "full snapshot (bounds the draws a crash loses); "
+                        "0 = never")
     f.add_argument("--resume", action="store_true",
                    help="resume from --checkpoint when one exists - a "
                         "plain file or a multi-process .procK-of-N set, "
@@ -190,6 +204,8 @@ def main(argv=None) -> int:
         checkpoint_path=args.checkpoint,
         resume=resume,
         checkpoint_every_chunks=args.checkpoint_every,
+        checkpoint_mode=args.checkpoint_mode,
+        checkpoint_full_every=args.checkpoint_full_every,
     )
     res = fit(Y, cfg)
     Sigma = (res.covariance(destandardize=False)
@@ -221,6 +237,7 @@ def main(argv=None) -> int:
         "shape": list(Sigma.shape),
         "seconds": round(res.seconds, 3),
         "iters_per_sec": round(res.iters_per_sec, 2),
+        "chain_iters_per_sec": round(res.chain_iters_per_sec, 2),
         "phase_seconds": {k: round(v, 3)
                           for k, v in res.phase_seconds.items()},
         "tau_log_max": float(np.asarray(res.stats.tau_log_max)),

@@ -261,12 +261,34 @@ class FitConfig:
     # Save every k-th chunk boundary (the final chunk always saves, so a
     # finished run stays resumable-as-noop).  Saves are write-behind
     # (utils/checkpoint.AsyncCheckpointWriter), but each snapshot still
-    # crosses the device->host link; on a slow link, raise this so the
-    # transfer of one save finishes inside the compute of the next k
-    # chunks - measured at the p=10k bench shape over a ~3.5 MB/s tunnel,
-    # a 406 MB snapshot per 250-iteration chunk serializes the chain
-    # behind the link (README Performance).
-    checkpoint_every_chunks: int = 1
+    # crosses the device->host link; on a slow link the transfer of one
+    # save must finish inside the compute of the next k chunks - measured
+    # at the p=10k bench shape over a ~3.5 MB/s tunnel, a 406 MB snapshot
+    # per 250-iteration chunk serializes the chain behind the link (README
+    # Performance).  "auto" (default) measures the FIRST save's actual
+    # drain time and sizes the cadence so exactly that holds; an int
+    # overrides.  NOTE: the write-behind snapshot transiently doubles the
+    # accumulator-dominated device footprint (one extra carry copy); near
+    # device-memory capacity the writer falls back to a synchronous host
+    # fetch automatically.
+    checkpoint_every_chunks: "int | str" = "auto"
+    # What a due (non-final) save contains.  "full": the entire carry -
+    # exact resume, finished-run no-op resume, but the snapshot is
+    # p^2-dominated (406 MB at p=10k).  "light": state-only saves (MBs -
+    # the sampler state without the covariance accumulators; the final
+    # save too).  A light resume restores the chain state exactly but
+    # restarts accumulation at the checkpointed iteration (the raw-sum
+    # accumulators divide by the restarted window's saved count at fetch),
+    # so a crash loses accumulated draws back to the last FULL save - the
+    # documented trade that makes checkpointing viable on a slow link.
+    # Resuming a FINISHED light checkpoint with the same schedule refuses
+    # loudly (there is nothing accumulated to report); extending mcmc
+    # works.
+    checkpoint_mode: str = "full"     # "full" | "light"
+    # In light mode, additionally upgrade every k-th due save to a full
+    # snapshot (bounds the draws lost to a crash); 0 = full save only when
+    # the run ends under mode="full" semantics, i.e. never in light mode.
+    checkpoint_full_every: int = 0
 
 
 def validate(cfg: FitConfig, n: int, p: int) -> None:
@@ -329,10 +351,18 @@ def validate(cfg: FitConfig, n: int, p: int) -> None:
             f"resume must be False, True, or 'auto', got {cfg.resume!r}")
     if cfg.resume and not cfg.checkpoint_path:
         raise ValueError("resume requires checkpoint_path")
-    if cfg.checkpoint_every_chunks < 1:
+    cek = cfg.checkpoint_every_chunks
+    if not (cek == "auto" or (isinstance(cek, int) and cek >= 1)):
         raise ValueError(
-            f"checkpoint_every_chunks must be >= 1, got "
-            f"{cfg.checkpoint_every_chunks}")
+            f"checkpoint_every_chunks must be >= 1 or 'auto', got {cek!r}")
+    if cfg.checkpoint_mode not in ("full", "light"):
+        raise ValueError(
+            f"unknown checkpoint_mode {cfg.checkpoint_mode!r} "
+            "(full | light)")
+    if cfg.checkpoint_full_every < 0:
+        raise ValueError(
+            f"checkpoint_full_every must be >= 0, got "
+            f"{cfg.checkpoint_full_every}")
     if cfg.backend.fetch_dtype not in ("float32", "bfloat16", "float16",
                                        "quant8"):
         raise ValueError(

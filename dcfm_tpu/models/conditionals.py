@@ -100,7 +100,7 @@ def gibbs_sweep(
     *,
     shard_offset=0,
     reduce_fn: Callable[[jax.Array], jax.Array] = local_sum,
-) -> SamplerState:
+) -> tuple[SamplerState, jax.Array]:
     """One full Gibbs iteration over all local shards.
 
     Args:
@@ -114,7 +114,12 @@ def gibbs_sweep(
       reduce_fn: (Gl, ...) -> (...) cross-shard sum; must psum over the mesh
         axis when sharded.
 
-    Returns the next SamplerState.
+    Returns ``(state, sse)``: the next SamplerState plus the (Gl, P)
+    per-feature residual sum of squares ||Y_.j - eta Lambda_j'||^2 the ps
+    conditional already had to form.  Exposing it makes the observability
+    layer (sampler._trace_now) free of any data-sized contraction: the
+    replacement for the reference's tic/toc (``divideconquer.m:200-201``)
+    must not itself cost a conditional's worth of device time per sweep.
     """
     Gl, n, P = Y.shape
     K = state.Lambda.shape[-1]
@@ -250,14 +255,14 @@ def gibbs_sweep(
     def ps_update(kg, Ym, eta_m, Lam_m):
         resid = Ym - eta_m @ Lam_m.T                            # (n, P)
         sse = jnp.sum(resid * resid, axis=0)                    # (P,)
-        return gamma_rate(kg, cfg.as_ + 0.5 * n, cfg.bs + 0.5 * sse)
+        return gamma_rate(kg, cfg.as_ + 0.5 * n, cfg.bs + 0.5 * sse), sse
 
     with jax.named_scope("ps_update"):
         ks = _shard_keys(jax.random.fold_in(key, _SITE_PS), shard_offset, Gl)
-        ps = jax.vmap(ps_update)(ks, Y, eta, Lam)
+        ps, sse = jax.vmap(ps_update)(ks, Y, eta, Lam)
 
     return SamplerState(Lambda=Lam, Z=Z, X=X, ps=ps, prior=prior_state,
-                        active=state.active)
+                        active=state.active), sse
 
 
 def covariance_blocks(

@@ -156,9 +156,17 @@ TRACE_SUMMARIES = ("signal_var_mean", "resid_var_mean", "sigma_diag_mean",
                    "avg_loglik")
 
 
-def _trace_now(Y: jax.Array, state: SamplerState, reduce_fn: Callable,
+def _trace_now(state: SamplerState, sse_j: jax.Array, reduce_fn: Callable,
                num_global_shards: int, rho: float) -> jax.Array:
-    """(4,) per-iteration scalar summaries, globally reduced over shards."""
+    """(4,) per-iteration scalar summaries, globally reduced over shards.
+
+    ``sse_j`` is the (Gl, P) per-feature residual SSE the ps conditional
+    already formed (returned by gibbs_sweep), so the trace costs only
+    O(g(nK^2 + PK^2)) — no data-sized contraction.  The observability layer
+    replacing ``divideconquer.m:200-201`` must be ~free relative to the
+    sweep it instruments; earlier rounds re-derived the SSE here with an
+    O(g n P K) einsum, which silently cost a full conditional per sweep.
+    """
     P = state.ps.shape[-1]
     n = state.X.shape[0]
     p_total = num_global_shards * P
@@ -167,14 +175,6 @@ def _trace_now(Y: jax.Array, state: SamplerState, reduce_fn: Callable,
     E = jnp.einsum("gnk,gnj->gkj", eta, eta) / n             # (Gl, K, K)
     M = jnp.einsum("gpk,gkj->gpj", state.Lambda, E)          # (Gl, P, K)
     sig_j = jnp.sum(M * state.Lambda, axis=-1)               # (Gl, P)
-    # sse via ||y||^2 - 2 y'm + ||m||^2: only (Gl, P, K) temporaries (the
-    # naive residual would materialize a data-sized (Gl, n, P) slab every
-    # iteration); sum(Y^2) is scan-invariant, hoisted by XLA.
-    YE = jnp.einsum("gnp,gnk->gpk", Y, eta)                  # (Gl, P, K)
-    sse_j = jnp.maximum(
-        jnp.sum(Y * Y, axis=1)
-        - 2.0 * jnp.sum(YE * state.Lambda, axis=-1)
-        + n * sig_j, 0.0)                                    # (Gl, P)
     loglik = 0.5 * jnp.sum(
         n * (jnp.log(state.ps) - jnp.log(2.0 * jnp.pi))
         - state.ps * sse_j, axis=-1)                         # (Gl,)
@@ -307,9 +307,10 @@ def run_chunk(
                                       shard_offset=shard_offset)
         else:
             Yc = Y
-        state = gibbs_sweep(
+        state, sse = gibbs_sweep(
             it_key, Yc, carry.state, cfg, prior,
             shard_offset=shard_offset, reduce_fn=reduce_fn)
+        sweep_state = state  # the sweep's own draw; trace is computed on it
         it = carry.iteration + 1  # 1-based, like the reference
         if cfg.rank_adapt:
             state = adapt_rank(it_key, state, it, burnin, cfg)
@@ -397,7 +398,10 @@ def run_chunk(
                  carry.y_imp_acc))
         with jax.named_scope("health_trace"):
             health = _health_update(carry.health, _health_now(state, prior))
-            trace = _trace_now(Yc, state, reduce_fn,
+            # Trace on the sweep's output + its sse (a consistent pair); on
+            # the rare burn-in adaptation iterations the carried state may
+            # additionally have columns re-masked - health watches that one.
+            trace = _trace_now(sweep_state, sse, reduce_fn,
                                carry.sigma_acc.shape[1], cfg.rho)
         return ChainCarry(state, sigma_acc, it, health, sigma_sq_acc,
                           draw_bufs, y_imp_acc), trace

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 def gamma_rate(key: jax.Array, shape, rate, *, sample_shape=None) -> jax.Array:
@@ -26,25 +27,35 @@ def gamma_rate(key: jax.Array, shape, rate, *, sample_shape=None) -> jax.Array:
     its rejection step accepts ~99% first-try there, while the chi^2 sum
     would need 2*shape normals.
     """
-    if (isinstance(shape, (int, float))
-            and float(2 * shape).is_integer() and 0 < shape <= 2):
+    # np.isscalar-style check: accept Python AND numpy scalars, so the
+    # branch taken (and thus the RNG stream) depends only on the VALUE,
+    # never on whether a caller passed 1.5 or np.float32(1.5).
+    static = (not isinstance(shape, (jax.Array, jnp.ndarray))
+              and np.ndim(shape) == 0)
+    if static and float(2 * float(shape)).is_integer() and 0 < shape <= 2:
         rate = jnp.asarray(rate)
+        # both branches follow the RATE's floating dtype (weak-typed int
+        # rates promote to the default float), so shape<=2 vs shape>2 can
+        # never silently disagree - e.g. under jax_enable_x64 the fallback
+        # returns float64 and so must this path.
+        dt = rate.dtype if jnp.issubdtype(rate.dtype, jnp.floating) \
+            else jnp.result_type(float)
         if sample_shape is None:
             out_shape = tuple(rate.shape)
         elif isinstance(sample_shape, int):
             out_shape = (sample_shape,)     # the fallback accepts ints too
         else:
             out_shape = tuple(sample_shape)
-        tw = int(2 * shape)
+        tw = int(2 * float(shape))
         if tw == 2:
             # jax.random.exponential computes -log1p(-u): exact in the
             # small-draw tail, which inverse_gamma_rate maps to the large
             # tail the horseshoe clamps care about
-            g = jax.random.exponential(key, out_shape, jnp.float32)
+            g = jax.random.exponential(key, out_shape, dt)
         else:
-            z = jax.random.normal(key, out_shape + (tw,), jnp.float32)
+            z = jax.random.normal(key, out_shape + (tw,), dt)
             g = 0.5 * jnp.sum(z * z, axis=-1)
-        return g / jnp.broadcast_to(rate, out_shape)
+        return g / jnp.broadcast_to(rate, out_shape).astype(dt)
     shape = jnp.asarray(shape)
     rate = jnp.asarray(rate)
     out_shape = sample_shape

@@ -55,6 +55,53 @@ from dcfm_tpu.config import (
 _FORMAT_VERSION = 5
 
 
+# ChainCarry fields a state-only ("light") save drops.  The accumulators
+# are raw SUMS over saved draws (models/sampler.ChainCarry), so a resume
+# may restart them at zero from a recorded iteration; api.fit divides by
+# the restarted window's saved-draw count at fetch (meta["acc_start"]).
+# Light saves are therefore MBs (the sampler state) instead of the
+# p^2-dominated full snapshot - the difference between checkpointing being
+# free and costing 18x e2e on a slow device->host link (README).
+_ACC_FIELDS = ("sigma_acc", "sigma_sq_acc", "y_imp_acc")
+
+
+def _slim(carry: Any) -> Any:
+    """The carry with its accumulator fields replaced by None - the pytree
+    a state-only save flattens.  Idempotent; a non-ChainCarry pytree (e.g.
+    test doubles) passes through unchanged.  Slimming happens BEFORE the
+    on-device snapshot and the device->host fetch, which is the entire
+    point: a light save must never move the p^2-sized accumulators off the
+    device."""
+    if not hasattr(carry, "_replace"):
+        return carry
+    return carry._replace(
+        **{f: None for f in _ACC_FIELDS
+           if getattr(carry, f, None) is not None})
+
+
+def _expand_zeros(carry: Any, template: Any) -> Any:
+    """Restore a slim carry's accumulator fields as host zeros shaped by
+    the (full) template - accumulation restarts at the recorded
+    iteration."""
+    fill = {}
+    for f in _ACC_FIELDS:
+        tpl = getattr(template, f, None)
+        if tpl is not None and getattr(carry, f, None) is None:
+            fill[f] = np.zeros(np.shape(tpl), np.dtype(tpl.dtype))
+    return carry._replace(**fill) if fill else carry
+
+
+def _acc_leaf_indices(carry: Any) -> list:
+    """Flat-leaf indices of the accumulator fields (``_ACC_FIELDS``) in
+    ``jax.tree.flatten(carry)`` order - recorded in FULL checkpoints so
+    :func:`strip_checkpoint` can drop them after the fact."""
+    if not hasattr(carry, "_replace"):
+        return []
+    keep = {id(l) for l in jax.tree.leaves(_slim(carry))}
+    return [i for i, l in enumerate(jax.tree.leaves(carry))
+            if id(l) not in keep]
+
+
 def data_fingerprint(data: np.ndarray) -> str:
     """Cheap content hash of the sharded data (shape + strided sample)."""
     h = hashlib.sha256()
@@ -86,7 +133,9 @@ def _config_from_json(d: dict) -> FitConfig:
         pad_to_shards=d["pad_to_shards"],
         checkpoint_path=d.get("checkpoint_path"),
         resume=d.get("resume", False),
-        checkpoint_every_chunks=d.get("checkpoint_every_chunks", 1),
+        checkpoint_every_chunks=d.get("checkpoint_every_chunks", "auto"),
+        checkpoint_mode=d.get("checkpoint_mode", "full"),
+        checkpoint_full_every=d.get("checkpoint_full_every", 0),
     )
 
 
@@ -116,8 +165,24 @@ def save_checkpoint(
     cfg: FitConfig,
     *,
     fingerprint: str,
+    state_only: bool = False,
+    acc_start: int = 0,
 ) -> None:
-    """Atomically write chain state + config + data fingerprint."""
+    """Atomically write chain state + config + data fingerprint.
+
+    ``state_only=True`` saves the SLIM carry (accumulator fields dropped,
+    leaves numbered in slim flatten order) - the MB-scale light save of
+    FitConfig.checkpoint_mode="light"; nothing accumulator-sized is even
+    fetched from the device.  A light resume restarts accumulation at the
+    saved iteration (the accumulators are raw sums, so the window divisor
+    at fetch makes the restarted mean exact over its window).
+    ``acc_start`` records the global iteration the CURRENT accumulators'
+    window started at (0 for an uninterrupted run), so a full save after a
+    light resume stays self-describing.
+    """
+    acc_idx = [] if state_only else _acc_leaf_indices(carry)
+    if state_only:
+        carry = _slim(carry)
     carry = jax.device_get(carry)
     leaves, treedef = jax.tree.flatten(carry)
     meta = {
@@ -128,9 +193,40 @@ def save_checkpoint(
         # the chain vmap axis
         "iteration": int(np.asarray(carry.iteration).reshape(-1)[0]),
         "fingerprint": fingerprint,
+        "state_only": bool(state_only),
+        "acc_start": int(acc_start),
+        "acc_leaf_indices": acc_idx,
     }
     _atomic_savez(path, meta,
                   {f"leaf_{i}": np.asarray(l) for i, l in enumerate(leaves)})
+
+
+def strip_checkpoint(src: str, dst: str) -> None:
+    """Rewrite a FULL checkpoint as a state-only (light) one - drops the
+    accumulator leaves recorded in its meta (renumbering the kept leaves
+    into slim flatten order, the state-only on-disk convention), turning a
+    p^2-sized snapshot into MBs.  The result resumes like any light
+    checkpoint: chain state exact, accumulation restarted at the saved
+    iteration."""
+    with np.load(src) as z:
+        meta = json.loads(bytes(z["__meta__"]).decode())
+        if meta["version"] != _FORMAT_VERSION:
+            raise ValueError(
+                f"checkpoint format v{meta['version']} != v{_FORMAT_VERSION}")
+        if meta.get("state_only"):
+            raise ValueError("checkpoint is already state-only")
+        drop = set(meta.get("acc_leaf_indices", []))
+        if not drop:
+            raise ValueError(
+                "checkpoint records no accumulator leaves to strip "
+                "(written by an older version?)")
+        n_full = sum(1 for k in z.files if k != "__meta__")
+        kept = [i for i in range(n_full) if i not in drop]
+        payload = {f"leaf_{j}": z[f"leaf_{i}"] for j, i in enumerate(kept)}
+    meta["state_only"] = True
+    meta["acc_start"] = meta["iteration"]
+    meta["acc_leaf_indices"] = []
+    _atomic_savez(dst, meta, payload)
 
 
 def read_checkpoint_meta(path: str) -> dict:
@@ -157,7 +253,13 @@ def load_checkpoint(path: str, carry_template: Any) -> Tuple[Any, dict]:
         if meta["version"] != _FORMAT_VERSION:
             raise ValueError(
                 f"checkpoint format v{meta['version']} != v{_FORMAT_VERSION}")
-        template_leaves, treedef = jax.tree.flatten(carry_template)
+        state_only = meta.get("state_only", False)
+        # state-only files store the SLIM carry (accumulators dropped);
+        # match against the slim template and restore the accumulators as
+        # zeros afterwards - accumulation restarts at meta["iteration"]
+        # (the caller threads that into the fetch divisor via acc_start)
+        template = _slim(carry_template) if state_only else carry_template
+        template_leaves, treedef = jax.tree.flatten(template)
         leaves = []
         for i, tl in enumerate(template_leaves):
             arr = z[f"leaf_{i}"]
@@ -166,7 +268,10 @@ def load_checkpoint(path: str, carry_template: Any) -> Tuple[Any, dict]:
                     f"checkpoint leaf {i} shape {arr.shape} != expected "
                     f"{np.shape(tl)} - config/data mismatch?")
             leaves.append(arr)
-        return jax.tree.unflatten(treedef, leaves), meta
+        carry = jax.tree.unflatten(treedef, leaves)
+        if state_only:
+            carry = _expand_zeros(carry, carry_template)
+        return carry, meta
 
 
 def proc_path(path: str, process_index: int, process_count: int) -> str:
@@ -288,8 +393,14 @@ def load_checkpoint_resharded(
     Returns ``(host carry pytree, metadata of file 0)``; raises if the
     files disagree on the saved iteration (a crash landed between two
     processes' saves - the set is not a consistent chain state).
+
+    State-only sets (light saves) match against the SLIM template; the
+    accumulators come back as host zeros (accumulation restarts at the
+    recorded iteration).
     """
-    template_leaves, treedef = jax.tree.flatten(carry_template)
+    state_only = read_checkpoint_meta(paths[0]).get("state_only", False)
+    template = _slim(carry_template) if state_only else carry_template
+    template_leaves, treedef = jax.tree.flatten(template)
     full = [None] * len(template_leaves)
     metas = []
     for fp in paths:
@@ -298,6 +409,9 @@ def load_checkpoint_resharded(
             if meta["version"] != _FORMAT_VERSION:
                 raise ValueError(f"checkpoint format v{meta['version']} != "
                                  f"v{_FORMAT_VERSION}")
+            if meta.get("state_only", False) != state_only:
+                raise ValueError(
+                    "per-process checkpoints mix state-only and full files")
             metas.append(meta)
             lm = meta["leaf_meta"]
             if len(lm) != len(template_leaves):
@@ -327,7 +441,10 @@ def load_checkpoint_resharded(
         raise ValueError(
             f"per-process checkpoints disagree on the iteration "
             f"({sorted(iters)}) - a crash between two processes' saves")
-    return jax.tree.unflatten(treedef, full), metas[0]
+    carry = jax.tree.unflatten(treedef, full)
+    if state_only:
+        carry = _expand_zeros(carry, carry_template)
+    return carry, metas[0]
 
 
 def save_checkpoint_multiprocess(
@@ -336,6 +453,8 @@ def save_checkpoint_multiprocess(
     cfg: FitConfig,
     *,
     fingerprint: str,
+    state_only: bool = False,
+    acc_start: int = 0,
 ) -> None:
     """Multi-host checkpoint: process k atomically writes its own
     ``path.prock-of-N`` with exactly the shard data its devices own - no
@@ -346,7 +465,14 @@ def save_checkpoint_multiprocess(
     entry per addressable shard, keyed by the shard's global offsets, so
     reload is layout-exact and fails loudly on a device->process layout
     change rather than silently permuting shards.
+
+    ``state_only``/``acc_start``: as in :func:`save_checkpoint` - the SLIM
+    carry is what flattens (nothing accumulator-sized crosses the
+    device->host link), and both load paths restore the accumulators at
+    zero from the slim-template match.
     """
+    if state_only:
+        carry = _slim(carry)
     leaves, treedef = jax.tree.flatten(carry)
     payload, leaf_meta = {}, []
     for i, leaf in enumerate(leaves):
@@ -369,6 +495,9 @@ def save_checkpoint_multiprocess(
         "process_index": jax.process_index(),
         "process_count": jax.process_count(),
         "leaf_meta": leaf_meta,
+        "state_only": bool(state_only),
+        "acc_start": int(acc_start),
+        "acc_leaf_indices": [],
     }
     _atomic_savez(proc_path(path, jax.process_index(), jax.process_count()),
                   meta, payload)
@@ -410,6 +539,18 @@ def load_checkpoint_multiprocess(path: str, carry_like: Any,
             f"no complete checkpoint set at {path}(.procK-of-N)")
     kind, found = source
     if kind == "plain" or found[0] != jax.process_count():
+        if kind == "local-set":
+            # api._resume_state_multiproc fabricates this kind when only
+            # this process's own file is visible (per-host local disks);
+            # the other N-1 paths in it were never verified to exist, so
+            # resharding from it would crash on missing files.  The count
+            # always matches jax.process_count() by construction - refuse
+            # loudly if that invariant ever breaks instead of limping into
+            # the reshard reads.
+            raise ValueError(
+                "local-set checkpoint source (only this process's file "
+                "verified) cannot be resharded - the peer files may not "
+                "exist on this host")
         leaves_like, treedef = jax.tree.flatten(carry_like)
         if kind == "set":
             host, meta = load_checkpoint_resharded(found[1], carry_like)
@@ -432,7 +573,9 @@ def load_checkpoint_multiprocess(path: str, carry_like: Any,
         if meta["version"] != _FORMAT_VERSION:
             raise ValueError(
                 f"checkpoint format v{meta['version']} != v{_FORMAT_VERSION}")
-        leaves_like, treedef = jax.tree.flatten(carry_like)
+        state_only = meta.get("state_only", False)
+        template = _slim(carry_like) if state_only else carry_like
+        leaves_like, treedef = jax.tree.flatten(template)
         lm = meta["leaf_meta"]
         if len(lm) != len(leaves_like):
             raise ValueError(
@@ -463,7 +606,24 @@ def load_checkpoint_multiprocess(path: str, carry_like: Any,
 
                 out.append(jax.make_array_from_callback(
                     tpl.shape, tpl.sharding, cb))
-        return jax.tree.unflatten(treedef, out), meta
+        carry = jax.tree.unflatten(treedef, out)
+        if state_only:
+            # accumulators restart at zero, placed with each leaf's target
+            # sharding (np.zeros is calloc-backed: the full-shape host
+            # array only costs the pages the shard slices touch)
+            fill = {}
+            for f in _ACC_FIELDS:
+                tpl = getattr(carry_like, f, None)
+                if tpl is None:
+                    continue
+                zfull = np.zeros(np.shape(tpl), np.dtype(tpl.dtype))
+                sh = getattr(tpl, "sharding", None)
+                fill[f] = (jax.make_array_from_callback(
+                    tuple(np.shape(tpl)), sh, lambda idx, _z=zfull: _z[idx])
+                    if sh is not None else zfull)
+            if fill:
+                carry = carry._replace(**fill)
+        return carry, meta
 
 
 @jax.jit
@@ -507,29 +667,72 @@ class AsyncCheckpointWriter:
 
     At most one save is in flight: ``submit`` joins the previous save
     first, bounding the extra footprint to one carry copy on device plus
-    one on host.  ``wait()`` must be called before the results are used /
-    fit() returns, making the last file durable; a failed background save
-    re-raises there (or on the next submit) rather than being swallowed.
+    one on host.  NOTE the on-device snapshot transiently DOUBLES the
+    accumulator-dominated HBM footprint (e.g. +1.26 GB/device at the
+    config-5 pod shape); when that copy fails to allocate, submit falls
+    back to a synchronous host fetch of the live carry (the old path -
+    slower but allocation-free on device).  ``wait()`` must be called
+    before the results are used / fit() returns, making the last file
+    durable; a failed background save re-raises there (or on the next
+    submit).  ``poll_error()`` surfaces a stored failure WITHOUT blocking,
+    so the driver can notice broken durability (disk full, ...) at the
+    next chunk boundary instead of after the chain finished.
+
+    ``last_save_seconds`` holds the measured wall-clock of the most recent
+    COMPLETED background save (device fetch + atomic write) - the number
+    checkpoint_every_chunks="auto" sizes the cadence from.
     """
 
     def __init__(self):
         self._thread: Optional[threading.Thread] = None
         self._error: Optional[BaseException] = None
+        self.last_save_seconds: Optional[float] = None
 
     def submit(self, save_fn: Callable[..., None], path: str, carry: Any,
-               cfg: "FitConfig", *, fingerprint: str) -> None:
+               cfg: "FitConfig", *, fingerprint: str, **save_kwargs) -> None:
         self.wait()
-        snap = device_snapshot(carry)
+        import time as _time
+        if save_kwargs.get("state_only"):
+            # light save: drop the accumulator fields BEFORE the snapshot,
+            # so neither the on-device copy nor the background fetch ever
+            # touches the p^2-sized leaves (save_fn's own _slim is then a
+            # no-op) - the whole point of the light mode on a slow link
+            carry = _slim(carry)
+        sync_fetch_s = 0.0
+        try:
+            snap = device_snapshot(carry)
+        except Exception:
+            # on-device copy failed (e.g. RESOURCE_EXHAUSTED near device
+            # memory capacity): synchronous host fetch instead - the chain
+            # thread stalls for the fetch, but the save still happens.
+            # Counted into last_save_seconds so the auto cadence is sized
+            # from the FULL cost of a save in this regime, not just the
+            # background write.
+            t0 = _time.perf_counter()
+            snap = jax.device_get(carry)
+            sync_fetch_s = _time.perf_counter() - t0
 
         def run():
+            t0 = _time.perf_counter()
             try:
-                save_fn(path, snap, cfg, fingerprint=fingerprint)
-            except BaseException as e:   # surfaced by wait()
+                save_fn(path, snap, cfg, fingerprint=fingerprint,
+                        **save_kwargs)
+                self.last_save_seconds = (sync_fetch_s
+                                          + _time.perf_counter() - t0)
+            except BaseException as e:   # surfaced by wait()/poll_error()
                 self._error = e
 
         self._thread = threading.Thread(
             target=run, name="dcfm-checkpoint-writer", daemon=True)
         self._thread.start()
+
+    def poll_error(self) -> Optional[BaseException]:
+        """Non-blocking peek at a stored background failure (not consumed;
+        wait() still raises it)."""
+        return self._error
+
+    def busy(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
 
     def wait(self) -> None:
         t = self._thread

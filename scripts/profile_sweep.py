@@ -31,7 +31,7 @@ K_TOTAL = int(os.environ.get("PROF_K", 512))
 ITERS = int(os.environ.get("PROF_ITERS", 50))
 
 SCOPES = ("z_update", "x_update", "lambda_update", "prior_update",
-          "ps_update", "combine")
+          "ps_update", "combine", "health_trace", "impute_missing")
 
 
 def _capture(tmpdir: str) -> float:
@@ -172,8 +172,9 @@ def _aggregate(tmpdir: str) -> dict:
         md = _decode(kv[2][0])
         stat_ids[md.get(2, [b""])[0]] = kv[1][0]
     tf_op_id = stat_ids.get(b"tf_op")
-    # event-metadata id -> scope path (the tf_op stat's string value)
+    # event-metadata id -> (scope path from the tf_op stat, HLO op name)
     scope_of = {}
+    name_of = {}
     for e in tpu.get(4, []):
         kv = _decode(e)
         md = _decode(kv[2][0])
@@ -183,6 +184,7 @@ def _aggregate(tmpdir: str) -> dict:
             if tf_op_id is not None and s.get(1, [None])[0] == tf_op_id:
                 path = s.get(5, [b""])[0]
         scope_of[kv[1][0]] = path.decode(errors="replace")
+        name_of[kv[1][0]] = md.get(2, [b""])[0].decode(errors="replace")
     totals = {s: 0.0 for s in SCOPES}
     other = 0.0
     total = 0.0
@@ -203,8 +205,14 @@ def _aggregate(tmpdir: str) -> dict:
             else:
                 other += dur_us
                 # coarse attribution for the unscoped remainder: last two
-                # path components (scan plumbing, RNG, health stats, ...)
-                tag = "/".join(path.split("/")[-2:]) if path else "<none>"
+                # path components (scan plumbing, RNG, ...); ops carrying
+                # no scope path at all are tagged by their HLO op name
+                # with trailing digits stripped (fusion.123 -> fusion)
+                if path:
+                    tag = "/".join(path.split("/")[-2:])
+                else:
+                    nm = name_of.get(ev.get(1, [None])[0], "") or "<none>"
+                    tag = "hlo:" + nm.rstrip("0123456789.")
                 other_paths[tag] = other_paths.get(tag, 0.0) + dur_us
     top_other = dict(sorted(other_paths.items(), key=lambda kv: -kv[1])[:8])
     return {"per_scope_us": totals, "other_us": other,

@@ -1,0 +1,100 @@
+"""Run the compiled-TPU test lane per file and record the evidence.
+
+The suite normally runs on the 8-virtual-CPU-device platform
+(tests/conftest.py); ``DCFM_TPU_TESTS=1`` opts a run onto the real
+accelerator instead, exercising compiled-Mosaic lowerings the CPU lane
+interprets.  On the axon remote platform a long-lived test process
+occasionally loses the tunnel mid-suite (the known flake README documents
+as "prefer per-file runs"), so this script does exactly that, recording
+the behavior instead of asserting it away: each test file runs in its own
+subprocess with up to ``TPULANE_RETRIES`` retries, and the per-file
+pass/fail/skip table is written as one JSON line - the committed artifact
+(TPUTESTS_r05.json).
+
+Files that REQUIRE >= 8 devices (the virtual-mesh distributed tests) are
+expected to self-skip on a 1-chip platform; their rows read "skip", which
+is correct behavior, not missing coverage - the mesh program's compiled
+execution on the chip is evidenced separately (MESHTPU_r05.json).
+
+Run: DCFM_TPU_TESTS=1 python scripts/tpu_test_lane.py   (~15-30 min)
+Env: TPULANE_FILES (comma-separated subset), TPULANE_RETRIES (default 2),
+TPULANE_TIMEOUT (seconds per file, default 900).
+"""
+
+import glob
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+RETRIES = int(os.environ.get("TPULANE_RETRIES", 2))
+TIMEOUT = int(os.environ.get("TPULANE_TIMEOUT", 900))
+
+
+def run_file(path: str) -> dict:
+    """One test file on the TPU lane, in its own interpreter."""
+    env = dict(os.environ, DCFM_TPU_TESTS="1")
+    attempts = []
+    for attempt in range(1 + RETRIES):
+        t0 = time.monotonic()
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-m", "pytest", path, "-q", "--tb=line",
+                 "-p", "no:cacheprovider"],
+                cwd=REPO, env=env, capture_output=True, text=True,
+                timeout=TIMEOUT)
+            rc = proc.returncode
+            tail = (proc.stdout.strip().splitlines() or [""])[-1]
+        except subprocess.TimeoutExpired:
+            rc, tail = -1, f"timeout after {TIMEOUT}s"
+        attempts.append({"rc": rc, "seconds": round(time.monotonic() - t0, 1),
+                         "tail": tail[-200:]})
+        if rc in (0, 5):        # 5 = no tests collected (everything skipped)
+            break
+    last = attempts[-1]
+    status = ("pass" if last["rc"] == 0 else
+              "skip" if last["rc"] == 5 else "fail")
+    # pytest rc 0 with an all-skipped tail is still a skip row
+    if status == "pass" and " skipped" in last["tail"] \
+            and " passed" not in last["tail"]:
+        status = "skip"
+    return {"status": status, "attempts": len(attempts),
+            "seconds": last["seconds"], "tail": last["tail"]}
+
+
+def main() -> int:
+    if not os.environ.get("DCFM_TPU_TESTS"):
+        print(json.dumps({"ok": False,
+                          "error": "set DCFM_TPU_TESTS=1 to opt into the "
+                                   "TPU lane"}))
+        return 1
+    sel = os.environ.get("TPULANE_FILES")
+    files = (sorted(f"tests/{f}" if not f.startswith("tests/") else f
+                    for f in sel.split(",")) if sel else
+             sorted(os.path.relpath(f, REPO)
+                    for f in glob.glob(os.path.join(REPO, "tests",
+                                                    "test_*.py"))))
+    table = {}
+    for f in files:
+        table[os.path.basename(f)] = run_file(f)
+        print(f"# {os.path.basename(f)}: {table[os.path.basename(f)]['status']}",
+              file=sys.stderr, flush=True)
+    n_pass = sum(r["status"] == "pass" for r in table.values())
+    n_skip = sum(r["status"] == "skip" for r in table.values())
+    n_fail = sum(r["status"] == "fail" for r in table.values())
+    out = {
+        "artifact": "compiled-TPU test lane, per-file",
+        "env": "DCFM_TPU_TESTS=1, one subprocess per file, "
+               f"retries={RETRIES}",
+        "files": table,
+        "pass": n_pass, "skip": n_skip, "fail": n_fail,
+        "ok": n_fail == 0 and n_pass > 0,
+    }
+    print(json.dumps(out))
+    return 0 if out["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -36,6 +36,32 @@ def data():
     return Y
 
 
+class _SyncWriter:
+    """Deterministic stand-in for AsyncCheckpointWriter: saves run
+    synchronously at submit, so tests that count saves or simulate a
+    kill-at-save-N see an exact schedule (the real writer's busy-deferral
+    and background raise make save boundaries timing-dependent)."""
+    last_save_seconds = None
+
+    def submit(self, save_fn, path, carry, cfg, **kw):
+        import jax
+        save_fn(path, jax.device_get(carry), cfg, **kw)
+
+    def poll_error(self):
+        return None
+
+    def busy(self):
+        return False
+
+    def wait(self):
+        pass
+
+
+def _use_sync_writer(monkeypatch):
+    import dcfm_tpu.api as api
+    monkeypatch.setattr(api, "AsyncCheckpointWriter", _SyncWriter)
+
+
 def test_kill_and_resume_bitwise_identical(tmp_path, monkeypatch, data):
     """Interrupt after 2 of 4 chunks; the resumed run must reproduce the
     uninterrupted run's accumulator bit for bit."""
@@ -44,7 +70,13 @@ def test_kill_and_resume_bitwise_identical(tmp_path, monkeypatch, data):
     res_full = fit(data, _cfg())
 
     ck = str(tmp_path / "chain.npz")
-    cfg_ck = dataclasses.replace(_cfg(), checkpoint_path=ck)
+    # cadence pinned to 1 + synchronous writer: the kill-at-save-2 =
+    # iteration-16 arithmetic needs a save at every boundary, exactly when
+    # submitted (the "auto" default may size the cadence wider, and the
+    # async writer may defer past a busy save)
+    cfg_ck = dataclasses.replace(_cfg(), checkpoint_path=ck,
+                                 checkpoint_every_chunks=1)
+    _use_sync_writer(monkeypatch)
 
     real_save = api.save_checkpoint
     calls = {"n": 0}
@@ -138,7 +170,7 @@ def test_resume_requires_checkpoint_path(data):
         fit(data, dataclasses.replace(_cfg(), resume=True))
 
 
-def test_mesh_resume_matches_mesh_uninterrupted(tmp_path, data):
+def test_mesh_resume_matches_mesh_uninterrupted(tmp_path, monkeypatch, data):
     """Checkpoint/resume through the shard_map mesh path (4 devices,
     2 shards each): resumed accumulator equals the uninterrupted one."""
     mesh_kw = dict(
@@ -150,12 +182,16 @@ def test_mesh_resume_matches_mesh_uninterrupted(tmp_path, data):
     res_full = fit(Y, FitConfig(**mesh_kw))
 
     ck = str(tmp_path / "mesh.npz")
-    cfg_ck = FitConfig(**mesh_kw, checkpoint_path=ck)
+    cfg_ck = FitConfig(**mesh_kw, checkpoint_path=ck,
+                       checkpoint_every_chunks=1)
     # run only the first half by checkpointing then truncating: simulate the
     # interruption by saving a mid-chain checkpoint from a half-length run
-    # with the same schedule metadata.
+    # with the same schedule metadata.  Sync writer + cadence 1: the kill
+    # must land at a deterministic boundary (the async writer's deferral
+    # and last-boundary warning-downgrade make the raise timing-dependent).
     import dcfm_tpu.api as api
 
+    _use_sync_writer(monkeypatch)
     calls = {"n": 0}
     real_save = api.save_checkpoint
 
@@ -255,6 +291,9 @@ def test_resume_auto_elastic_recovery(tmp_path, monkeypatch, data):
     import os
 
     os.unlink(ck)
+    # sync writer: the kill must surface at its own boundary, not drift to
+    # the last one (where a save failure is by design only a warning)
+    _use_sync_writer(monkeypatch)
     monkeypatch.setattr(api, "save_checkpoint", killing_save)
     with pytest.raises(Killed):
         fit(data, cfg_auto)
@@ -542,6 +581,7 @@ def test_checkpoint_cadence(tmp_path, monkeypatch, data):
         real(*a, **k)
 
     monkeypatch.setattr(api, "save_checkpoint", counting)
+    _use_sync_writer(monkeypatch)
     ck = str(tmp_path / "cadence.npz")
     cfg = dataclasses.replace(_cfg(), checkpoint_path=ck,
                               checkpoint_every_chunks=3)
@@ -640,3 +680,177 @@ def test_torn_set_does_not_shadow_valid_plain(tmp_path):
     os.unlink(base)
     with pytest.raises(ValueError, match="disagree on the iteration"):
         discover_checkpoint(base, prefer_plain=False)
+
+
+# ---- state-only ("light") checkpointing -----------------------------------
+
+def test_light_checkpoint_file_is_small_and_tagged(tmp_path, data):
+    """Light saves omit the accumulator leaves: the file is tagged
+    state_only, carries no sigma_acc leaf, and is a fraction of the full
+    snapshot's size."""
+    import json as _json
+    import os
+
+    ck_full = str(tmp_path / "full.npz")
+    ck_light = str(tmp_path / "light.npz")
+    fit(data, dataclasses.replace(_cfg(), checkpoint_path=ck_full))
+    fit(data, dataclasses.replace(_cfg(), checkpoint_path=ck_light,
+                                  checkpoint_mode="light"))
+    with np.load(ck_light) as z:
+        meta = _json.loads(bytes(z["__meta__"]).decode())
+        n_light = sum(1 for k in z.files if k != "__meta__")
+    assert meta["state_only"] is True
+    assert meta["acc_start"] == 0
+    with np.load(ck_full) as z:
+        full_meta = _json.loads(bytes(z["__meta__"]).decode())
+        n_full = sum(1 for k in z.files if k != "__meta__")
+    assert full_meta["state_only"] is False
+    # the full file records which of its leaves are the accumulators; the
+    # light file stores exactly the slim complement
+    dropped = full_meta["acc_leaf_indices"]
+    assert dropped and n_light == n_full - len(dropped)
+    assert (os.path.getsize(ck_light) < 0.7 * os.path.getsize(ck_full))
+
+
+def test_light_finished_resume_refuses(tmp_path, data):
+    """Resuming a FINISHED light checkpoint with the same schedule must
+    refuse loudly (its accumulators were never saved - a silent resume
+    would return Sigma = 0)."""
+    ck = str(tmp_path / "light.npz")
+    cfg = dataclasses.replace(_cfg(), checkpoint_path=ck,
+                              checkpoint_mode="light")
+    fit(data, cfg)
+    with pytest.raises(ValueError, match="state-only"):
+        fit(data, dataclasses.replace(cfg, resume=True))
+
+
+def test_light_crash_resume_restarts_accumulation_exactly(
+        tmp_path, monkeypatch, data):
+    """Crash mid-run in light mode, resume: the chain state restores
+    exactly and accumulation restarts at the checkpointed iteration - the
+    resumed fit's Sigma must equal a fresh run whose burn-in ends where
+    the accumulator window restarts (same seed: the chain trajectory is
+    identical because per-iteration keys derive from the global iteration,
+    and thin=2 keeps the saved-draw grid aligned)."""
+    import dcfm_tpu.api as api
+
+    ck = str(tmp_path / "light.npz")
+    cfg_ck = dataclasses.replace(
+        _cfg(), checkpoint_path=ck, checkpoint_mode="light",
+        checkpoint_every_chunks=1)
+    _use_sync_writer(monkeypatch)
+
+    real_save = api.save_checkpoint
+    calls = {"n": 0}
+
+    def killing_save(*args, **kwargs):
+        real_save(*args, **kwargs)
+        calls["n"] += 1
+        if calls["n"] == 3:              # checkpoint at iteration 24 of 32
+            raise Killed("simulated crash mid-chain")
+
+    monkeypatch.setattr(api, "save_checkpoint", killing_save)
+    with pytest.raises(Killed):
+        fit(data, cfg_ck)
+    monkeypatch.setattr(api, "save_checkpoint", real_save)
+
+    _, meta = load_checkpoint_meta(ck)
+    assert meta["iteration"] == 24 and meta["state_only"] is True
+
+    res = fit(data, dataclasses.replace(cfg_ck, resume=True))
+    assert res.iters_per_sec > 0          # ran the 24..32 tail
+
+    # oracle: fresh run saving exactly the window (24, 32] - same chain
+    oracle = fit(data, dataclasses.replace(
+        _cfg(), run=RunConfig(burnin=24, mcmc=8, thin=2, seed=3,
+                              chunk_size=8)))
+    np.testing.assert_allclose(res.sigma_blocks, oracle.sigma_blocks,
+                               rtol=1e-6, atol=1e-7)
+
+
+def test_light_extension_resume(tmp_path, data):
+    """A finished light checkpoint + a LONGER schedule extends the chain:
+    state continues exactly, accumulation covers the extension window."""
+    ck = str(tmp_path / "light.npz")
+    cfg = dataclasses.replace(_cfg(), checkpoint_path=ck,
+                              checkpoint_mode="light")
+    fit(data, cfg)                        # runs to 32, light save at 32
+    ext = dataclasses.replace(
+        cfg, run=RunConfig(burnin=16, mcmc=32, thin=2, seed=3,
+                           chunk_size=8), resume=True)
+    res = fit(data, ext)
+    assert res.iters_per_sec > 0
+    oracle = fit(data, dataclasses.replace(
+        _cfg(), run=RunConfig(burnin=32, mcmc=16, thin=2, seed=3,
+                              chunk_size=8)))
+    np.testing.assert_allclose(res.sigma_blocks, oracle.sigma_blocks,
+                               rtol=1e-6, atol=1e-7)
+
+
+def test_strip_checkpoint_roundtrip(tmp_path, data):
+    """strip_checkpoint turns a full snapshot into a light one that
+    resumes identically to a native light save."""
+    from dcfm_tpu.utils.checkpoint import strip_checkpoint
+
+    ck = str(tmp_path / "full.npz")
+    cfg = dataclasses.replace(_cfg(), checkpoint_path=ck)
+    fit(data, cfg)
+    stripped = str(tmp_path / "stripped.npz")
+    strip_checkpoint(ck, stripped)
+    import os
+    assert os.path.getsize(stripped) < 0.7 * os.path.getsize(ck)
+    _, meta = load_checkpoint_meta(stripped)
+    assert meta["state_only"] is True and meta["acc_start"] == 32
+    # resumes as a chain extension from 32
+    import shutil
+    shutil.move(stripped, ck)
+    ext = dataclasses.replace(
+        cfg, run=RunConfig(burnin=16, mcmc=32, thin=2, seed=3,
+                           chunk_size=8), resume=True)
+    res = fit(data, ext)
+    oracle = fit(data, dataclasses.replace(
+        _cfg(), run=RunConfig(burnin=32, mcmc=16, thin=2, seed=3,
+                              chunk_size=8)))
+    np.testing.assert_allclose(res.sigma_blocks, oracle.sigma_blocks,
+                               rtol=1e-6, atol=1e-7)
+
+
+def test_checkpoint_full_every_sidecar_in_light_mode(
+        tmp_path, monkeypatch, data):
+    """checkpoint_full_every=3 in light mode upgrades every 3rd due save
+    to a full snapshot written to the .full SIDECAR (the main path's next
+    light save would otherwise atomically overwrite it, voiding the
+    bounds-the-loss guarantee).  A finished-light resume falls back to the
+    sidecar: it re-runs the tail from the full snapshot and reproduces the
+    uninterrupted run's accumulator bit for bit."""
+    import os
+
+    import dcfm_tpu.api as api
+
+    res_full = fit(data, _cfg())
+
+    seen = []
+    real = api.save_checkpoint
+
+    def recording(path, *a, **k):
+        seen.append((os.path.basename(path), bool(k.get("state_only"))))
+        real(path, *a, **k)
+
+    monkeypatch.setattr(api, "save_checkpoint", recording)
+    _use_sync_writer(monkeypatch)
+    ck = str(tmp_path / "hybrid.npz")
+    cfg = dataclasses.replace(
+        _cfg(), checkpoint_path=ck, checkpoint_mode="light",
+        checkpoint_every_chunks=1, checkpoint_full_every=3)
+    fit(data, cfg)
+    # 4 chunk boundaries: light, light, FULL (to the sidecar), light
+    assert seen == [("hybrid.npz", True), ("hybrid.npz", True),
+                    ("hybrid.npz.full", False), ("hybrid.npz", True)]
+    assert os.path.exists(ck + ".full")
+    # the main path ends as a FINISHED light checkpoint (iteration 32, no
+    # accumulators); resume falls back to the full sidecar (iteration 24),
+    # re-runs 24..32, and lands exactly on the uninterrupted run
+    monkeypatch.setattr(api, "save_checkpoint", real)
+    res = fit(data, dataclasses.replace(cfg, resume=True))
+    assert res.iters_per_sec > 0                 # ran the 24..32 tail
+    np.testing.assert_array_equal(res.sigma_blocks, res_full.sigma_blocks)

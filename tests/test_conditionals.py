@@ -42,7 +42,7 @@ def fixed():
 
 def _many_sweeps(cfg, prior, Y, state, n_rep=3000):
     keys = jax.random.split(jax.random.key(7), n_rep)
-    return jax.vmap(lambda k: gibbs_sweep(k, Y, state, cfg, prior))(keys)
+    return jax.vmap(lambda k: gibbs_sweep(k, Y, state, cfg, prior)[0])(keys)
 
 
 def test_z_conditional_moments(fixed):

@@ -40,9 +40,11 @@ def test_trace_avg_loglik_matches_numpy():
     ps = rng.uniform(0.5, 2.0, (Gl, P)).astype(np.float32)
     state = SamplerState(Lambda=jnp.asarray(Lam), Z=jnp.asarray(Z),
                          X=jnp.asarray(X), ps=jnp.asarray(ps), prior=None)
-    tr = np.asarray(_trace_now(jnp.asarray(Y), state, local_sum, Gl, rho))
     eta = np.sqrt(rho) * X[None] + np.sqrt(1 - rho) * Z
     mean = np.einsum("gnk,gpk->gnp", eta, Lam)
+    # the sweep hands _trace_now the ps conditional's residual SSE
+    sse = np.sum((Y - mean) ** 2, axis=1)
+    tr = np.asarray(_trace_now(state, jnp.asarray(sse), local_sum, Gl, rho))
     var = (1.0 / ps)[:, None, :]
     cell_ll = -0.5 * (np.log(2 * np.pi * var) + (Y - mean) ** 2 / var)
     idx = TRACE_SUMMARIES.index("avg_loglik")

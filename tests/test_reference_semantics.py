@@ -258,7 +258,7 @@ def test_geweke_joint_distribution(prior_name):
         def body(state, k):
             ky, ks = jax.random.split(k)
             Y = _sample_Y(ky, state)
-            return gibbs_sweep(ks, Y, state, cfg, prior), None
+            return gibbs_sweep(ks, Y, state, cfg, prior)[0], None
 
         state, _ = jax.lax.scan(body, _prior_state(k0, prior),
                                 jax.random.split(k_steps, T_STEPS))

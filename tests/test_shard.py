@@ -73,6 +73,35 @@ def test_mesh_dl_prior_statistically_equivalent():
     assert abs(e1 - e4) < 0.1
 
 
+@pytest.mark.slow
+def test_mesh_dl_prior_long_chain_halved_bounds():
+    """Slow-lane DL mesh-equivalence pin at HALVED tolerances (round-4
+    verdict: a bug that only manifests after a GIG accept/reject flip and
+    costs <= 0.1 rel err passed both fast-lane tests).  Bitwise layout
+    equality is unattainable by construction - the X-update psum's
+    reduction order differs from the vmap layout's jnp.sum by ulps, and
+    the GIG sampler's accept/reject comparison is discontinuous in its
+    parameters, so one ulp lawfully swaps in a different (equally valid)
+    draw after a few sweeps.  What CAN be tightened is the statistical
+    bound: with 3x the draws of the fast-lane test, Monte Carlo error
+    shrinks enough that both layouts must recover the truth to err < 0.3
+    and agree to |Δerr| < 0.05 - half the fast-lane bounds, so a layout
+    bug half the size of anything the fast lane would catch fails here."""
+    Y, St = make_synthetic(120, 64, 3, seed=8)
+    m = ModelConfig(num_shards=4, factors_per_shard=3, rho=0.8, prior="dl")
+    r = RunConfig(burnin=200, mcmc=280, thin=1, seed=3)
+    res1 = _run(Y, m, r)
+    res4 = _run(Y, m, r, mesh_devices=4)
+
+    def err(res):
+        return (np.linalg.norm(res.Sigma - St) / np.linalg.norm(St))
+
+    e1, e4 = err(res1), err(res4)
+    assert np.isfinite(res4.Sigma).all()
+    assert e1 < 0.3 and e4 < 0.3, (e1, e4)
+    assert abs(e1 - e4) < 0.05, (e1, e4)
+
+
 def test_mesh_dl_prior_short_chain_tight():
     """Tight DL mesh-layout pin, complementing the statistical test above:
     over a FEW sweeps the psum reduction-order ulps cannot have flipped a
