@@ -556,22 +556,32 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
             out.append(num_iters % chunk)
         return out
 
-    def _try_full_sidecar(template):
+    def _try_full_sidecar(template, light_kept):
         """Load the ``.full`` sidecar maintained by checkpoint_full_every
-        (light mode), if present and compatible -> (carry, done,
-        acc_start) or None.  Used when a light resume's restarted window
-        would save zero draws: the sidecar trades re-running the tail for
-        keeping every accumulated draw."""
+        (light mode) IF it would preserve MORE saved draws than resuming
+        the light checkpoint would (``light_kept``: the light resume's
+        restarted-window draw count; 0 for a finished run) -> (carry,
+        done, acc_start) or None.  Resuming the sidecar re-runs the tail
+        from its earlier iteration - more compute - but keeps every draw
+        its accumulators already hold, which is the point of maintaining
+        it: without this comparison a crash would lose draws back to the
+        light save even though a full snapshot sat right next to it."""
         side = cfg.checkpoint_path + ".full"
         if not os.path.exists(side):
             return None
         try:
             meta = read_checkpoint_meta(side)
-            if checkpoint_compatible(meta, cfg, fingerprint) is not None:
+            if (meta.get("state_only")
+                    or checkpoint_compatible(meta, cfg, fingerprint)
+                    is not None):
+                return None
+            s_acc0 = int(meta.get("acc_start", 0))
+            s_kept = (num_saved_draws(run.total_iters, run.burnin, run.thin)
+                      - num_saved_draws(s_acc0, run.burnin, run.thin))
+            if s_kept <= light_kept:
                 return None
             carry, meta = load_checkpoint(side, template)
-            return (carry, int(meta["iteration"]),
-                    int(meta.get("acc_start", 0)))
+            return carry, int(meta["iteration"]), s_acc0
         except Exception:
             return None
 
@@ -628,21 +638,22 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
                         load_checkpoint_resharded(found[1], template))
                     it = int(meta["iteration"])
                     if meta.get("state_only"):
-                        # Light checkpoint: accumulation restarts here.  A
-                        # resume whose restarted window contains ZERO saved
-                        # draws (finished run, or nothing but tail
-                        # iterations past the last thin point remain) would
-                        # silently return Sigma = 0.  First fall back to
-                        # the .full sidecar that checkpoint_full_every
-                        # maintains; absent that, refuse loudly.
+                        # Light checkpoint: accumulation restarts here,
+                        # keeping only the draws of the restarted window.
+                        # The .full sidecar (checkpoint_full_every) wins
+                        # whenever its accumulators preserve MORE draws -
+                        # including the window = 0 case (finished run, or
+                        # only tail iterations past the last thin point
+                        # remain), where a light resume would silently
+                        # return Sigma = 0.
                         window = (num_saved_draws(run.total_iters,
                                                   run.burnin, run.thin)
                                   - num_saved_draws(it, run.burnin,
                                                     run.thin))
+                        side = _try_full_sidecar(template, max(window, 0))
+                        if side is not None:
+                            return side
                         if window <= 0:
-                            side = _try_full_sidecar(template)
-                            if side is not None:
-                                return side
                             raise ValueError(
                                 "resuming a state-only (light) checkpoint "
                                 f"at iteration {it}: no further draws "
@@ -878,13 +889,19 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
                 full_due = (light_mode and cfg.checkpoint_full_every > 0
                             and (saves_done + 1)
                             % cfg.checkpoint_full_every == 0)
-                # full saves in light mode go to the .full SIDECAR: the
+                # Full saves in light mode go to the .full SIDECAR: the
                 # next light save atomically replaces checkpoint_path, so
                 # writing the full snapshot there would void the
-                # bounds-the-loss guarantee one save later.  The sidecar
-                # is picked up by _try_full_sidecar when a light resume
-                # has nothing to accumulate.
-                target = (cfg.checkpoint_path + ".full" if full_due
+                # bounds-the-loss guarantee one save later.  The resume
+                # path (_try_full_sidecar) prefers the sidecar whenever
+                # it preserves more draws than the light restart window.
+                # EXCEPT on the last boundary: checkpoint_path must always
+                # receive the final state (a stale light file there would
+                # mis-resume a finished run), and a full-due final save is
+                # simply written full to the main path - no later light
+                # save exists to overwrite it.
+                target = (cfg.checkpoint_path + ".full"
+                          if full_due and not last
                           else cfg.checkpoint_path)
                 t_ck = time.perf_counter()
                 try:

@@ -854,3 +854,81 @@ def test_checkpoint_full_every_sidecar_in_light_mode(
     res = fit(data, dataclasses.replace(cfg, resume=True))
     assert res.iters_per_sec > 0                 # ran the 24..32 tail
     np.testing.assert_array_equal(res.sigma_blocks, res_full.sigma_blocks)
+
+
+def test_midrun_crash_prefers_sidecar_over_light(tmp_path, monkeypatch, data):
+    """A mid-run crash in light mode resumes from the .full sidecar when it
+    preserves more draws than the light restart window - re-running the
+    tail from the full snapshot reproduces the uninterrupted run bit for
+    bit (without the preference, the crash would lose every draw before
+    the last light save even though a full snapshot sat right next to
+    it)."""
+    import dcfm_tpu.api as api
+
+    res_full = fit(data, _cfg())
+
+    ck = str(tmp_path / "mid.npz")
+    cfg = dataclasses.replace(
+        _cfg(), checkpoint_path=ck, checkpoint_mode="light",
+        checkpoint_every_chunks=1, checkpoint_full_every=2)
+    _use_sync_writer(monkeypatch)
+
+    real = api.save_checkpoint
+    calls = {"n": 0}
+
+    def killing_save(*a, **k):
+        real(*a, **k)
+        calls["n"] += 1
+        if calls["n"] == 3:     # light@8, FULL@16 (sidecar), light@24, kill
+            raise Killed("crash after the light save at 24")
+
+    monkeypatch.setattr(api, "save_checkpoint", killing_save)
+    with pytest.raises(Killed):
+        fit(data, cfg)
+    monkeypatch.setattr(api, "save_checkpoint", real)
+    import os
+    assert os.path.exists(ck + ".full")
+    _, meta = load_checkpoint_meta(ck)
+    assert meta["iteration"] == 24 and meta["state_only"] is True
+
+    # sidecar (full, iteration 16, all draws <= 16 accumulated) keeps 8
+    # draws vs the light restart window's 4 -> resume re-runs 16..32 and
+    # lands exactly on the uninterrupted run
+    res = fit(data, dataclasses.replace(cfg, resume=True))
+    np.testing.assert_array_equal(res.sigma_blocks, res_full.sigma_blocks)
+
+
+def test_final_full_due_save_goes_to_main_path(tmp_path, monkeypatch, data):
+    """When the LAST boundary's save is full-due, the full snapshot must
+    land on checkpoint_path itself (a sidecar-diverted final save would
+    leave a stale light file there, and a finished-run resume would
+    silently report a window-only Sigma)."""
+    import os
+
+    import dcfm_tpu.api as api
+
+    res_full = fit(data, _cfg())
+
+    seen = []
+    real = api.save_checkpoint
+
+    def recording(path, *a, **k):
+        seen.append((os.path.basename(path), bool(k.get("state_only"))))
+        real(path, *a, **k)
+
+    monkeypatch.setattr(api, "save_checkpoint", recording)
+    _use_sync_writer(monkeypatch)
+    ck = str(tmp_path / "final.npz")
+    cfg = dataclasses.replace(
+        _cfg(), checkpoint_path=ck, checkpoint_mode="light",
+        checkpoint_every_chunks=1, checkpoint_full_every=4)
+    fit(data, cfg)
+    # the 4th save is full-due AND final -> written FULL to the main path
+    assert seen == [("final.npz", True), ("final.npz", True),
+                    ("final.npz", True), ("final.npz", False)]
+    _, meta = load_checkpoint_meta(ck)
+    assert meta["iteration"] == 32 and meta["state_only"] is False
+    monkeypatch.setattr(api, "save_checkpoint", real)
+    res = fit(data, dataclasses.replace(cfg, resume=True))
+    assert res.iters_per_sec == 0.0       # finished full file: no-op resume
+    np.testing.assert_array_equal(res.sigma_blocks, res_full.sigma_blocks)
