@@ -156,8 +156,9 @@ def main():
     #   0.095-0.227 at other shapes, BASELINE.md); 0.18 = 1.5x the
     #   measured value, so a sampler degraded by ~50%+ fails loudly.
     # * chain_s: the Gibbs compute is the code under test and does NOT
-    #   ride the tunnel; measured 1.36-1.45 s across rounds 3-4, so 2.5 s
-    #   (~1.8x) means the sweep or the accumulation genuinely regressed.
+    #   ride the tunnel; measured 0.92-1.45 s across rounds 3-5 (1.04 s
+    #   at round 5 with the true-f32 sweep), so 2.5 s means the sweep or
+    #   the accumulation genuinely regressed.
     # The tight bounds only hold at the default north-star shape; an env-
     # overridden quick run (e.g. BENCH_ITERS=100 sanity checks) keeps the
     # loose accuracy guard and skips the chain_s budget.

@@ -13,7 +13,7 @@ from dcfm_tpu.config import (
     AdaptConfig, BackendConfig, DLConfig, FitConfig, HorseshoeConfig,
     MGPConfig, ModelConfig, RunConfig)
 
-__version__ = "0.4.0"
+__version__ = "0.5.0"
 
 __all__ = [
     "fit", "divideconquer", "FitResult",

@@ -892,9 +892,13 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
                 # Full saves in light mode go to the .full SIDECAR: the
                 # next light save atomically replaces checkpoint_path, so
                 # writing the full snapshot there would void the
-                # bounds-the-loss guarantee one save later.  The resume
-                # path (_try_full_sidecar) prefers the sidecar whenever
-                # it preserves more draws than the light restart window.
+                # bounds-the-loss guarantee one save later.  On
+                # single-process resume, _try_full_sidecar automatically
+                # prefers the sidecar whenever it preserves more draws
+                # than the light restart window; multi-process resume
+                # uses the light set (the sidecar is a normal
+                # .procK-of-N set at path+".full" - recover by pointing
+                # checkpoint_path at it).
                 # EXCEPT on the last boundary: checkpoint_path must always
                 # receive the final state (a stale light file there would
                 # mis-resume a finished run), and a full-due final save is

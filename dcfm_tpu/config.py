@@ -286,8 +286,12 @@ class FitConfig:
     # works.
     checkpoint_mode: str = "full"     # "full" | "light"
     # In light mode, additionally upgrade every k-th due save to a full
-    # snapshot (bounds the draws lost to a crash); 0 = full save only when
-    # the run ends under mode="full" semantics, i.e. never in light mode.
+    # snapshot, written to the ``checkpoint_path + ".full"`` sidecar
+    # (bounds the draws lost to a crash); 0 = never.  Single-process
+    # resume automatically prefers the sidecar whenever it preserves more
+    # saved draws than the light restart window; on multi-process runs
+    # the sidecar is a normal .procK-of-N set at the sidecar path -
+    # resume from it by pointing checkpoint_path there.
     checkpoint_full_every: int = 0
 
 

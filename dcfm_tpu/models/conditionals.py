@@ -121,6 +121,21 @@ def gibbs_sweep(
     replacement for the reference's tic/toc (``divideconquer.m:200-201``)
     must not itself cost a conditional's worth of device time per sweep.
     """
+    with jax.default_matmul_precision("highest"):
+        return _gibbs_sweep(key, Y, state, cfg, prior,
+                            shard_offset=shard_offset, reduce_fn=reduce_fn)
+
+
+def _gibbs_sweep(key, Y, state, cfg, prior, *, shard_offset, reduce_fn):
+    # True float32 matmuls, enforced by the precision scope above: the
+    # TPU MXU's DEFAULT precision is bf16-class, and the conditionals'
+    # precision/rate terms are numerically load-bearing (SURVEY section 7
+    # "Numerics") - under default precision the compiled-TPU Geweke joint
+    # test measures a REPRODUCIBLE z = 5.9 prior bias on the horseshoe's
+    # E[log ps]; with this scope all three priors pass on the chip.
+    # Measured cost: sweep 0.70 -> 0.89 ms/iter at the bench shape (+28%,
+    # the data-sized residual matmuls run multi-pass) - paid willingly,
+    # a sampler must not buy speed with a measurable prior bias.
     Gl, n, P = Y.shape
     K = state.Lambda.shape[-1]
     rho = cfg.rho
@@ -334,12 +349,22 @@ def covariance_blocks(
         Lam_all_c = Lam_all.astype(compute_dtype)
     else:
         Lam_local_c, Lam_all_c = Lam_local, Lam_all
-    ein = functools.partial(jnp.einsum, preferred_element_type=out_dtype)
+    # combine_dtype="float32" must MEAN float32: the TPU MXU's default
+    # matmul precision is bf16-class, so without an explicit HIGHEST the
+    # "full precision" combine silently matches the bfloat16 mode (caught
+    # by the draw-reconstruction test on the compiled-TPU lane).  When a
+    # reduced compute_dtype was chosen, default (fastest) precision is the
+    # point.
+    prec = jax.lax.Precision.HIGHEST if compute_dtype is None else None
+    ein = functools.partial(jnp.einsum, preferred_element_type=out_dtype,
+                            precision=prec)
     if eta_local is not None:
         n = eta_local.shape[1]
-        # the K x K cross-moments are cheap - keep them full precision; only
-        # the O(p^2 K) block products run in compute_dtype
-        H = jnp.einsum("rnk,cnj->rckj", eta_local, eta_all) / n  # (Gl,G,K,K)
+        # the K x K cross-moments are cheap - keep them full precision
+        # (explicitly: TPU default precision is not full) regardless of
+        # compute_dtype; only the O(p^2 K) block products run reduced
+        H = jnp.einsum("rnk,cnj->rckj", eta_local, eta_all,
+                       precision=jax.lax.Precision.HIGHEST) / n  # (Gl,G,K,K)
         LH = ein("rpk,rckj->rcpj", Lam_local_c,
                  H.astype(compute_dtype or out_dtype))           # (Gl,G,P,K)
         blocks = ein("rcpj,cqj->rcpq",

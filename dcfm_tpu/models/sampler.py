@@ -298,6 +298,16 @@ def run_chunk(
     thin = sched[1].astype(jnp.int32)
 
     def body(carry: ChainCarry, it_key: jax.Array) -> tuple[ChainCarry, None]:
+        # True-f32 matmuls for everything around the sweep too (imputation,
+        # trace, H cross-moments; gibbs_sweep carries its own scope).  The
+        # TPU MXU's DEFAULT precision is bf16-class - see _gibbs_sweep for
+        # the measured prior bias that forbids it.  The combine's explicit
+        # reduced-precision mode is unaffected (bf16 inputs multiply
+        # exactly on the MXU).
+        with jax.default_matmul_precision("highest"):
+            return _body(carry, it_key)
+
+    def _body(carry: ChainCarry, it_key: jax.Array):
         if cfg.impute_missing:
             # data-augmentation site: complete the NaN entries from their
             # conditional given the CURRENT state; every conditional and
@@ -376,8 +386,13 @@ def run_chunk(
                 H_bufs = draws.H
                 if H_bufs is not None:
                     n_obs = eta.shape[1]
-                    H_draw = jnp.einsum("rnk,cnj->rckj", eta,
-                                        eta_all) / n_obs   # (Gl, G, K, K)
+                    # HIGHEST: draw-level covariance reconstruction from
+                    # these stored cross-moments must match the combine's
+                    # full-precision blocks (TPU default precision is not
+                    # full - see covariance_blocks)
+                    H_draw = jnp.einsum(
+                        "rnk,cnj->rckj", eta, eta_all,
+                        precision=jax.lax.Precision.HIGHEST) / n_obs
                     H_bufs = lax.dynamic_update_slice_in_dim(
                         H_bufs, H_draw[None], idx, axis=0)
                 draws = DrawBuffers(

@@ -163,6 +163,9 @@ def test_rank_adapt_horseshoe_recovers_true_rank():
         assert np.all(Lam[m][:, act[m] == 0] == 0)
 
 
+@pytest.mark.skipif(len(jax.devices()) < 4,
+                    reason="needs 4 devices (self-skips on the 1-chip "
+                           "DCFM_TPU_TESTS lane)")
 def test_rank_adapt_mesh_matches_vmap():
     """Adaptation is per-shard-local; the mesh layout must reproduce the
     single-device chain bitwise, mask included."""

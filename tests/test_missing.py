@@ -105,8 +105,14 @@ def test_missing_checkpoint_resume_bitwise(tmp_path, monkeypatch):
         run=RunConfig(burnin=16, mcmc=16, thin=2, seed=0, chunk_size=8))
     full = fit(Ym, base)
 
+    # sync writer + cadence 1: the kill must land at a deterministic
+    # boundary (the async writer's busy-deferral and last-boundary
+    # warning-downgrade make the raise timing-dependent)
+    from tests.test_checkpoint import _use_sync_writer
+    _use_sync_writer(monkeypatch)
     ck = str(tmp_path / "miss.npz")
-    cfg_ck = dataclasses.replace(base, checkpoint_path=ck)
+    cfg_ck = dataclasses.replace(base, checkpoint_path=ck,
+                                 checkpoint_every_chunks=1)
     real = api.save_checkpoint
     calls = {"n": 0}
 
