@@ -556,32 +556,78 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
             out.append(num_iters % chunk)
         return out
 
-    def _try_full_sidecar(template, light_kept):
-        """Load the ``.full`` sidecar maintained by checkpoint_full_every
-        (light mode) IF it would preserve MORE saved draws than resuming
-        the light checkpoint would (``light_kept``: the light resume's
-        restarted-window draw count; 0 for a finished run) -> (carry,
-        done, acc_start) or None.  Resuming the sidecar re-runs the tail
-        from its earlier iteration - more compute - but keeps every draw
-        its accumulators already hold, which is the point of maintaining
-        it: without this comparison a crash would lose draws back to the
-        light save even though a full snapshot sat right next to it."""
+    def _local_set_source(path):
+        """Per-host local-disk fallback, shared by the main multi-process
+        resume and the sidecar eligibility check: fabricate a "local-set"
+        source from THIS process's own ``.procK-of-N`` file.  "local-set",
+        not "set": the peer files were never verified to exist on this
+        host - the loader's fast path treats it like a set (it only reads
+        the local file) while the reshard branch rejects the kind rather
+        than crashing on missing peers; callers additionally gate on
+        collective agreement.  -> (source, this process's file path), or
+        (None, None) when no local file exists."""
+        n = jax.process_count()
+        mine = proc_path(path, jax.process_index(), n)
+        if not os.path.exists(mine):
+            return None, None
+        it = int(read_checkpoint_meta(mine)["iteration"])
+        return ("local-set",
+                (n, [proc_path(path, i, n) for i in range(n)], it)), mine
+
+    def _sidecar_eligibility(light_kept):
+        """The ONE home of the "does the .full sidecar beat the light
+        resume" rule (checkpoint_full_every): discover the sidecar - a
+        plain file or a ``.procK-of-N`` set at ``checkpoint_path +
+        ".full"``, falling back to this process's own set file when peers
+        live on per-host local disks - and return ``(source, iteration,
+        acc_start)`` iff it is full, compatible, and preserves MORE saved
+        draws than ``light_kept`` (the light restart window; 0 for a
+        finished run).  None otherwise; never raises.  Resuming the
+        sidecar re-runs the tail from its earlier iteration - more
+        compute - but keeps every draw its accumulators already hold,
+        which is the point of maintaining it."""
         side = cfg.checkpoint_path + ".full"
-        if not os.path.exists(side):
-            return None
         try:
-            meta = read_checkpoint_meta(side)
-            if (meta.get("state_only")
-                    or checkpoint_compatible(meta, cfg, fingerprint)
+            source = discover_checkpoint(side, prefer_plain=not multiproc)
+            meta_path = None
+            if source is not None:
+                meta_path = side if source[0] == "plain" else source[1][1][0]
+            elif multiproc:
+                # per-host local disks: the shared local-set fallback; the
+                # unanimity gate in the caller keeps a partially present
+                # set from ever being acted on
+                source, meta_path = _local_set_source(side)
+            if source is None:
+                return None
+            smeta = read_checkpoint_meta(meta_path)
+            if (smeta.get("state_only")
+                    or checkpoint_compatible(smeta, cfg, fingerprint)
                     is not None):
                 return None
-            s_acc0 = int(meta.get("acc_start", 0))
+            s_acc0 = int(smeta.get("acc_start", 0))
             s_kept = (num_saved_draws(run.total_iters, run.burnin, run.thin)
                       - num_saved_draws(s_acc0, run.burnin, run.thin))
             if s_kept <= light_kept:
                 return None
-            carry, meta = load_checkpoint(side, template)
-            return carry, int(meta["iteration"]), s_acc0
+            return source, int(smeta["iteration"]), s_acc0
+        except Exception:
+            return None
+
+    def _try_full_sidecar(template, light_kept):
+        """Single-process sidecar load -> (carry, done, acc_start) or
+        None; eligibility via :func:`_sidecar_eligibility`."""
+        elig = _sidecar_eligibility(light_kept)
+        if elig is None:
+            return None
+        source, _, s_acc0 = elig
+        side = cfg.checkpoint_path + ".full"
+        try:
+            if source[0] == "plain":
+                carry, smeta = load_checkpoint(side, template)
+            else:
+                carry, smeta = load_checkpoint_resharded(source[1][1],
+                                                         template)
+            return carry, int(smeta["iteration"]), s_acc0
         except Exception:
             return None
 
@@ -711,9 +757,7 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
             except Exception as e:
                 source = None
                 failure = f"checkpoint unreadable: {e}"
-            my_path = proc_path(cfg.checkpoint_path, jax.process_index(),
-                                jax.process_count())
-            if source is None and os.path.exists(my_path):
+            if source is None:
                 # Per-host local checkpoint disks: discovery needs the
                 # whole set visible, but the SAME-topology fast path only
                 # ever reads this process's own file - fall back to it.
@@ -721,17 +765,9 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
                 # file), and the collective iteration agreement below
                 # still refuses mixed states.
                 try:
-                    n = jax.process_count()
-                    it = int(read_checkpoint_meta(my_path)["iteration"])
-                    # "local-set", not "set": only THIS process's file was
-                    # verified to exist; the loader's fast path treats it
-                    # like a set (it only reads the local file), while the
-                    # reshard branch rejects it explicitly rather than
-                    # crashing on peer files that may not be on this host.
-                    source = ("local-set",
-                              (n, [proc_path(cfg.checkpoint_path, i, n)
-                                   for i in range(n)], it))
-                    meta_path, failure = my_path, None
+                    source, lpath = _local_set_source(cfg.checkpoint_path)
+                    if source is not None:
+                        meta_path, failure = lpath, None
                 except Exception as e:
                     failure = failure or f"checkpoint unreadable: {e}"
             if source is not None:
@@ -770,7 +806,16 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
                                                else 1)
         src_count = (-1 if loaded is None or source[0] == "plain"
                      else source[1][0])
-        my_sig = np.asarray([my_iter, kind_code, src_count], np.int64)
+        # state_only is part of the signature: the light-resume branch
+        # below runs an EXTRA collective (the sidecar gates), so two
+        # processes that agree on iteration/kind/count but disagree on
+        # light-vs-full (e.g. per-host disks holding files from runs with
+        # different checkpoint_mode) must NOT pass this gate - one would
+        # enter the sidecar allgather while the other entered the chain.
+        so_code = (-1 if loaded is None
+                   else int(bool(loaded[1].get("state_only"))))
+        my_sig = np.asarray([my_iter, kind_code, src_count, so_code],
+                            np.int64)
         all_sigs = multihost_utils.process_allgather(my_sig)
         agree = my_iter >= 0 and bool(np.all(all_sigs == my_sig[None, :]))
         if agree:
@@ -779,27 +824,76 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
                 window = (num_saved_draws(run.total_iters, run.burnin,
                                           run.thin)
                           - num_saved_draws(my_iter, run.burnin, run.thin))
+                # Sidecar preference (checkpoint_full_every), collective
+                # with TWO unanimity gates.  Gate 1: every process
+                # evaluates the sidecar deterministically
+                # (_sidecar_eligibility - the same rule as single-process)
+                # and the switch is considered only if ALL processes saw
+                # the SAME, more-draw-preserving source (a partially
+                # visible, torn, or absent sidecar on ANY process keeps
+                # the agreed light resume everywhere).  Gate 2: the
+                # PAYLOAD load must succeed on every process before any
+                # commits - a truncated shard file on one host must not
+                # leave it raising while peers enter the chain (that
+                # would deadlock the first collective); on any failure
+                # all processes fall back to the already-loaded light
+                # carry.  The sidecar load transiently holds both carries
+                # (same 2x-accumulator class as the snapshot transient).
+                elig = _sidecar_eligibility(max(window, 0))
+                if elig is None:
+                    e_sig = np.asarray([-1, -1, -1], np.int64)
+                else:
+                    e_sig = np.asarray(
+                        [elig[1], 0 if elig[0][0] == "plain" else 1,
+                         (-1 if elig[0][0] == "plain"
+                          else elig[0][1][0])], np.int64)
+                all_e = multihost_utils.process_allgather(e_sig)
+                if (e_sig[0] >= 0
+                        and bool(np.all(all_e == e_sig[None, :]))):
+                    s_carry = smeta2 = None
+                    try:
+                        s_carry, smeta2 = load_checkpoint_multiprocess(
+                            cfg.checkpoint_path + ".full", template,
+                            source=elig[0])
+                        s_ok = 1
+                    except Exception:
+                        s_ok = 0
+                    all_ok = multihost_utils.process_allgather(
+                        np.asarray([s_ok], np.int64))
+                    if bool(np.all(all_ok == 1)):
+                        jax.tree.map(
+                            lambda a: (a.delete()
+                                       if isinstance(a, jax.Array)
+                                       else None), loaded[0])
+                        return (s_carry, int(smeta2["iteration"]),
+                                int(smeta2.get("acc_start", 0)))
+                    if s_carry is not None:   # a peer failed: fall back
+                        jax.tree.map(
+                            lambda a: (a.delete()
+                                       if isinstance(a, jax.Array)
+                                       else None), s_carry)
                 if window > 0:
                     return loaded[0], my_iter, my_iter
-                # light checkpoint with an empty restart window: nothing
-                # would be accumulated (see _resume_state); raising here
-                # is safe - every process agreed on the source, so all
-                # raise identically
+                # light checkpoint with an empty restart window and no
+                # unanimously better sidecar: nothing would be
+                # accumulated (see _resume_state); raising here is safe -
+                # every process agreed on the source, so all raise
+                # identically
                 if not auto:
                     raise ValueError(
                         "resuming a state-only (light) checkpoint at "
                         f"iteration {my_iter}: no further draws would be "
                         "saved and its covariance accumulators were not "
-                        "stored - extend run.mcmc, or recover manually "
-                        "from a .full sidecar if checkpoint_full_every "
-                        "maintained one")
+                        "stored - extend run.mcmc, or use "
+                        "checkpoint_full_every so a .full sidecar exists")
             else:
                 return loaded[0], my_iter, int(meta.get("acc_start", 0))
         if cfg.resume and not auto and not agree:
             raise ValueError(
                 failure or "resume=True but the per-process checkpoints "
                 "disagree on the resume source "
-                f"({all_sigs.tolist()} as [iteration, kind, count] rows) - "
+                f"({all_sigs.tolist()} as [iteration, kind, count, "
+                "state_only] rows) - "
                 "a crash between two processes' saves, or mixed stale "
                 "files; delete the files or use resume='auto' to restart "
                 "fresh")
@@ -892,13 +986,11 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
                 # Full saves in light mode go to the .full SIDECAR: the
                 # next light save atomically replaces checkpoint_path, so
                 # writing the full snapshot there would void the
-                # bounds-the-loss guarantee one save later.  On
-                # single-process resume, _try_full_sidecar automatically
+                # bounds-the-loss guarantee one save later.  Resume
                 # prefers the sidecar whenever it preserves more draws
-                # than the light restart window; multi-process resume
-                # uses the light set (the sidecar is a normal
-                # .procK-of-N set at path+".full" - recover by pointing
-                # checkpoint_path at it).
+                # than the light restart window - _try_full_sidecar
+                # single-process, the unanimity-gated collective check in
+                # _resume_state_multiproc on pods.
                 # EXCEPT on the last boundary: checkpoint_path must always
                 # receive the final state (a stale light file there would
                 # mis-resume a finished run), and a full-due final save is

@@ -287,11 +287,12 @@ class FitConfig:
     checkpoint_mode: str = "full"     # "full" | "light"
     # In light mode, additionally upgrade every k-th due save to a full
     # snapshot, written to the ``checkpoint_path + ".full"`` sidecar
-    # (bounds the draws lost to a crash); 0 = never.  Single-process
-    # resume automatically prefers the sidecar whenever it preserves more
-    # saved draws than the light restart window; on multi-process runs
-    # the sidecar is a normal .procK-of-N set at the sidecar path -
-    # resume from it by pointing checkpoint_path there.
+    # (bounds the draws lost to a crash); 0 = never.  Resume automatically
+    # prefers the sidecar whenever it preserves more saved draws than the
+    # light restart window - on multi-process runs the preference is
+    # collective and unanimity-gated (a partially visible sidecar
+    # degrades to the light resume on every process, never to divergent
+    # branches).
     checkpoint_full_every: int = 0
 
 

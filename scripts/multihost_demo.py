@@ -102,7 +102,7 @@ def child_ck(process_id: int) -> None:
     ref = api.fit(Y, FitConfig(model=model, run=run,
                                backend=BackendConfig(mesh_devices=0)))
 
-    restore = _crash_after_first_save("save_checkpoint_multiprocess")
+    restore = _crash_after_nth_save("save_checkpoint_multiprocess")
     try:
         api.fit(Y, cfg(False))
         raise SystemExit("simulated crash did not fire")
@@ -159,8 +159,88 @@ def child_ext(process_id: int) -> None:
     }), flush=True)
 
 
-def _crash_after_first_save(attr: str):
-    """Monkeypatch api.<attr> so the first checkpoint save completes and
+def child_light(process_id: int) -> None:
+    """Multi-host light checkpointing with the .full sidecar: a crash
+    after a later LIGHT save must resume from the earlier FULL sidecar
+    set (the unanimity-gated collective preference in
+    api._resume_state_multiproc) whenever the sidecar preserves more
+    saved draws, reproducing the uninterrupted run bit for bit."""
+    os.environ["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={DEVS_PER_PROC}")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from dcfm_tpu.parallel import multihost
+    multihost.initialize(f"127.0.0.1:{PORT}", NPROC, process_id)
+
+    import numpy as np
+    import dcfm_tpu.api as api
+    from dcfm_tpu import BackendConfig, FitConfig, ModelConfig, RunConfig
+    rng = np.random.default_rng(SEED)
+    p = G * P_SHARD
+    Y = rng.standard_normal((N, p)).astype(np.float32)
+    model = ModelConfig(num_shards=G, factors_per_shard=K, rho=0.9)
+    # 4 chunk boundaries (iters 2,4,6,8); full_every=2 -> the 2nd save is
+    # a full snapshot to the sidecar set
+    run = RunConfig(burnin=4, mcmc=4, thin=1, seed=SEED, chunk_size=2)
+    ckpath = os.path.join(os.environ["MULTIHOST_DEMO_DIR"], "light.ck")
+
+    def cfg(resume):
+        return FitConfig(model=model, run=run,
+                         backend=BackendConfig(mesh_devices=0),
+                         checkpoint_path=ckpath, resume=resume,
+                         checkpoint_mode="light",
+                         checkpoint_every_chunks=1, checkpoint_full_every=2)
+
+    ref = api.fit(Y, FitConfig(model=model, run=run,
+                               backend=BackendConfig(mesh_devices=0)))
+
+    # Synchronous writer so the kill lands at a deterministic boundary.
+    # Deliberately NOT tests/test_checkpoint._SyncWriter: that one
+    # jax.device_get()s the carry (fine for single-device carries), but
+    # save_checkpoint_multiprocess must receive the LIVE global arrays -
+    # it reads their addressable_shards.
+    class SyncWriter:
+        last_save_seconds = None
+
+        def submit(self, save_fn, path, carry, c, **kw):
+            save_fn(path, carry, c, **kw)
+
+        def poll_error(self):
+            return None
+
+        def busy(self):
+            return False
+
+        def wait(self):
+            pass
+
+    api.AsyncCheckpointWriter = SyncWriter
+    # light@2, FULL@4 (sidecar), light@6, then the simulated kill
+    restore = _crash_after_nth_save("save_checkpoint_multiprocess", nth=3)
+    try:
+        api.fit(Y, cfg(False))
+        raise SystemExit("simulated crash did not fire")
+    except RuntimeError:
+        pass
+    restore()
+
+    import glob
+    side_files = glob.glob(ckpath + ".full.proc*")
+    # the sidecar set (full@4, draws <= 4 accumulated: 4 of the 4 saved
+    # draws vs the light restart window's 2) must win the collective
+    # preference; resuming re-runs 4..8 and matches the uninterrupted run
+    res = api.fit(Y, cfg("auto"))
+    diff = float(np.abs(res.Sigma - ref.Sigma).max())
+    print("CHILD_LIGHT " + json.dumps({
+        "pid": process_id,
+        "sidecar_files": len(side_files),
+        "resumed_vs_uninterrupted_maxdiff": diff,
+        "ran_tail": res.iters_per_sec > 0,
+    }), flush=True)
+
+
+def _crash_after_nth_save(attr: str, nth: int = 1):
+    """Monkeypatch api.<attr> so the nth checkpoint save completes and
     then raises - the shared crash simulation for every recovery demo.
     Returns a restore() callable."""
     import dcfm_tpu.api as api
@@ -170,7 +250,7 @@ def _crash_after_first_save(attr: str):
     def killing(*a, **k):
         real(*a, **k)
         calls["n"] += 1
-        if calls["n"] == 1:
+        if calls["n"] == nth:
             raise RuntimeError("simulated crash mid-chain")
 
     setattr(api, attr, killing)
@@ -255,7 +335,7 @@ def child_resh(process_id: int) -> None:
     from dcfm_tpu import BackendConfig, FitConfig
     model, run, Y, ckpath = _resh_workload()
 
-    _crash_after_first_save("save_checkpoint_multiprocess")
+    _crash_after_nth_save("save_checkpoint_multiprocess")
     try:
         api.fit(Y, FitConfig(model=model, run=run,
                              backend=BackendConfig(mesh_devices=0),
@@ -312,7 +392,7 @@ def _resh_single(mode: str) -> None:
         assert res.iters_per_sec > 0, "resume was a no-op; nothing resharded"
         np.save(os.path.join(out_dir, "resumed.npy"), res.Sigma)
     elif mode == "save":
-        _crash_after_first_save("save_checkpoint")
+        _crash_after_nth_save("save_checkpoint")
         try:
             api.fit(Y, FitConfig(model=model, run=run, backend=be,
                                  checkpoint_path=ckpath))
@@ -444,6 +524,27 @@ def parent_ck() -> int:
     return 0 if ok else 1
 
 
+def parent_light() -> int:
+    t0 = time.perf_counter()
+    env = _child_env()
+    with tempfile.TemporaryDirectory() as tmp:
+        env["MULTIHOST_DEMO_DIR"] = tmp
+        results = _spawn_children("--child-light", "CHILD_LIGHT", env)
+    if results is None:
+        return 1
+    ok = all(r["sidecar_files"] == NPROC
+             and r["resumed_vs_uninterrupted_maxdiff"] <= 1e-6
+             and r["ran_tail"] for r in results.values())
+    print(json.dumps({
+        "demo": "multihost light checkpoints + .full sidecar preference, "
+                "2 procs",
+        "seconds": round(time.perf_counter() - t0, 1),
+        "results": results[0],
+        "ok": ok,
+    }))
+    return 0 if ok else 1
+
+
 def parent() -> int:
     t0 = time.perf_counter()
     env = _child_env()
@@ -508,6 +609,8 @@ if __name__ == "__main__":
         child_ck(int(sys.argv[2]))
     elif len(sys.argv) > 2 and sys.argv[1] == "--child-ext":
         child_ext(int(sys.argv[2]))
+    elif len(sys.argv) > 2 and sys.argv[1] == "--child-light":
+        child_light(int(sys.argv[2]))
     elif len(sys.argv) > 2 and sys.argv[1] == "--child-resh":
         child_resh(int(sys.argv[2]))
     elif len(sys.argv) > 2 and sys.argv[1] == "--child-resh-resume":
@@ -518,6 +621,8 @@ if __name__ == "__main__":
         import jax
         jax.config.update("jax_platforms", "cpu")
         _resh_single(sys.argv[2])
+    elif len(sys.argv) > 1 and sys.argv[1] == "--light":
+        sys.exit(parent_light())
     elif len(sys.argv) > 1 and sys.argv[1] == "--ck":
         sys.exit(parent_ck())
     elif len(sys.argv) > 1 and sys.argv[1] == "--ext":

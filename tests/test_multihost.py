@@ -71,6 +71,26 @@ def test_multihost_chain_extension():
 
 
 @pytest.mark.slow
+def test_multihost_light_sidecar_preference():
+    # light mode + checkpoint_full_every across 2 processes: a crash after
+    # a later light save must resume from the earlier FULL sidecar set
+    # (collective, unanimity-gated preference) and reproduce the
+    # uninterrupted run bitwise
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [_REPO] + [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+                   if p])
+    env["MULTIHOST_DEMO_PORT"] = "29877"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "scripts", "multihost_demo.py"),
+         "--light"],
+        env=env, cwd=_REPO, capture_output=True, text=True, timeout=560)
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    assert '"ok": true' in proc.stdout
+
+
+@pytest.mark.slow
 def test_multihost_topology_flexible_resume():
     # both reshard directions: a 2-process checkpoint set resumed on 1
     # process x 8 devices, and a plain single-process file resumed across
