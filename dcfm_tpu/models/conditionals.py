@@ -121,21 +121,24 @@ def gibbs_sweep(
     replacement for the reference's tic/toc (``divideconquer.m:200-201``)
     must not itself cost a conditional's worth of device time per sweep.
     """
-    with jax.default_matmul_precision("highest"):
+    with jax.default_matmul_precision("high"):
         return _gibbs_sweep(key, Y, state, cfg, prior,
                             shard_offset=shard_offset, reduce_fn=reduce_fn)
 
 
 def _gibbs_sweep(key, Y, state, cfg, prior, *, shard_offset, reduce_fn):
-    # True float32 matmuls, enforced by the precision scope above: the
-    # TPU MXU's DEFAULT precision is bf16-class, and the conditionals'
-    # precision/rate terms are numerically load-bearing (SURVEY section 7
-    # "Numerics") - under default precision the compiled-TPU Geweke joint
-    # test measures a REPRODUCIBLE z = 5.9 prior bias on the horseshoe's
-    # E[log ps]; with this scope all three priors pass on the chip.
-    # Measured cost: sweep 0.70 -> 0.89 ms/iter at the bench shape (+28%,
-    # the data-sized residual matmuls run multi-pass) - paid willingly,
-    # a sampler must not buy speed with a measurable prior bias.
+    # The precision scope above is load-bearing: the TPU MXU's DEFAULT
+    # matmul precision is single-pass bf16, and under it the compiled-TPU
+    # Geweke joint test measures a REPRODUCIBLE z = 5.9 prior bias on the
+    # horseshoe's E[log ps] - the conditionals' precision/rate terms are
+    # numerically load-bearing (SURVEY section 7 "Numerics").  "high"
+    # (bf16_3x: the f32 product reconstructed from three bf16 passes,
+    # per-op error ~2^-21 vs single-pass bf16's ~2^-8) removes the bias -
+    # all three priors' Geweke tests pass on the chip - at 0.72 ms/iter
+    # for the bench-shape sweep, vs 0.70 biased (default) and 0.89 exact
+    # ("highest", which measured statistically indistinguishable from
+    # "high" here).  A sampler must not buy speed with a measurable prior
+    # bias; "high" is the cheapest precision with none detectable.
     Gl, n, P = Y.shape
     K = state.Lambda.shape[-1]
     rho = cfg.rho

@@ -298,12 +298,16 @@ def run_chunk(
     thin = sched[1].astype(jnp.int32)
 
     def body(carry: ChainCarry, it_key: jax.Array) -> tuple[ChainCarry, None]:
-        # True-f32 matmuls for everything around the sweep too (imputation,
-        # trace, H cross-moments; gibbs_sweep carries its own scope).  The
-        # TPU MXU's DEFAULT precision is bf16-class - see _gibbs_sweep for
-        # the measured prior bias that forbids it.  The combine's explicit
-        # reduced-precision mode is unaffected (bf16 inputs multiply
-        # exactly on the MXU).
+        # Full-precision matmuls for everything around the sweep too
+        # (imputation, trace, H cross-moments; gibbs_sweep carries its own
+        # "high" scope).  HIGHEST here because the stored H cross-moments
+        # must reconstruct the combine's HIGHEST-precision blocks exactly
+        # (the draw-reconstruction test pins it); these ops are small, so
+        # the extra passes are free.  The TPU MXU's DEFAULT precision is
+        # single-pass bf16 - see _gibbs_sweep for the measured prior bias
+        # that forbids it anywhere on the sampling path.  The combine's
+        # explicit reduced-precision mode is unaffected (bf16 inputs
+        # multiply exactly on the MXU).
         with jax.default_matmul_precision("highest"):
             return _body(carry, it_key)
 
