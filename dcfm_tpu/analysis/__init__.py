@@ -1,0 +1,47 @@
+"""dcfm-lint: JAX/FFI-aware static analysis for the dcfm_tpu codebase.
+
+The classes of bug that have actually taken down this repo's runs are
+mechanically detectable at the source level, and every rule family here
+is named after one of them:
+
+* **DCFM1xx - RNG discipline.**  The divide-and-conquer Gibbs sampler
+  (arXiv:1612.02875) derives every random draw from a single run seed by
+  ``fold_in``/``split`` lineage; a key consumed by two samplers silently
+  correlates conditionals (and breaks the bitwise resume contract).
+* **DCFM2xx - jit hygiene.**  Host syncs (``float()``, ``np.asarray``,
+  ``.item()``), ``os.environ`` reads, and Python control flow on traced
+  values inside jit/scan-traced functions either fail at trace time or -
+  worse - silently constant-fold a value that should be data-dependent.
+* **DCFM3xx - dtype drift.**  The TPU path is float32 end to end;
+  a float64 literal or ``np.float64`` default leaking into a ``jnp``
+  expression doubles memory and silently de-optimizes the MXU path
+  (the MGP shrinkage machinery in models/priors.py is exactly the
+  numerically delicate code this protects).
+* **DCFM4xx - FFI safety.**  The ctypes-loaded native assembler
+  (native/__init__.py) is called with raw pointers; a missing
+  ``argtypes``/``restype`` declaration, a pointer taken from a temporary
+  array, or a missing C-contiguity guard is a heap corruption - the
+  process dies with SIGABRT/SIGSEGV, not a Python traceback.
+* **DCFM5xx - thread-shutdown discipline.**  A daemonic background
+  thread (the write-behind checkpoint saver) that is still inside
+  native/numpy/JAX code at interpreter teardown aborts the whole
+  process - the tier-1-killing failure mode this subsystem exists for.
+
+Run it as ``dcfm-tpu lint <paths>`` or ``python -m dcfm_tpu.analysis``.
+Suppress a single finding with an inline ``# dcfm: ignore[RULE_ID]``
+comment on the flagged line (use sparingly; CI treats any finding as a
+failure).
+"""
+
+from dcfm_tpu.analysis.linter import Finding, lint_file, lint_paths, lint_source
+from dcfm_tpu.analysis.rules import RULES, Rule
+
+__all__ = [
+    "Finding", "RULES", "Rule", "lint_file", "lint_paths", "lint_source",
+    "main",
+]
+
+
+def main(argv=None) -> int:
+    from dcfm_tpu.analysis.__main__ import main as _main
+    return _main(argv)

@@ -1,0 +1,60 @@
+"""CLI for the dcfm-lint static-analysis pass.
+
+``python -m dcfm_tpu.analysis [paths...]`` (also reachable as
+``dcfm-tpu lint``) lints the given files/directories (default:
+the ``dcfm_tpu`` package next to this file) and exits non-zero iff
+any finding was emitted - the CI gate (scripts/ci_check.sh).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="dcfm-tpu lint",
+        description="JAX/FFI-aware static analysis for dcfm_tpu "
+                    "(RNG discipline, jit hygiene, dtype drift, FFI "
+                    "safety, thread shutdown)")
+    p.add_argument("paths", nargs="*",
+                   help="files or directories to lint (default: the "
+                        "dcfm_tpu package)")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule registry and exit")
+    return p
+
+
+def main(argv=None) -> int:
+    from dcfm_tpu.analysis.linter import lint_paths
+    from dcfm_tpu.analysis.rules import RULES
+
+    args = build_parser().parse_args(argv)
+    if args.list_rules:
+        for r in RULES.values():
+            tag = " (library-only)" if r.library_only else ""
+            print(f"{r.id} [{r.name}]{tag}: {r.summary}")
+        return 0
+    paths = args.paths or [os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))]
+    findings = lint_paths(paths)
+    if args.format == "json":
+        print(json.dumps([{
+            "path": f.path, "line": f.line, "col": f.col,
+            "rule": f.rule, "message": f.message} for f in findings]))
+    else:
+        for f in findings:
+            print(f)
+        n = len(findings)
+        print(f"dcfm-lint: {n} finding{'s' if n != 1 else ''} in "
+              f"{len(set(f.path for f in findings))} file(s)"
+              if n else "dcfm-lint: clean")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,873 @@
+"""AST linter core: JAX/FFI-aware checks over one module at a time.
+
+Design: one :func:`lint_source` pass per file, no imports of the linted
+code (pure ``ast``), no third-party dependencies.  Each rule family is a
+separate checker over a shared :class:`_Module` context that pre-resolves
+the things every family needs:
+
+* import aliases (``jnp``/``np``/``jax.random``/``ctypes`` may be bound
+  to anything; the checkers work on *resolved* dotted names),
+* the set of **traced functions** - jit-decorated, ``jax.jit(f)``-wrapped,
+  or passed to ``lax.scan/cond/while_loop/fori_loop/switch`` /
+  ``jax.vmap/pmap`` - plus nested functions they call (propagated to
+  siblings defined in the same scope, the ``run_chunk`` ->
+  ``body``/``_body``/``accumulate`` structure),
+* CDLL-tainted names for the FFI family (values flowing out of
+  ``ctypes.CDLL`` through module globals and local helper returns).
+
+False-positive posture: every rule errs toward silence.  The lint gate is
+``dcfm-tpu lint dcfm_tpu/`` exiting 0 with no suppressions, so a rule
+that cries wolf on sanctioned idioms (``fold_in`` site derivation, the
+static-shape ``float()`` guards in ops/gamma.py, host-side ``np.float64``
+diagnostics) would be deleted, not argued with.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Iterable, Optional
+
+from dcfm_tpu.analysis.rules import RULES
+
+_IGNORE_RE = re.compile(r"#\s*dcfm:\s*ignore\[([A-Z0-9, ]+)\]")
+
+# jax.random functions that CONSUME the key they are given (the key must
+# not be used again).  fold_in/key/PRNGKey/clone DERIVE keys and are
+# exempt: fold_in with distinct site constants is this repo's sanctioned
+# key-derivation architecture (models/conditionals._shard_keys).
+_RNG_CONSUMERS = {
+    "split", "normal", "uniform", "gamma", "beta", "bernoulli", "cauchy",
+    "categorical", "chisquare", "choice", "dirichlet", "double_sided_maxwell",
+    "exponential", "f", "gumbel", "laplace", "loggamma", "logistic",
+    "maxwell", "multivariate_normal", "orthogonal", "pareto", "permutation",
+    "poisson", "rademacher", "randint", "rayleigh", "t", "truncated_normal",
+    "weibull_min", "ball", "binomial", "geometric",
+}
+_RNG_DERIVERS = {"fold_in", "key", "PRNGKey", "wrap_key_data", "clone",
+                 "key_data"}
+_KEY_PARAM_RE = re.compile(
+    r"^(key|keys|rng|rngs|rng_key|k|k_[A-Za-z0-9_]+|[A-Za-z0-9_]*_key)$")
+
+# callees whose function arguments execute under trace
+_TRACER_CALLERS = {"scan", "while_loop", "fori_loop", "cond", "switch",
+                   "vmap", "pmap", "checkpoint", "remat", "associative_scan",
+                   "pallas_call", "shard_map"}
+
+_CONTIG_PRODUCERS = {"ascontiguousarray", "require", "zeros", "empty",
+                     "ones", "full", "zeros_like", "empty_like",
+                     "ones_like", "full_like"}
+
+_HOST_SYNC_NP = {"asarray", "array", "ascontiguousarray", "save", "load",
+                 "copy"}
+_HOST_SYNC_METHODS = {"item", "tolist", "tobytes"}
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    path: str
+    line: int
+    col: int
+    rule: str
+    message: str
+
+    def __str__(self) -> str:
+        name = RULES[self.rule].name if self.rule in RULES else "error"
+        return (f"{self.path}:{self.line}:{self.col}: {self.rule} "
+                f"[{name}] {self.message}")
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """'jax.random.split' for Attribute/Name chains, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _last(name: Optional[str]) -> str:
+    return name.rsplit(".", 1)[-1] if name else ""
+
+
+class _Module:
+    """Shared per-file context: aliases, traced-function set, taint."""
+
+    def __init__(self, tree: ast.Module, source: str, path: str):
+        self.tree = tree
+        self.path = path
+        self.lines = source.splitlines()
+        base = os.path.basename(path)
+        self.is_test = base.startswith("test_") or base == "conftest.py"
+        self.ignores = self._collect_ignores()
+        self.aliases: dict = {}
+        self._collect_aliases()
+        self.traced: set = set()
+        self._collect_traced()
+
+    def _collect_ignores(self) -> dict:
+        out: dict = {}
+        for i, line in enumerate(self.lines, 1):
+            m = _IGNORE_RE.search(line)
+            if m:
+                out[i] = {r.strip() for r in m.group(1).split(",")}
+        return out
+
+    def _collect_aliases(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.aliases[a.asname or a.name.split(".")[0]] = (
+                        a.name if a.asname else a.name.split(".")[0])
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for a in node.names:
+                    self.aliases[a.asname or a.name] = (
+                        f"{node.module}.{a.name}")
+
+    def resolve(self, node: ast.AST) -> str:
+        """Canonical dotted name of an expression ('' if unresolvable):
+        the head segment is expanded through the import aliases, so
+        ``from jax import random as r`` makes ``r.split`` resolve to
+        ``jax.random.split``."""
+        name = _dotted(node)
+        if not name:
+            return ""
+        head, _, rest = name.partition(".")
+        head = self.aliases.get(head, head)
+        return f"{head}.{rest}" if rest else head
+
+    def is_jax_random(self, call: ast.Call) -> Optional[str]:
+        """The jax.random function name if this call targets one."""
+        full = self.resolve(call.func)
+        if full.startswith("jax.random."):
+            tail = full.rsplit(".", 1)[-1]
+            if tail in _RNG_CONSUMERS or tail in _RNG_DERIVERS:
+                return tail
+        return None
+
+    # -- traced-function discovery ------------------------------------
+    def _collect_traced(self) -> None:
+        # function-definition tree: every def, keyed by enclosing scope
+        self._defs_by_scope: dict = {}
+
+        def collect(scope: ast.AST) -> None:
+            local = self._defs_by_scope.setdefault(scope, {})
+            for st in ast.walk(scope):
+                if st is scope:
+                    continue
+                if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    # only direct children scopes: a def is "in" the
+                    # nearest enclosing def
+                    if _enclosing_def(self.tree, st) is scope or (
+                            scope is self.tree
+                            and _enclosing_def(self.tree, st) is None):
+                        local[st.name] = st
+                        collect(st)
+
+        collect(self.tree)
+
+        for scope, defs in self._defs_by_scope.items():
+            for fdef in defs.values():
+                for dec in getattr(fdef, "decorator_list", []):
+                    flat = ast.dump(dec)
+                    if "'jit'" in flat or "'pjit'" in flat:
+                        self.traced.add(fdef)
+        all_defs: dict = {}
+        for defs in self._defs_by_scope.values():
+            all_defs.update(defs)
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            tail = _last(self.resolve(node.func))
+            if tail not in {"jit", "pjit"} and tail not in _TRACER_CALLERS:
+                continue
+            for arg in list(node.args) + [k.value for k in node.keywords]:
+                if isinstance(arg, ast.Lambda):
+                    self.traced.add(arg)
+                elif isinstance(arg, ast.Name) and arg.id in all_defs:
+                    self.traced.add(all_defs[arg.id])
+                elif (isinstance(arg, ast.Call)
+                      and _last(self.resolve(arg.func)) == "partial"):
+                    for parg in arg.args:
+                        if isinstance(parg, ast.Name) and parg.id in all_defs:
+                            self.traced.add(all_defs[parg.id])
+        # propagate to same-scope siblings the traced functions call
+        # (run_chunk's scan body calls its sibling _body); module-level
+        # helpers are NOT propagated into - that is what keeps the
+        # statically-guarded float() in ops/gamma.py out of DCFM201.
+        changed = True
+        while changed:
+            changed = False
+            for scope, defs in self._defs_by_scope.items():
+                for fdef in [d for d in defs.values() if d in self.traced]:
+                    for call in ast.walk(fdef):
+                        if (isinstance(call, ast.Call)
+                                and isinstance(call.func, ast.Name)
+                                and call.func.id in defs
+                                and defs[call.func.id] not in self.traced):
+                            self.traced.add(defs[call.func.id])
+                            changed = True
+
+
+def _enclosing_def(tree: ast.Module, target: ast.AST):
+    """Nearest FunctionDef ancestor of ``target`` (None = module)."""
+    path = []
+
+    def walk(node, anc):
+        if node is target:
+            path.append(anc)
+            return True
+        for child in ast.iter_child_nodes(node):
+            na = node if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef)) else anc
+            if walk(child, na):
+                return True
+        return False
+
+    walk(tree, None)
+    return path[0] if path else None
+
+
+class _Reporter:
+    def __init__(self, mod: _Module):
+        self.mod = mod
+        self.findings: list = []
+        self._seen: set = set()
+
+    def emit(self, rule: str, node: ast.AST, message: str) -> None:
+        if rule in RULES and RULES[rule].library_only and self.mod.is_test:
+            return
+        line = getattr(node, "lineno", 0)
+        if rule in self.mod.ignores.get(line, set()):
+            return
+        key = (rule, line, getattr(node, "col_offset", 0))
+        if key in self._seen:
+            return
+        self._seen.add(key)
+        self.findings.append(Finding(
+            self.mod.path, line, getattr(node, "col_offset", 0), rule,
+            message))
+
+
+# =====================================================================
+# DCFM1xx - RNG discipline
+# =====================================================================
+
+@dataclasses.dataclass
+class _KeyState:
+    """Per-key consumption record along one control-flow path."""
+    samplers: int = 0                  # direct jax.random sampler/split uses
+    escapes: dict = dataclasses.field(default_factory=dict)  # callee -> n
+
+    def copy(self) -> "_KeyState":
+        return _KeyState(self.samplers, dict(self.escapes))
+
+    def merge(self, other: "_KeyState") -> "_KeyState":
+        esc = dict(self.escapes)
+        for c, n in other.escapes.items():
+            esc[c] = max(esc.get(c, 0), n)
+        return _KeyState(max(self.samplers, other.samplers), esc)
+
+
+class _KeyFlow:
+    """Path-sensitive single-scope key-consumption counter.
+
+    Tracks names bound to PRNG keys (key-producing assignments and
+    key-looking parameters) and counts static *consumption* sites.  A
+    key is violated when, along one path, it is (a) consumed by two
+    jax.random sampler/``split`` calls, (b) passed twice into the SAME
+    unknown callee, or (c) both sampled directly and passed into an
+    unknown callee.  Passing one parent key into *distinct* helpers is
+    exempt: that is this repo's sanctioned site-derivation architecture
+    (gibbs_sweep/impute_missing_y/adapt_rank each ``fold_in`` a distinct
+    ``_SITE_*`` constant from the same iteration key).  ``fold_in``
+    itself derives, never consumes.  ``if``/``else`` branches count
+    independently (a returning branch never merges with the fallthrough
+    path); loop bodies are walked twice so a key consumed across
+    iterations without re-derivation inside the loop is caught.  Nested
+    function bodies are separate scopes (closure keys are not tracked
+    there - by design, it keeps ``fit()``'s resume helpers quiet);
+    lambdas are walked inline with parameter shadowing.
+    """
+
+    def __init__(self, mod: _Module, rep: _Reporter, scope: ast.AST):
+        self.mod, self.rep = mod, rep
+        self.scope = scope
+
+    def run(self) -> None:
+        counts: dict = {}
+        args = getattr(self.scope, "args", None)
+        if args is not None:
+            for a in (args.posonlyargs + args.args + args.kwonlyargs):
+                if _KEY_PARAM_RE.match(a.arg):
+                    counts[a.arg] = _KeyState()
+        body = self.scope.body if isinstance(self.scope.body, list) else [
+            ast.Expr(self.scope.body)]
+        self._stmts(body, counts)
+
+    def _stmts(self, stmts, counts) -> bool:
+        """Process a statement list; True if every path terminates."""
+        for st in stmts:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+                continue  # separate scope, analyzed on its own
+            if isinstance(st, (ast.Return, ast.Raise)):
+                v = getattr(st, "value", None) or getattr(st, "exc", None)
+                if v is not None:
+                    self._expr(v, counts)
+                return True
+            if isinstance(st, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                if st.value is not None:
+                    self._expr(st.value, counts)
+                targets = (st.targets if isinstance(st, ast.Assign)
+                           else [st.target])
+                self._rebind(targets, st.value, counts)
+            elif isinstance(st, ast.If):
+                self._expr(st.test, counts)
+                c_body = {k: v.copy() for k, v in counts.items()}
+                c_else = {k: v.copy() for k, v in counts.items()}
+                t_body = self._stmts(st.body, c_body)
+                t_else = self._stmts(st.orelse, c_else)
+                live = [c for c, t in ((c_body, t_body), (c_else, t_else))
+                        if not t]
+                if not live:
+                    return True
+                merged: dict = {}
+                for c in live:
+                    for k, v in c.items():
+                        merged[k] = merged[k].merge(v) if k in merged else v
+                counts.clear()
+                counts.update(merged)
+            elif isinstance(st, (ast.For, ast.While)):
+                self._expr(st.iter if isinstance(st, ast.For) else st.test,
+                           counts)
+                self._stmts(st.body, counts)
+                self._stmts(st.body, counts)   # cross-iteration reuse
+                self._stmts(st.orelse, counts)
+            elif isinstance(st, ast.With):
+                for item in st.items:
+                    self._expr(item.context_expr, counts)
+                if self._stmts(st.body, counts):
+                    return True
+            elif isinstance(st, ast.Try):
+                self._stmts(st.body, counts)
+                for h in st.handlers:
+                    self._stmts(h.body,
+                                {k: v.copy() for k, v in counts.items()})
+                self._stmts(st.orelse, counts)
+                self._stmts(st.finalbody, counts)
+            elif isinstance(st, ast.Expr):
+                self._expr(st.value, counts)
+            else:
+                for child in ast.iter_child_nodes(st):
+                    if isinstance(child, ast.expr):
+                        self._expr(child, counts)
+        return False
+
+    def _rebind(self, targets, value, counts) -> None:
+        produced = self._is_key_producer(value)
+        for t in targets:
+            names = ([t.id] if isinstance(t, ast.Name) else
+                     [e.id for e in getattr(t, "elts", [])
+                      if isinstance(e, ast.Name)])
+            for n in names:
+                if produced:
+                    counts[n] = _KeyState()   # fresh key(s): lineage resets
+                elif n in counts:
+                    del counts[n]             # rebound to a non-key value
+
+    def _is_key_producer(self, value) -> bool:
+        if not isinstance(value, ast.Call):
+            return False
+        fn = self.mod.is_jax_random(value)
+        if fn == "split" or fn in _RNG_DERIVERS:
+            return True
+        return _last(self.mod.resolve(value.func)) == "chain_keys"
+
+    def _expr(self, node, counts, shadow=frozenset()) -> None:
+        if node is None:
+            return
+        if isinstance(node, ast.Lambda):
+            inner = shadow | {a.arg for a in node.args.args}
+            self._expr(node.body, counts, inner)
+            return
+        if isinstance(node, ast.Call):
+            self._consume(node, counts, shadow)
+        for child in ast.iter_child_nodes(node):
+            self._expr(child, counts, shadow)
+
+    def _consume(self, call, counts, shadow) -> None:
+        fn = self.mod.is_jax_random(call)
+        if fn is not None and fn != "split" and fn in _RNG_DERIVERS:
+            return                        # derivation, not consumption
+        full = self.mod.resolve(call.func)
+        tail = _last(full)
+        if fn is None and tail in {"eval_shape", "ShapeDtypeStruct",
+                                   "key_data", "block_until_ready"}:
+            return                        # shape/introspection only
+        callee = full or f"<dynamic:{id(call.func)}>"
+        for a in list(call.args) + [k.value for k in call.keywords]:
+            if not (isinstance(a, ast.Name) and a.id in counts
+                    and a.id not in shadow):
+                continue
+            st = counts[a.id]
+            if fn is not None:            # direct sampler / split
+                st.samplers += 1
+                if st.samplers >= 2 or st.escapes:
+                    self._flag(a)
+            else:                         # escapes into an unknown callee
+                st.escapes[callee] = st.escapes.get(callee, 0) + 1
+                if st.escapes[callee] >= 2 or st.samplers:
+                    self._flag(a)
+
+    def _flag(self, node) -> None:
+        self.rep.emit(
+            "DCFM101", node,
+            f"PRNG key '{node.id}' is consumed more than once on this "
+            "path (two samplers, the same helper twice, or a sampler "
+            "plus a helper) - derive a fresh key with split/fold_in "
+            "before each consumption")
+
+
+def _check_rng(mod: _Module, rep: _Reporter) -> None:
+    scopes = [mod.tree] + [
+        n for n in ast.walk(mod.tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    for scope in scopes:
+        _KeyFlow(mod, rep, scope).run()
+    # DCFM102: inline constant-seed key construction in library code,
+    # except shape-only eval_shape arguments
+    shape_only: set = set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call) and _last(
+                mod.resolve(node.func)) in {"eval_shape",
+                                            "ShapeDtypeStruct"}:
+            for sub in ast.walk(node):
+                shape_only.add(id(sub))
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call) or id(node) in shape_only:
+            continue
+        fn = mod.is_jax_random(node)
+        if fn in {"key", "PRNGKey"} and node.args \
+                and isinstance(node.args[0], ast.Constant):
+            rep.emit("DCFM102", node,
+                     f"jax.random.{fn}({node.args[0].value!r}) with a "
+                     "constant seed in library code - thread the "
+                     "caller's key/seed instead")
+
+
+# =====================================================================
+# DCFM2xx / DCFM3xx - jit hygiene and dtype drift
+# =====================================================================
+
+def _is_float64_dtype(mod: _Module, node: ast.AST) -> bool:
+    if _last(mod.resolve(node)) in {"float64", "double"}:
+        return True
+    return (isinstance(node, ast.Constant)
+            and node.value in ("float64", "double", ">f8", "<f8", "f8"))
+
+
+def _check_traced_bodies(mod: _Module, rep: _Reporter) -> None:
+    for fdef in mod.traced:
+        # subtrees of nested defs that are NOT themselves traced are a
+        # separate function - skip them here
+        skip: set = set()
+        for nd in ast.walk(fdef):
+            if nd is fdef or not isinstance(
+                    nd, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if nd not in mod.traced:
+                for sub in ast.walk(nd):
+                    skip.add(id(sub))
+        tracerish = _tracerish_names(mod, fdef)
+        for node in ast.walk(fdef):
+            if id(node) in skip:
+                continue
+            if isinstance(node, ast.Call):
+                _check_traced_call(mod, rep, node, tracerish)
+            resolved = ""
+            if isinstance(node, ast.Subscript):
+                resolved = mod.resolve(node.value)
+            elif isinstance(node, ast.Call):
+                resolved = mod.resolve(node.func)
+            if resolved in {"os.environ", "os.environ.get", "os.getenv"}:
+                rep.emit("DCFM203", node,
+                         "os.environ read inside a traced function is "
+                         "baked in at trace time; read it outside the "
+                         "jit and pass the value in")
+            if isinstance(node, (ast.If, ast.While)):
+                test = node.test
+                if _is_static_test(test):
+                    continue
+                if _mentions(test, tracerish) or _has_jnp_call(mod, test):
+                    rep.emit("DCFM202", node,
+                             "Python control flow on a traced value "
+                             "(ConcretizationError or silent trace-time "
+                             "constant fold; use lax.cond / jnp.where)")
+
+
+def _check_traced_call(mod, rep, node, tracerish) -> None:
+    full = mod.resolve(node.func)
+    tail = _last(full)
+    head = full.split(".", 1)[0] if full else ""
+    if head in {"numpy", "np"} and tail in _HOST_SYNC_NP:
+        rep.emit("DCFM201", node,
+                 f"numpy call '{full}' inside a traced function forces "
+                 "a host sync (or fails at trace time); use jnp")
+    elif full == "jax.device_get":
+        rep.emit("DCFM201", node,
+                 "jax.device_get inside a traced function")
+    elif (isinstance(node.func, ast.Attribute)
+          and node.func.attr in _HOST_SYNC_METHODS):
+        rep.emit("DCFM201", node,
+                 f".{node.func.attr}() inside a traced function "
+                 "materializes the value on host")
+    elif (isinstance(node.func, ast.Name)
+          and node.func.id in {"float", "int", "bool"}
+          and node.args and _mentions(node.args[0], tracerish)):
+        rep.emit("DCFM201", node,
+                 f"{node.func.id}() on a traced value forces a concrete "
+                 "host value at trace time")
+    for a in list(node.args) + [k.value for k in node.keywords]:
+        if _is_float64_dtype(mod, a):
+            rep.emit("DCFM301", a,
+                     "float64 dtype inside a traced function (the TPU "
+                     "path is float32 end to end)")
+    if tail == "astype" and node.args and isinstance(
+            node.args[0], ast.Name) and node.args[0].id == "float":
+        rep.emit("DCFM302", node,
+                 "astype(float) in traced code (float64 under x64; "
+                 "pin jnp.float32)")
+    for k in node.keywords:
+        if k.arg == "dtype" and isinstance(k.value, ast.Name) \
+                and k.value.id == "float":
+            rep.emit("DCFM302", k.value,
+                     "dtype=float in traced code (float64 under x64; "
+                     "pin jnp.float32)")
+
+
+def _tracerish_names(mod: _Module, fdef) -> set:
+    """Names assigned (anywhere in the function) from expressions that
+    contain a jnp/lax call - conservative 'this is an array value'
+    marker for DCFM201/202."""
+    out: set = set()
+    changed = True
+    while changed:
+        changed = False
+        for node in ast.walk(fdef):
+            if not isinstance(node, ast.Assign):
+                continue
+            if _has_jnp_call(mod, node.value) or _mentions(node.value, out):
+                for t in node.targets:
+                    if isinstance(t, ast.Name) and t.id not in out:
+                        out.add(t.id)
+                        changed = True
+    return out
+
+
+def _has_jnp_call(mod: _Module, node: ast.AST) -> bool:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call):
+            full = mod.resolve(n.func)
+            if full.startswith("jax.numpy.") or full.startswith("jax.lax.") \
+                    or full.split(".", 1)[0] in {"jnp", "lax"}:
+                return True
+    return False
+
+
+def _mentions(node: ast.AST, names: set) -> bool:
+    return any(isinstance(n, ast.Name) and n.id in names
+               for n in ast.walk(node))
+
+
+def _is_static_test(test: ast.AST) -> bool:
+    """Tests that are fine in traced code: None/isinstance/shape checks -
+    static structure, not traced values."""
+    if isinstance(test, ast.Compare) and any(
+            isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops):
+        return True
+    for n in ast.walk(test):
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Name) \
+                and n.func.id in {"isinstance", "hasattr", "len",
+                                  "getattr", "callable"}:
+            return True
+    return False
+
+
+def _check_dtype_module(mod: _Module, rep: _Reporter) -> None:
+    """DCFM301/302 outside traced functions: float64 passed into jnp
+    calls anywhere (host-side np.float64 diagnostics are deliberately
+    fine - utils/diagnostics.py accumulates in double on purpose)."""
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Attribute) and mod.resolve(node) in {
+                "jnp.float64", "jax.numpy.float64"}:
+            rep.emit("DCFM301", node,
+                     "jnp.float64 in library code - the TPU path is "
+                     "float32 end to end")
+        if not isinstance(node, ast.Call):
+            continue
+        full = mod.resolve(node.func)
+        if not (full.startswith("jnp.") or full.startswith("jax.numpy.")):
+            continue
+        for a in list(node.args) + [k.value for k in node.keywords]:
+            if _is_float64_dtype(mod, a):
+                rep.emit("DCFM301", a,
+                         f"float64 dtype passed to {full} - drifts the "
+                         "float32 TPU path to double precision")
+        for k in node.keywords:
+            if k.arg == "dtype" and isinstance(k.value, ast.Name) \
+                    and k.value.id == "float":
+                rep.emit("DCFM302", k.value,
+                         f"dtype=float passed to {full} (float64 under "
+                         "x64; pin jnp.float32)")
+
+
+# =====================================================================
+# DCFM4xx - FFI safety
+# =====================================================================
+
+def _check_ffi(mod: _Module, rep: _Reporter) -> None:
+    tainted = _cdll_tainted(mod)
+    declared_arg: set = set()
+    declared_res: set = set()
+    alias_to_sym: dict = {}
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        # fn = lib.symbol
+        if (isinstance(node.value, ast.Attribute)
+                and isinstance(node.value.value, ast.Name)
+                and node.value.value.id in tainted):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    alias_to_sym[t.id] = node.value.attr
+        # fn.argtypes = [...] / lib.sym.restype = ...
+        for t in node.targets:
+            if isinstance(t, ast.Attribute) and t.attr in ("argtypes",
+                                                           "restype"):
+                sym = None
+                if isinstance(t.value, ast.Name):
+                    sym = alias_to_sym.get(t.value.id)
+                elif (isinstance(t.value, ast.Attribute)
+                      and isinstance(t.value.value, ast.Name)
+                      and t.value.value.id in tainted):
+                    sym = t.value.attr
+                if sym:
+                    (declared_arg if t.attr == "argtypes"
+                     else declared_res).add(sym)
+
+    def check_sym(node, sym):
+        missing = [w for w, s in (("argtypes", declared_arg),
+                                  ("restype", declared_res))
+                   if sym not in s]
+        if missing:
+            rep.emit("DCFM401", node,
+                     f"foreign function '{sym}' called without "
+                     f"{' and '.join(missing)} declared - implicit int "
+                     "signatures corrupt 64-bit arguments")
+
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if (isinstance(node.func, ast.Attribute)
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id in tainted
+                and not node.func.attr.startswith("_")):
+            check_sym(node, node.func.attr)
+        elif (isinstance(node.func, ast.Name)
+              and node.func.id in alias_to_sym):
+            check_sym(node, alias_to_sym[node.func.id])
+    _check_data_as(mod, rep)
+
+
+def _cdll_tainted(mod: _Module) -> set:
+    """Names holding a ctypes.CDLL handle: direct constructions, module
+    globals they flow into, and locals assigned from helper functions
+    that return a tainted name (fixed point, a few passes)."""
+    tainted: set = set()
+    returns_tainted: set = set()
+    for _ in range(4):
+        changed = False
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Assign):
+                v, is_t = node.value, False
+                if isinstance(v, ast.Call):
+                    if _last(mod.resolve(v.func)) in {"CDLL", "LoadLibrary",
+                                                      "PyDLL", "WinDLL"}:
+                        is_t = True
+                    elif (isinstance(v.func, ast.Name)
+                          and v.func.id in returns_tainted):
+                        is_t = True
+                elif isinstance(v, ast.Name) and v.id in tainted:
+                    is_t = True
+                if is_t:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name) and t.id not in tainted:
+                            tainted.add(t.id)
+                            changed = True
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for r in ast.walk(node):
+                    if (isinstance(r, ast.Return)
+                            and isinstance(r.value, ast.Name)
+                            and r.value.id in tainted
+                            and node.name not in returns_tainted):
+                        returns_tainted.add(node.name)
+                        changed = True
+        if not changed:
+            break
+    return tainted
+
+
+def _check_data_as(mod: _Module, rep: _Reporter) -> None:
+    # pointer wrappers: tiny pure-conversion helpers that directly
+    # `return param.ctypes.data_as(...)` (native._ptr).  Their CALLERS
+    # are checked instead; a function that merely uses data_as on a
+    # parameter somewhere is NOT a wrapper and gets checked itself.
+    wrappers: set = set()
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        params = {a.arg for a in node.args.args}
+        stmts = [s for s in node.body
+                 if not (isinstance(s, ast.Expr)
+                         and isinstance(s.value, ast.Constant))]
+        if (len(stmts) == 1 and isinstance(stmts[0], ast.Return)
+                and _is_data_as(stmts[0].value)
+                and isinstance(stmts[0].value.func.value.value, ast.Name)
+                and stmts[0].value.func.value.value.id in params):
+            wrappers.add(node.name)
+
+    for fdef in ast.walk(mod.tree):
+        if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        guarded = _contiguity_guarded_names(mod, fdef)
+        for n in ast.walk(fdef):
+            if not isinstance(n, ast.Call):
+                continue
+            recv = None
+            if _is_data_as(n):
+                recv = n.func.value.value
+            elif (isinstance(n.func, ast.Name) and n.func.id in wrappers
+                  and n.args):
+                recv = n.args[0]
+            if recv is None:
+                continue
+            if not isinstance(recv, ast.Name):
+                rep.emit("DCFM402", n,
+                         "pointer taken from a temporary expression - "
+                         "the array may be collected while the foreign "
+                         "call still uses its memory; bind it to a "
+                         "local that outlives the call")
+            elif fdef.name not in wrappers and recv.id not in guarded:
+                rep.emit("DCFM403", n,
+                         f"'{recv.id}' passed by pointer without a "
+                         "C-contiguity+dtype guard in this function "
+                         "(np.ascontiguousarray it, allocate it here, "
+                         "or check .flags.c_contiguous)")
+
+
+def _is_data_as(n: ast.AST) -> bool:
+    return (isinstance(n, ast.Call)
+            and isinstance(n.func, ast.Attribute)
+            and n.func.attr == "data_as"
+            and isinstance(n.func.value, ast.Attribute)
+            and n.func.value.attr == "ctypes")
+
+
+def _contiguity_guarded_names(mod: _Module, fdef) -> set:
+    out: set = set()
+    for n in ast.walk(fdef):
+        if isinstance(n, ast.Assign) and isinstance(n.value, ast.Call):
+            if _last(mod.resolve(n.value.func)) in _CONTIG_PRODUCERS:
+                for t in n.targets:
+                    if isinstance(t, ast.Name):
+                        out.add(t.id)
+        if (isinstance(n, ast.Attribute) and n.attr == "c_contiguous"
+                and isinstance(n.value, ast.Attribute)
+                and n.value.attr == "flags"
+                and isinstance(n.value.value, ast.Name)):
+            out.add(n.value.value.id)
+    return out
+
+
+# =====================================================================
+# DCFM5xx - thread-shutdown discipline
+# =====================================================================
+
+def _check_threads(mod: _Module, rep: _Reporter) -> None:
+    has_join = any(
+        isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute)
+        and n.func.attr == "join" and not n.args
+        for n in ast.walk(mod.tree))
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if _last(mod.resolve(node.func)) == "Thread":
+            for k in node.keywords:
+                if (k.arg == "daemon" and isinstance(k.value, ast.Constant)
+                        and k.value.value is True):
+                    rep.emit("DCFM501", node,
+                             "daemon thread in library code: still "
+                             "running at interpreter teardown it aborts "
+                             "inside native/numpy/JAX (the tier-1 "
+                             "SIGABRT class); use a non-daemon thread "
+                             "joined before teardown")
+            if not has_join:
+                rep.emit("DCFM502", node,
+                         "thread created in a module with no .join() "
+                         "anywhere - nothing bounds its lifetime before "
+                         "interpreter teardown")
+        if (isinstance(node.func, ast.Attribute)
+                and node.func.attr == "start"
+                and isinstance(node.func.value, ast.Call)
+                and _last(mod.resolve(node.func.value.func)) == "Thread"):
+            rep.emit("DCFM502", node,
+                     "thread started as a temporary - it can never be "
+                     "joined; bind it and join before teardown")
+
+
+# =====================================================================
+# driver
+# =====================================================================
+
+def lint_source(source: str, path: str = "<string>") -> list:
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [Finding(path, e.lineno or 0, e.offset or 0, "DCFM000",
+                        f"syntax error: {e.msg}")]
+    mod = _Module(tree, source, path)
+    rep = _Reporter(mod)
+    _check_rng(mod, rep)
+    _check_traced_bodies(mod, rep)
+    _check_dtype_module(mod, rep)
+    _check_ffi(mod, rep)
+    _check_threads(mod, rep)
+    rep.findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return rep.findings
+
+
+def lint_file(path: str) -> list:
+    with open(path, "r", encoding="utf-8") as f:
+        return lint_source(f.read(), path)
+
+
+def lint_paths(paths: Iterable[str]) -> list:
+    findings: list = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = [d for d in dirs if d not in {
+                    "__pycache__", ".git", ".jax_cache"}]
+                for fn in sorted(files):
+                    if fn.endswith(".py"):
+                        findings.extend(lint_file(os.path.join(root, fn)))
+        elif p.endswith(".py"):
+            findings.extend(lint_file(p))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings

@@ -1,0 +1,86 @@
+"""Rule registry: one place that names every rule the linter can emit.
+
+The linter (analysis/linter.py) imports nothing from here at check time -
+rules are emitted by ID string - but the registry is the documentation
+the CLI's ``--list-rules`` prints and the README section is generated
+from, and the fixture tests assert that every registered rule has at
+least one known-bad fixture that fires it.
+
+``library_only`` rules are skipped for test files (``test_*.py`` /
+``conftest.py``): tests legitimately use constant seeds and daemon
+helper threads; library code must not.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    id: str
+    name: str
+    family: str
+    summary: str
+    library_only: bool = False
+
+
+RULES = {r.id: r for r in [
+    # ---- DCFM1xx: RNG discipline -------------------------------------
+    Rule("DCFM101", "rng-key-reuse", "rng",
+         "a PRNG key is consumed more than once on one path: two "
+         "jax.random sampler/split calls, the same helper twice, or a "
+         "sampler plus a helper.  fold_in derivation and handing one "
+         "parent key to distinct site-deriving helpers are exempt"),
+    Rule("DCFM102", "rng-inline-const-key", "rng",
+         "jax.random.key/PRNGKey called with a constant seed inline in "
+         "library code (fixed entropy; thread the caller's key instead). "
+         "Shape-only jax.eval_shape arguments are exempt",
+         library_only=True),
+    # ---- DCFM2xx: jit hygiene ----------------------------------------
+    Rule("DCFM201", "jit-host-sync", "jit",
+         "host-synchronizing call (np.asarray/np.array, .item(), "
+         ".tolist(), jax.device_get, float()/int()/bool() on a traced "
+         "value) inside a jit-decorated or scan/cond/while-carried "
+         "function"),
+    Rule("DCFM202", "jit-python-control-flow", "jit",
+         "Python if/while on a value computed from jnp/lax inside a "
+         "traced function (trace-time constant-fold or ConcretizationError; "
+         "use lax.cond/lax.select)"),
+    Rule("DCFM203", "jit-env-read", "jit",
+         "os.environ read inside a traced function (baked in at trace "
+         "time, ignored on later calls; read it outside the jit)"),
+    # ---- DCFM3xx: dtype drift ----------------------------------------
+    Rule("DCFM301", "dtype-float64", "dtype",
+         "float64 dtype (jnp.float64, np.float64/'float64' passed to a "
+         "jnp call, or any float64 inside a traced function) leaking "
+         "into the float32 TPU path"),
+    Rule("DCFM302", "dtype-weak-float", "dtype",
+         "builtin float used as a dtype in a jnp call or astype(float) "
+         "on a traced value (means float64 under x64; pin jnp.float32)"),
+    # ---- DCFM4xx: FFI safety -----------------------------------------
+    Rule("DCFM401", "ffi-missing-signature", "ffi",
+         "ctypes foreign function called without both argtypes and "
+         "restype declared (mismatched implicit int signature corrupts "
+         "the stack on 64-bit args)"),
+    Rule("DCFM402", "ffi-pointer-from-temporary", "ffi",
+         "ndarray.ctypes.data_as (or a wrapper around it) applied to a "
+         "temporary expression - the array can be garbage-collected "
+         "while the native call still holds its pointer; bind it to a "
+         "local first"),
+    Rule("DCFM403", "ffi-missing-contiguity-guard", "ffi",
+         "array passed by pointer to a foreign call without a "
+         "C-contiguity + dtype guard (np.ascontiguousarray / allocation "
+         "/ .flags.c_contiguous check) in the same function"),
+    # ---- DCFM5xx: thread-shutdown discipline -------------------------
+    Rule("DCFM501", "thread-daemon-in-library", "thread",
+         "threading.Thread(daemon=True) in library code: a daemon "
+         "thread still inside native/numpy/JAX code at interpreter "
+         "teardown aborts the process (SIGABRT); use a non-daemon "
+         "thread joined before teardown",
+         library_only=True),
+    Rule("DCFM502", "thread-started-unjoinable", "thread",
+         "Thread started as a temporary (threading.Thread(...).start()) "
+         "or in a module with no .join() anywhere - nothing can join it "
+         "before interpreter teardown"),
+]}

@@ -357,6 +357,18 @@ def _cast_f32_jit():
     return jax.jit(lambda x: x.astype(jnp.float32))
 
 
+@functools.lru_cache(maxsize=4)
+def _owned_copy_jit():
+    """Identity-copy jit: every output leaf is a freshly allocated,
+    XLA-owned buffer.  The safe ingestion seam for host numpy pytrees
+    (checkpoint loads) that will outlive their numpy sources - the CPU
+    backend's zero-copy device_put can alias a numpy buffer WITHOUT
+    keeping it alive, and computing on it after the source is dropped
+    reads freed heap (garbage results / glibc abort).  Re-traces per
+    pytree structure, cached thereafter."""
+    return jax.jit(lambda t: jax.tree.map(jnp.copy, t))
+
+
 def _upload_host_array(data: np.ndarray, upload_dtype: str) -> np.ndarray:
     """Down-cast the standardized data on the host so fewer bytes cross the
     host->device link; the device casts back to float32 on arrival."""
@@ -909,10 +921,35 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
             carry0 = init_fn(k_init, Yd)
         return carry0, 0, 0
 
-    def _run_chain(init_fn, get_chunk_fn, Yd):
+    def _run_chain(init_fn, get_chunk_fn, Yd, commit_fn=None):
         t_init = time.perf_counter()
         carry, done, acc_start = (_resume_state_multiproc if multiproc
                                   else _resume_state)(init_fn, Yd)
+        if commit_fn is not None and done:
+            # Commit a RESUMED carry into device-OWNED buffers before the
+            # first chunk call.  Two independent reasons, both load-
+            # bearing:
+            #
+            # 1. Lifetime.  load_checkpoint returns host numpy leaves,
+            #    and on the CPU backend jax's array ingestion can
+            #    zero-copy ALIAS a (suitably aligned) numpy buffer
+            #    without keeping the numpy array alive.  The loader's
+            #    arrays die when this rebind drops them, so the chain
+            #    would compute on freed heap - garbage Sigma when
+            #    lucky, glibc abort ("corrupted size vs. prev_size") /
+            #    SIGSEGV when not.  This was the process-killing crash
+            #    at the mesh checkpoint-resume tests in tier-1.  The
+            #    commit therefore runs a jitted COPY (jnp.copy per
+            #    leaf): jit outputs are freshly allocated XLA-owned
+            #    buffers by construction, while the numpy inputs stay
+            #    referenced for the duration of the call.
+            #
+            # 2. Signature stability.  Feeding host numpy leaves
+            #    straight into the jitted chunk presents an uncommitted
+            #    argument signature that differs from the committed
+            #    carry every fresh start uses, forcing a full recompile
+            #    of the chunk program on every resume.
+            carry = commit_fn(carry)
         jax.block_until_ready(carry)
         phase["init_s"] = time.perf_counter() - t_init
         stats = None
@@ -1058,10 +1095,30 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
                 Yd = _cast_f32_jit()(Yd)  # jit preserves the sharding
             jax.block_until_ready(Yd)
             phase["upload_s"] = time.perf_counter() - t_up
+            def _commit_mesh(c):
+                # Resumed carry (host numpy from load_checkpoint) ->
+                # XLA-OWNED device arrays with the EXACT carry
+                # shardings the shard_map chunk expects (see the
+                # commit_fn rationale in _run_chain: a raw device_put
+                # of numpy can zero-copy alias the loader's buffers and
+                # compute on freed heap once they are dropped; the
+                # jitted jnp.copy allocates fresh device-owned
+                # buffers).
+                from jax.sharding import NamedSharding, PartitionSpec
+                specs = _mesh_fns(mesh, m, chunk, C, S_draws)[2]
+                spec_leaves = jax.tree.leaves(
+                    specs, is_leaf=lambda x: isinstance(x, PartitionSpec))
+                _, treedef = jax.tree.flatten(c)
+                shardings = jax.tree.unflatten(
+                    treedef, [NamedSharding(mesh, s) for s in spec_leaves])
+                return jax.jit(lambda t: jax.tree.map(jnp.copy, t),
+                               out_shardings=shardings)(c)
+
             (carry, stats, executed, traces, chunk_secs, done, acc_start,
              ck_error) = _run_chain(
                 _mesh_fns(mesh, m, chunk, C, S_draws)[0],
-                lambda ni: _mesh_fns(mesh, m, ni, C, S_draws)[1], Yd)
+                lambda ni: _mesh_fns(mesh, m, ni, C, S_draws)[1], Yd,
+                commit_fn=None if multiproc else _commit_mesh)
         else:
             with jax.default_device(devices[0]):
                 t_up = time.perf_counter()
@@ -1082,7 +1139,14 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
                 (carry, stats, executed, traces, chunk_secs, done, acc_start,
                  ck_error) = _run_chain(
                     lambda k, Y: jax.device_put(init_fn(k, Y), devices[0]),
-                    lambda ni: _local_fns(m, ni, C, S_draws)[1], Yd)
+                    lambda ni: _local_fns(m, ni, C, S_draws)[1], Yd,
+                    # jit copy FIRST (fresh XLA-owned buffers - a raw
+                    # device_put of the loader's numpy can zero-copy
+                    # alias memory that dies at the commit rebind; see
+                    # _run_chain), then device_put of the jax arrays to
+                    # commit them to the device.
+                    commit_fn=lambda c: jax.device_put(
+                        _owned_copy_jit()(c), devices[0]))
     if stats is None:
         # resumed from a finished checkpoint: recompute the diagnostics
         # from the carried running-health panel (replicated first on

@@ -32,6 +32,21 @@ def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="dcfm_tpu", description=__doc__)
     sub = p.add_subparsers(dest="command", required=True)
 
+    # Static-analysis / test-infrastructure subcommands (dcfm_tpu/analysis).
+    # HELP-ONLY entries: main() dispatches "lint"/"test-isolated" to the
+    # delegated parsers BEFORE argparse runs (their own flags, e.g.
+    # `lint --list-rules`, belong to those parsers); these registrations
+    # exist so `dcfm-tpu --help` lists the subcommands.
+    sub.add_parser(
+        "lint", add_help=False,
+        help="JAX/FFI-aware static analysis (dcfm-lint); see "
+             "`dcfm-tpu lint --list-rules`")
+    sub.add_parser(
+        "test-isolated", add_help=False,
+        help="run pytest one subprocess per test file, so a native "
+             "crash (SIGABRT/SIGSEGV) fails one file instead of the "
+             "whole suite")
+
     f = sub.add_parser("fit", help="fit the model and write Sigma-hat")
     f.add_argument("data", help="observations, (n, p) .npy or .csv")
     f.add_argument("--shards", "-g", type=int, required=True,
@@ -147,6 +162,17 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv=None) -> int:
+    # lint/test-isolated dispatch BEFORE argparse: their flags (e.g.
+    # `lint --list-rules`) belong to the delegated parser, which
+    # argparse.REMAINDER would refuse when an option precedes the first
+    # positional.
+    raw = list(sys.argv[1:] if argv is None else argv)
+    if raw and raw[0] == "lint":
+        from dcfm_tpu.analysis.__main__ import main as lint_main
+        return lint_main(raw[1:])
+    if raw and raw[0] == "test-isolated":
+        from dcfm_tpu.analysis.isolate import main as isolate_main
+        return isolate_main(raw[1:])
     args = build_parser().parse_args(argv)
     from dcfm_tpu.config import (
         BackendConfig, FitConfig, ModelConfig, RunConfig)

@@ -27,10 +27,49 @@ import numpy as np
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "assemble.cpp")
 _LIB = os.path.join(_DIR, "_assemble.so")
+# Sanitizer lane (DCFM_NATIVE_SANITIZE=1): a separate ASan+UBSan debug
+# object, so the sanitized and production builds never invalidate each
+# other's mtime-based cache.  Loading it requires the ASan runtime to be
+# first in the process's library order (LD_PRELOAD=$(gcc
+# -print-file-name=libasan.so) for a stock CPython); when that is not
+# the case CDLL fails at load and the module degrades to the NumPy
+# fallback exactly like a missing compiler.
+_LIB_SAN = os.path.join(_DIR, "_assemble_san.so")
 
 _lock = threading.Lock()
 _lib = None
 _build_failed = False
+
+
+def sanitize_requested() -> bool:
+    """True when DCFM_NATIVE_SANITIZE=1 selects the ASan/UBSan build."""
+    return os.environ.get("DCFM_NATIVE_SANITIZE") == "1"
+
+
+def _asan_runtime_loaded() -> bool:
+    """True when the ASan runtime is already in this process (LD_PRELOAD).
+
+    Loading the sanitized .so WITHOUT the runtime preloaded does not
+    raise a catchable OSError - __asan_init terminates the process - so
+    the loader must check first and fall back instead of attempting it.
+    """
+    try:
+        with open("/proc/self/maps", "r") as f:
+            return "libasan" in f.read()
+    except OSError:
+        return False
+
+
+def _build_cmd(out_path: str, sanitize: bool) -> list:
+    # -Wall -Wextra always: the kernel compiles warning-free and must
+    # stay that way (tests/test_native_assemble.py pins it).
+    flags = ["-shared", "-fPIC", "-std=c++17", "-Wall", "-Wextra"]
+    if sanitize:
+        flags += ["-O1", "-g", "-fno-omit-frame-pointer",
+                  "-fsanitize=address,undefined"]
+    else:
+        flags += ["-O3"]
+    return ["g++", *flags, "-o", out_path, _SRC]
 
 
 def _load() -> Optional[ctypes.CDLL]:
@@ -38,12 +77,25 @@ def _load() -> Optional[ctypes.CDLL]:
     with _lock:
         if _lib is not None or _build_failed:
             return _lib
+        if os.environ.get("DCFM_NATIVE_DISABLE") == "1":
+            # explicit kill switch: every caller degrades to the NumPy
+            # path (crash triage: rules out the FFI lane in one rerun)
+            _build_failed = True
+            return None
+        sanitize = sanitize_requested()
+        if sanitize and not _asan_runtime_loaded():
+            # the sanitized .so would abort the process at dlopen (see
+            # _asan_runtime_loaded); degrade to the NumPy path instead
+            _build_failed = True
+            return None
+        lib_path = _LIB_SAN if sanitize else _LIB
         try:
             # a shipped prebuilt .so without the source stays usable; only
             # rebuild when the source exists and is newer
             stale = (os.path.exists(_SRC)
-                     and (not os.path.exists(_LIB)
-                          or os.path.getmtime(_LIB) < os.path.getmtime(_SRC)))
+                     and (not os.path.exists(lib_path)
+                          or os.path.getmtime(lib_path)
+                          < os.path.getmtime(_SRC)))
             if stale:
                 # per-process temp name: concurrent builders (e.g. parallel
                 # test workers) must not clobber each other's half-written
@@ -52,14 +104,13 @@ def _load() -> Optional[ctypes.CDLL]:
                 os.close(fd)
                 try:
                     subprocess.run(
-                        ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
-                         "-o", tmp, _SRC],
+                        _build_cmd(tmp, sanitize),
                         check=True, capture_output=True)
-                    os.replace(tmp, _LIB)
+                    os.replace(tmp, lib_path)
                 finally:
                     if os.path.exists(tmp):
                         os.unlink(tmp)
-            lib = ctypes.CDLL(_LIB)
+            lib = ctypes.CDLL(lib_path)
             # "_rowmajor" names version the ABI: a stale prebuilt .so with
             # the older argument lists fails the lookup here and degrades
             # to the NumPy path instead of segfaulting through a

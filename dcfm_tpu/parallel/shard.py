@@ -69,7 +69,10 @@ def build_mesh_chain(
     num_stored_draws: int = 0,
     compiler_options: Optional[dict] = None,
 ):
-    """Returns jitted (init_fn, chunk_fn) operating on mesh-sharded arrays.
+    """Returns ``(init_fn, chunk_fn, carry_specs)``: jitted functions
+    operating on mesh-sharded arrays plus the carry's PartitionSpec
+    pytree (the resume-sharding contract - see the note at the return
+    statement).
 
     init_fn(key, Y_sharded) -> ChainCarry (leaves sharded over SHARD_AXIS,
     X replicated).  chunk_fn(key, Y_sharded, carry, sched) ->
@@ -176,7 +179,16 @@ def build_mesh_chain(
         out_specs=(specs, ChainStats(*([rep] * len(ChainStats._fields))),
                    rep)), donate_argnums=(2,),
         compiler_options=compiler_options)
-    return init_fn, chunk_fn
+    # The carry PartitionSpec pytree is part of the public contract: a
+    # RESUMED carry (host numpy from the checkpoint loader) must be
+    # device_put with exactly these shardings BEFORE it is fed to
+    # chunk_fn - the chunk donates its carry, and donating uncommitted
+    # host arrays into the shard_map jit corrupts the heap on the CPU
+    # backend (the tier-1 SIGABRT/SIGSEGV at the mesh checkpoint-resume
+    # tests: the resumed chain then computes on freed memory, crashing
+    # or silently returning garbage).  api.fit's mesh commit_fn consumes
+    # this.
+    return init_fn, chunk_fn, specs
 
 
 def place_sharded(Y_shard_major, mesh: Mesh):

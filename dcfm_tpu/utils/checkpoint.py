@@ -722,8 +722,16 @@ class AsyncCheckpointWriter:
             except BaseException as e:   # surfaced by wait()/poll_error()
                 self._error = e
 
+        # NON-daemon deliberately (dcfm-lint DCFM501): a daemon writer
+        # still inside np.savez / the device fetch at interpreter
+        # teardown aborts the process (the raw SIGABRT that used to kill
+        # tier-1 mid-suite).  Non-daemon threads are joined by
+        # threading._shutdown BEFORE interpreter finalization, so even
+        # an abandoned writer (fit() raised between submit and wait)
+        # finishes its save and exits cleanly; the steady-state join is
+        # still wait()/submit's join, so no new blocking is introduced.
         self._thread = threading.Thread(
-            target=run, name="dcfm-checkpoint-writer", daemon=True)
+            target=run, name="dcfm-checkpoint-writer")
         self._thread.start()
 
     def poll_error(self) -> Optional[BaseException]:

@@ -1,0 +1,36 @@
+#!/usr/bin/env bash
+# CI gate: static analysis + tier-1 tests on CPU.
+#
+#   scripts/ci_check.sh              # lint dcfm_tpu/ then run tier-1
+#   CI_ISOLATED=1 scripts/ci_check.sh   # tier-1 via the crash-isolated
+#                                    # subprocess-per-file lane instead
+#
+# Any lint finding fails the build BEFORE the (much slower) test run;
+# the tier-1 command mirrors ROADMAP.md.  Exit code is non-zero on any
+# lint violation, test failure, or native-level crash.
+
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== dcfm-lint: static analysis over dcfm_tpu/ =="
+python -m dcfm_tpu.analysis dcfm_tpu/ || exit 1
+
+echo "== tier-1 tests (CPU) =="
+if [ "${CI_ISOLATED:-0}" = "1" ]; then
+    # fallback lane: a native abort fails one file, not the whole run.
+    # Same pytest flags as the main lane below, so the two lanes cannot
+    # disagree for flag reasons (e.g. pytest-randomly reordering).
+    JAX_PLATFORMS=cpu python -m dcfm_tpu.analysis.isolate tests/ \
+        -- -q -m 'not slow' --continue-on-collection-errors \
+        -p no:cacheprovider -p no:xdist -p no:randomly
+    exit $?
+fi
+
+rm -f /tmp/_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+    -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
+    -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log \
+    | tr -cd . | wc -c)"
+exit "$rc"

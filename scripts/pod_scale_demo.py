@@ -132,7 +132,7 @@ def run_demo(g=256, n_devices=8, P=196, n=16, K=2, iters=3, seed=0,
     # build_mesh_chain docstring); XLA's 40 s default aborts the process.
     opts = {"xla_cpu_collective_call_warn_stuck_seconds": "600",
             "xla_cpu_collective_call_terminate_timeout_seconds": "3600"}
-    init_fn, chunk_fn = build_mesh_chain(mesh, cfg, prior_triple, num_iters=iters,
+    init_fn, chunk_fn, _ = build_mesh_chain(mesh, cfg, prior_triple, num_iters=iters,
                                          compiler_options=opts)
     Yd = place_sharded(Y, mesh)
     key = jax.random.key(seed)

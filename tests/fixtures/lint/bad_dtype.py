@@ -1,0 +1,31 @@
+"""Known-bad dtype drift: DCFM301/302 must fire."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def f64_literal_dtype(x):
+    # DCFM301: np.float64 passed to a jnp call
+    return jnp.asarray(x, np.float64)
+
+
+def f64_attribute():
+    # DCFM301: jnp.float64 anywhere in library code
+    return jnp.zeros((3,), jnp.float64)
+
+
+def f64_string(x):
+    # DCFM301: string dtype spelling
+    return jnp.asarray(x, dtype="float64")
+
+
+@jax.jit
+def f64_in_traced(x):
+    # DCFM301: float64 inside a traced function
+    acc = jnp.zeros(x.shape, np.float64)
+    return acc + x
+
+
+def weak_float_dtype(x):
+    # DCFM302: builtin float = float64 under x64
+    return jnp.zeros_like(x, dtype=float)

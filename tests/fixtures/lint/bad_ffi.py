@@ -1,0 +1,28 @@
+"""Known-bad FFI safety: DCFM401/402/403 must fire."""
+import ctypes
+
+import numpy as np
+
+_lib = ctypes.CDLL("libfoo.so")
+
+# restype declared but argtypes NOT: implicit int conversion truncates
+# 64-bit pointers/sizes
+_fn = _lib.compute_undeclared
+_fn.restype = None
+
+
+def call_undeclared(n):
+    # DCFM401: argtypes missing for compute_undeclared
+    _lib.compute_undeclared(n)
+
+
+def pointer_from_temporary(x):
+    # DCFM402: the astype() temporary can be collected while the call runs
+    _lib.compute_undeclared(
+        x.astype(np.float32).ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+
+
+def unguarded_pointer(arr, n):
+    # DCFM403: arr may be non-contiguous / wrong dtype - no guard in sight
+    ptr = arr.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+    _lib.compute_undeclared(ptr, n)

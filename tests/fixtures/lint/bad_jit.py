@@ -1,0 +1,53 @@
+"""Known-bad jit hygiene: DCFM201/202/203 must fire."""
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+@jax.jit
+def host_sync_np(x):
+    # DCFM201: numpy call on a tracer
+    return np.asarray(x) + 1
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def host_sync_item(x, n):
+    # DCFM201: .item() materializes on host at trace time
+    return x * x.sum().item() + n
+
+
+@jax.jit
+def host_sync_float(x):
+    y = jnp.sum(x)
+    # DCFM201: float() on a traced value
+    return float(y)
+
+
+@jax.jit
+def python_branch_on_tracer(x):
+    y = jnp.sum(x)
+    # DCFM202: ConcretizationError (or silent constant fold)
+    if y > 0:
+        return x
+    return -x
+
+
+@jax.jit
+def env_read_in_jit(x):
+    # DCFM203: baked in at trace time
+    if os.environ.get("DCFM_FAST"):
+        return x * 2
+    return x
+
+
+def scan_body_host_sync(carry, x):
+    # DCFM201 via lax.scan-carried function
+    return carry + np.asarray(x), None
+
+
+def run(xs):
+    return lax.scan(scan_body_host_sync, 0.0, xs)

@@ -1,0 +1,48 @@
+"""Known-bad RNG discipline: every pattern here must fire DCFM101/102."""
+import jax
+import jax.numpy as jnp
+
+
+def two_samplers_one_key(key):
+    # DCFM101: the classic reuse - both draws see correlated streams
+    a = jax.random.normal(key, (3,))
+    b = jax.random.uniform(key, (3,))
+    return a + b
+
+
+def helper(k, shape):
+    return jax.random.normal(k, shape)
+
+
+def same_helper_twice(key):
+    # DCFM101: the same key escapes into the same helper twice
+    a = helper(key, (2,))
+    b = helper(key, (2,))
+    return a + b
+
+
+def sampler_then_helper(key):
+    # DCFM101: direct draw plus an escape - the helper may consume it too
+    a = jax.random.normal(key, (2,))
+    return a + helper(key, (2,))
+
+
+def split_then_reuse_parent(key):
+    # DCFM101: split consumes the parent; sampling it afterwards reuses it
+    k1, k2 = jax.random.split(key)
+    a = jax.random.normal(k1, (2,))
+    b = jax.random.normal(key, (2,))
+    return a + b + jnp.sum(k2 * 0)
+
+
+def loop_reuse(key, n):
+    # DCFM101: consumed on every iteration without re-derivation
+    out = 0.0
+    for _ in range(n):
+        out = out + jax.random.normal(key, ())
+    return out
+
+
+def inline_const_key():
+    # DCFM102: fixed entropy baked into library code
+    return jax.random.PRNGKey(42)

@@ -1,0 +1,15 @@
+"""Known-bad thread shutdown: DCFM501/502 must fire."""
+import threading
+
+
+def save_in_background(fn):
+    # DCFM501: daemon writer still inside native code at teardown ->
+    # SIGABRT.  DCFM502 also: this module never joins anything.
+    t = threading.Thread(target=fn, daemon=True)
+    t.start()
+    return t
+
+
+def fire_and_forget(fn):
+    # DCFM502: a temporary thread can never be joined
+    threading.Thread(target=fn).start()

@@ -1,0 +1,20 @@
+"""Known-good dtype use: float32 device path, float64 host diagnostics."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def f32_device_path(x):
+    return jnp.zeros(x.shape, jnp.float32) + x.astype(jnp.float32)
+
+
+def host_diagnostics(draws):
+    # np.float64 in HOST numpy code is deliberate (R-hat/ESS accumulate
+    # in double; utils/diagnostics.py) - never flagged
+    x = np.asarray(draws, np.float64)
+    return x.mean(), x.var()
+
+
+def f32_literals(n):
+    return jnp.full((n,), 1.5, dtype=jnp.float32)

@@ -1,0 +1,31 @@
+"""Known-good FFI: full signatures, contiguity guards, named bindings."""
+import ctypes
+
+import numpy as np
+
+_lib = ctypes.CDLL("libfoo.so")
+
+_fn = _lib.compute
+_fn.restype = None
+_fn.argtypes = [ctypes.POINTER(ctypes.c_float), ctypes.c_int64]
+
+
+def _ptr(a, ctype):
+    # pointer wrapper: applies data_as to its own parameter; callers are
+    # checked instead
+    return a.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+def call_declared(x, out):
+    x = np.ascontiguousarray(x, np.float32)
+    if not (out.flags.c_contiguous and out.dtype == np.float32):
+        raise ValueError("out must be C-contiguous float32")
+    _lib.compute(_ptr(x, ctypes.c_float), x.size)
+    _lib.compute(out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                 out.size)
+
+
+def allocated_here(n):
+    buf = np.zeros((n,), np.float32)
+    _lib.compute(_ptr(buf, ctypes.c_float), n)
+    return buf

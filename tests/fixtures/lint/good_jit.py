@@ -1,0 +1,44 @@
+"""Known-good jit hygiene: static branches and host code outside jit."""
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+@jax.jit
+def static_config_branch(x, *, scaled=True):
+    # branching on a static Python kwarg is fine (trace-time constant)
+    if scaled:
+        return x / jnp.maximum(jnp.sum(x), 1.0)
+    return x
+
+
+@jax.jit
+def none_check(x, mask=None):
+    # `is None` structure checks are static by construction
+    if mask is not None:
+        x = jnp.where(mask, x, 0.0)
+    return jnp.sum(x)
+
+
+@jax.jit
+def data_dependent_the_right_way(x):
+    y = jnp.sum(x)
+    return lax.cond(y > 0, lambda v: v, lambda v: -v, x)
+
+
+def body(carry, x):
+    return carry + jnp.tanh(x), None
+
+
+def run(xs):
+    return lax.scan(body, 0.0, xs)
+
+
+def host_post_processing(result):
+    # np.asarray OUTSIDE any traced function: the normal fetch idiom
+    flag = os.environ.get("DCFM_VERBOSE")
+    arr = np.asarray(result)
+    return arr.item() if arr.ndim == 0 and flag else arr

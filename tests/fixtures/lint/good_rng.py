@@ -1,0 +1,56 @@
+"""Known-good RNG discipline: none of these may fire any rule."""
+import jax
+
+
+_SITE_A, _SITE_B = 1, 2
+
+
+def sweep_a(key, x):
+    return x + jax.random.normal(jax.random.fold_in(key, _SITE_A), x.shape)
+
+
+def sweep_b(key, x):
+    return x * jax.random.uniform(jax.random.fold_in(key, _SITE_B), x.shape)
+
+
+def split_discipline(key):
+    k1, k2 = jax.random.split(key)
+    a = jax.random.normal(k1, (2,))
+    b = jax.random.uniform(k2, (2,))
+    return a + b
+
+
+def site_derivation(key, x):
+    # one parent key handed to DISTINCT site-deriving helpers - the
+    # repo's sanctioned architecture (each folds its own _SITE constant)
+    x = sweep_a(key, x)
+    x = sweep_b(key, x)
+    return x
+
+
+def fold_in_derives(key, n):
+    # fold_in with distinct data derives independent streams; using the
+    # parent in a sampler once afterwards is fine
+    ks = jax.vmap(lambda i: jax.random.fold_in(key, i))(
+        jax.numpy.arange(n))
+    return ks
+
+
+def branch_exclusive(key, fast):
+    # the two consumptions are on exclusive paths - no reuse
+    if fast:
+        return jax.random.normal(key, (2,))
+    return jax.random.uniform(key, (2,))
+
+
+def rebind_in_loop(key, n):
+    out = 0.0
+    for _ in range(n):
+        key, sub = jax.random.split(key)
+        out = out + jax.random.normal(sub, ())
+    return out
+
+
+def shape_only_template(init_fn, Y):
+    # jax.eval_shape never consumes entropy: the constant key is exempt
+    return jax.eval_shape(init_fn, jax.random.key(0), Y)

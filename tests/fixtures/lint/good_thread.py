@@ -1,0 +1,17 @@
+"""Known-good thread discipline: non-daemon, joined before teardown."""
+import threading
+
+
+class Writer:
+    def __init__(self):
+        self._thread = None
+
+    def submit(self, fn):
+        self.wait()
+        self._thread = threading.Thread(target=fn, name="writer")
+        self._thread.start()
+
+    def wait(self):
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None

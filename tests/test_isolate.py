@@ -1,0 +1,94 @@
+"""Crash-isolation runner: a native abort fails one file, not the run.
+
+The runner spawns real pytest subprocesses, so these tests use tiny
+self-contained test files in tmp_path (outside the repo's conftest -
+no jax import in the children, keeping this fast)."""
+
+import io
+import os
+import sys
+
+from dcfm_tpu.analysis.isolate import _signal_name, run_isolated
+
+
+def _write(tmp_path, name, body):
+    p = tmp_path / name
+    p.write_text(body)
+    return str(p)
+
+
+def test_signal_names():
+    import signal
+    assert _signal_name(-signal.SIGABRT) == "SIGABRT"
+    assert _signal_name(128 + signal.SIGSEGV) == "SIGSEGV"
+    assert _signal_name(1) == ""
+    assert _signal_name(0) == ""
+
+
+def test_crash_fails_one_file_and_others_still_report(tmp_path):
+    ok = _write(tmp_path, "test_ok.py",
+                "def test_fine():\n    assert 1 + 1 == 2\n")
+    crash = _write(tmp_path, "test_crash.py",
+                   "import os\n"
+                   "def test_native_abort():\n"
+                   "    os.abort()\n")
+    buf = io.StringIO()
+    rc = run_isolated([ok, crash], ["-q", "-p", "no:cacheprovider"],
+                      out=buf)
+    text = buf.getvalue()
+    assert rc == 1
+    assert f"PASS  {ok}" in text
+    assert "CRASH" in text and "SIGABRT" in text
+    assert "ISOLATED SUMMARY: 1 file(s) passed, 0 failed, 1 crashed" in text
+
+
+def test_plain_failure_is_not_a_crash(tmp_path):
+    bad = _write(tmp_path, "test_bad.py",
+                 "def test_wrong():\n    assert False\n")
+    buf = io.StringIO()
+    rc = run_isolated([bad], ["-q", "-p", "no:cacheprovider"], out=buf)
+    assert rc == 1
+    assert "FAIL" in buf.getvalue()
+    assert "crashed" in buf.getvalue()
+    assert "0 failed" not in buf.getvalue()
+
+
+def test_hang_reported_as_timeout_not_signal(tmp_path):
+    hang = _write(tmp_path, "test_hang.py",
+                  "import time\n"
+                  "def test_sleepy():\n"
+                  "    time.sleep(60)\n")
+    buf = io.StringIO()
+    rc = run_isolated([hang], ["-q", "-p", "no:cacheprovider"],
+                      timeout=4, out=buf)
+    text = buf.getvalue()
+    assert rc == 1
+    # a hang is its own class: never dressed up as a delivered signal
+    assert "HANG" in text and "TIMEOUT" in text
+    assert "SIGALRM" not in text
+
+
+def test_all_green_exits_zero(tmp_path):
+    ok = _write(tmp_path, "test_ok.py",
+                "def test_fine():\n    assert True\n")
+    empty = _write(tmp_path, "test_empty.py", "")
+    buf = io.StringIO()
+    rc = run_isolated([ok, empty], ["-q", "-p", "no:cacheprovider"],
+                      out=buf)
+    # exit code 5 (no tests collected) counts as pass: an empty file
+    # under a marker filter is not a failure
+    assert rc == 0
+    assert "2 file(s) passed" in buf.getvalue()
+
+
+def test_cli_entry_help():
+    # `dcfm-tpu test-isolated --help` goes through the early dispatch in
+    # cli.main; exercised via the module entry to avoid console-script
+    # installation assumptions
+    import subprocess
+    proc = subprocess.run(
+        [sys.executable, "-m", "dcfm_tpu.cli", "test-isolated", "--help"],
+        capture_output=True, text=True,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert proc.returncode == 0
+    assert "one pytest subprocess per test file" in proc.stdout

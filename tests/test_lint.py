@@ -1,0 +1,164 @@
+"""dcfm-lint: fixture-driven rule tests + the self-gate on dcfm_tpu/.
+
+Every rule family has a known-bad fixture asserting the exact rule IDs
+that fire (and a known-good twin asserting silence) - the linter is
+itself code that can rot, and a rule that silently stopped firing is a
+rule that no longer protects anything.  No jax import needed: the
+linter is pure ``ast``.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from dcfm_tpu.analysis import RULES, lint_file, lint_paths, lint_source
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures", "lint")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _rules_fired(name):
+    return {f.rule for f in lint_file(os.path.join(FIXTURES, name))}
+
+
+# ---------------------------------------------------------------------------
+# known-bad fixtures: exact rule families fire
+# ---------------------------------------------------------------------------
+
+def test_bad_rng_fires_101_and_102():
+    assert _rules_fired("bad_rng.py") == {"DCFM101", "DCFM102"}
+
+
+def test_bad_rng_all_reuse_shapes_flagged():
+    findings = lint_file(os.path.join(FIXTURES, "bad_rng.py"))
+    lines = {f.line for f in findings if f.rule == "DCFM101"}
+    # one finding inside each of the five reuse functions
+    assert len(lines) >= 5
+
+
+def test_bad_jit_fires_201_202_203():
+    assert _rules_fired("bad_jit.py") == {"DCFM201", "DCFM202", "DCFM203"}
+
+
+def test_bad_dtype_fires_301_302():
+    assert _rules_fired("bad_dtype.py") == {"DCFM301", "DCFM302"}
+
+
+def test_bad_ffi_fires_401_402_403():
+    assert _rules_fired("bad_ffi.py") == {"DCFM401", "DCFM402", "DCFM403"}
+
+
+def test_bad_thread_fires_501_502():
+    assert _rules_fired("bad_thread.py") == {"DCFM501", "DCFM502"}
+
+
+def test_every_rule_family_has_a_firing_fixture():
+    """The registry and the fixtures cannot drift apart: every
+    registered rule fires somewhere in the known-bad fixture set."""
+    fired = set()
+    for name in os.listdir(FIXTURES):
+        if name.startswith("bad_"):
+            fired |= _rules_fired(name)
+    assert fired == set(RULES), (
+        f"rules never fired by any fixture: {set(RULES) - fired}")
+
+
+# ---------------------------------------------------------------------------
+# known-good fixtures: silence on sanctioned idioms
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("name", [
+    "good_rng.py", "good_jit.py", "good_dtype.py", "good_ffi.py",
+    "good_thread.py"])
+def test_good_fixture_is_clean(name):
+    findings = lint_file(os.path.join(FIXTURES, name))
+    assert findings == [], [str(f) for f in findings]
+
+
+def test_inline_suppression_silences_one_line_only():
+    findings = lint_file(os.path.join(FIXTURES, "suppressed.py"))
+    assert {f.rule for f in findings} == {"DCFM501"}
+    assert len([f for f in findings if f.rule == "DCFM501"]) == 1
+
+
+# ---------------------------------------------------------------------------
+# targeted unit checks on lint_source
+# ---------------------------------------------------------------------------
+
+def test_library_only_rules_skip_test_files():
+    src = ("import threading\n"
+           "t = threading.Thread(target=print, daemon=True)\n"
+           "t.join()\n")
+    assert any(f.rule == "DCFM501" for f in lint_source(src, "mod.py"))
+    assert not any(f.rule == "DCFM501"
+                   for f in lint_source(src, "test_mod.py"))
+
+
+def test_split_rebind_resets_lineage():
+    src = ("import jax\n"
+           "def f(key):\n"
+           "    key, sub = jax.random.split(key)\n"
+           "    a = jax.random.normal(sub, (2,))\n"
+           "    b = jax.random.normal(key, (2,))\n"
+           "    return a + b\n")
+    assert lint_source(src, "mod.py") == []
+
+
+def test_alias_resolution_sees_through_import_as():
+    src = ("from jax import random as jr\n"
+           "def f(key):\n"
+           "    a = jr.normal(key, (2,))\n"
+           "    b = jr.normal(key, (2,))\n"
+           "    return a + b\n")
+    assert any(f.rule == "DCFM101" for f in lint_source(src, "mod.py"))
+
+
+def test_stdlib_random_is_not_jax_random():
+    src = ("import random\n"
+           "def f(key):\n"
+           "    random.uniform(0, 1)\n"
+           "    random.uniform(0, 1)\n")
+    assert lint_source(src, "mod.py") == []
+
+
+def test_syntax_error_reports_dcfm000():
+    findings = lint_source("def broken(:\n", "mod.py")
+    assert [f.rule for f in findings] == ["DCFM000"]
+
+
+# ---------------------------------------------------------------------------
+# the self-gate: the shipped tree lints clean, via the real CLI
+# ---------------------------------------------------------------------------
+
+def test_dcfm_tpu_tree_lints_clean():
+    findings = lint_paths([os.path.join(REPO, "dcfm_tpu")])
+    assert findings == [], "\n".join(str(f) for f in findings)
+
+
+def test_cli_lint_exits_zero_on_tree():
+    proc = subprocess.run(
+        [sys.executable, "-m", "dcfm_tpu.analysis",
+         os.path.join(REPO, "dcfm_tpu")],
+        capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "clean" in proc.stdout
+
+
+def test_cli_lint_exits_nonzero_on_bad_fixture():
+    proc = subprocess.run(
+        [sys.executable, "-m", "dcfm_tpu.analysis",
+         os.path.join(FIXTURES, "bad_thread.py")],
+        capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 1
+    assert "DCFM501" in proc.stdout
+
+
+def test_cli_list_rules():
+    proc = subprocess.run(
+        [sys.executable, "-m", "dcfm_tpu.analysis", "--list-rules"],
+        capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 0
+    for rid in RULES:
+        assert rid in proc.stdout

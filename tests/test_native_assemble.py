@@ -107,3 +107,85 @@ def test_native_end_to_end_in_fit():
         run=RunConfig(burnin=15, mcmc=15, thin=1, seed=0)))
     want = res.covariance(destandardize=True, reinsert_zero_cols=True)
     np.testing.assert_allclose(res.Sigma, want, rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# build hygiene: warning-free compile + the DCFM_NATIVE_SANITIZE lane
+# ---------------------------------------------------------------------------
+
+def _gpp():
+    import shutil
+    return shutil.which("g++")
+
+
+@pytest.mark.parametrize("sanitize", [False, True])
+def test_build_is_warning_free_wall_wextra(tmp_path, sanitize):
+    """BOTH builder variants pass -Wall -Wextra; -Werror here pins the
+    kernel warning-free so a warning can never silently rot into one of
+    the memory bugs the sanitizer lane exists to catch (the sanitized
+    -O1 flag set changes inlining and diagnostics vs -O3, so each
+    variant needs its own compile)."""
+    import subprocess
+
+    from dcfm_tpu import native
+
+    if _gpp() is None:
+        pytest.skip("g++ not available")
+    cmd = native._build_cmd(str(tmp_path / "w.so"), sanitize=sanitize)
+    cmd.insert(1, "-Werror")
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stderr
+    assert proc.stderr.strip() == "", proc.stderr
+
+
+def test_sanitize_env_selects_asan_build():
+    """DCFM_NATIVE_SANITIZE=1 must exercise the ASan+UBSan debug build
+    end to end in a subprocess (the ASan runtime has to be first in the
+    library order, so the sanitized object cannot load in THIS process).
+    Skips cleanly when g++ or libasan is unavailable."""
+    import os
+    import subprocess
+    import sys
+
+    if _gpp() is None:
+        pytest.skip("g++ not available")
+    libasan = subprocess.run(
+        ["gcc", "-print-file-name=libasan.so"],
+        capture_output=True, text=True).stdout.strip()
+    if not libasan or not os.path.exists(libasan):
+        pytest.skip("libasan not available")
+
+    code = """
+import sys
+import numpy as np
+from dcfm_tpu import native
+
+assert native.sanitize_requested()
+if not native.available():
+    print("NATIVE_UNAVAILABLE"); sys.exit(3)
+assert native._load()._name.endswith("_assemble_san.so")
+# g=2, P=1: panels [[a]], [[b]], [[c]] assemble to [[a, b], [b, c]]
+upper = np.asarray([[[2.0]], [[3.0]], [[5.0]]], np.float32)
+scale = np.ones(2, np.float32)
+out_map = np.arange(2, dtype=np.int64)
+out = native.assemble_covariance(upper, scale, out_map, 2)
+np.testing.assert_allclose(out, [[2.0, 3.0], [3.0, 5.0]])
+print("SAN_OK")
+"""
+    env = dict(os.environ,
+               DCFM_NATIVE_SANITIZE="1",
+               LD_PRELOAD=libasan,
+               ASAN_OPTIONS="detect_leaks=0")
+    proc = subprocess.run([sys.executable, "-c", code],
+                          capture_output=True, text=True, env=env,
+                          cwd=os.path.dirname(os.path.dirname(
+                              os.path.abspath(__file__))))
+    if "NATIVE_UNAVAILABLE" in proc.stdout or \
+            "ASan runtime does not come first" in proc.stderr:
+        pytest.skip("sanitized build not loadable in this environment")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "SAN_OK" in proc.stdout
+    # UBSan reports are non-fatal by default - a silent pass with a
+    # "runtime error:" line would hide real UB
+    assert "runtime error:" not in proc.stderr, proc.stderr
+    assert "AddressSanitizer" not in proc.stderr, proc.stderr
