@@ -22,12 +22,16 @@ import time
 import numpy as np
 
 # Benchmark shape: north-star config 3 (p=10k, 64 shards).  Overridable for
-# quick local runs: BENCH_P, BENCH_G, BENCH_N, BENCH_ITERS.
+# quick local runs: BENCH_P, BENCH_G, BENCH_N, BENCH_ITERS.  BENCH_CHAINS
+# runs >1 independent chains (an extra vmap axis; VERDICT r5 notes the
+# headline has only ever been single-chain iters/s) - the default gates
+# stay single-chain.
 P_TOTAL = int(os.environ.get("BENCH_P", 10_000))
 G = int(os.environ.get("BENCH_G", 64))
 N = int(os.environ.get("BENCH_N", 500))
 K_TOTAL = int(os.environ.get("BENCH_K", 512))     # 8 factors/shard
 ITERS = int(os.environ.get("BENCH_ITERS", 1000))
+CHAINS = int(os.environ.get("BENCH_CHAINS", 1))
 BASELINE_SECONDS = 60.0
 
 
@@ -70,7 +74,7 @@ def main():
                           combine_dtype=os.environ.get(
                               "BENCH_COMBINE", "bfloat16")),
         run=RunConfig(burnin=burnin, mcmc=mcmc, thin=thin, seed=0,
-                      chunk_size=chunk),
+                      chunk_size=chunk, num_chains=CHAINS),
         # quant8 fetch: this box reaches the TPU over a tunnel measured at
         # 2-4 MB/s (it fluctuates run to run), so the upper-panel fetch
         # dominates wall-clock; int8 panels with per-panel float32 scales
@@ -114,6 +118,35 @@ def main():
     err = float(np.linalg.norm(res.Sigma - Sigma_true)
                 / np.linalg.norm(Sigma_true))
     iters_per_sec = ITERS / seconds
+
+    # chain_s regression gate, MEDIAN-of-3 (ADVICE r5: best-of-3 hides
+    # bimodal regressions - a change that is slow half the time always
+    # has one fast run).  All three samples are ALWAYS taken at the gated
+    # shape - repeating only on a slow first sample would reintroduce the
+    # one-lucky-run escape the median exists to close - the gate judges
+    # the median, and every sample lands in the JSON artifact so a
+    # bimodal pattern is visible in the record.  (The chip behind the
+    # tunnel is intermittently TIMESHARED, inflating chain_s several-fold
+    # on identical binaries - README "Performance" - which is what the
+    # median absorbs from the other side.)
+    default_shape = (P_TOTAL, G, N, K_TOTAL, ITERS, CHAINS) == (
+        10_000, 64, 500, 512, 1000, 1)
+    chain_budget_s = 2.5
+    chain_samples = [res.phase_seconds["chain_s"]]
+    if default_shape:
+        for _ in range(2):
+            chain_samples.append(fit(Y, cfg).phase_seconds["chain_s"])
+    chain_s_med = float(np.median(chain_samples))
+
+    # ESS/s on the chain traces (utils/diagnostics.ess via
+    # FitResult.diagnostics): iterations/sec says nothing about MIXING -
+    # a sampler change can keep iters/s and halve the information per
+    # draw.  Denominator is the timed run's tunnel-independent chain_s.
+    ess_vals = (res.diagnostics or {}).get("ess", {})
+    chain_s_run = max(res.phase_seconds["chain_s"], 1e-9)
+    ess_per_sec = {k: round(float(v) / chain_s_run, 2)
+                   for k, v in ess_vals.items() if np.isfinite(v)}
+
     result = {
         "metric": f"Gibbs iters/sec/chip (p={P_TOTAL}, g={G}, n={N}, "
                   f"k={K_TOTAL}, {ITERS} iters)",
@@ -137,6 +170,14 @@ def main():
         # regressions should be judged on chain_s (gated below) and
         # assemble_s; fetch_s/upload_s swings track tunnel_MBps.
         "chain_s": round(res.phase_seconds["chain_s"], 2),
+        # every gate sample (timed run first; repeats only taken when the
+        # first sample tripped the budget) - bimodal regressions show up
+        # here even when the median squeaks under
+        "chain_s_samples": [round(s, 2) for s in chain_samples],
+        "num_chains": CHAINS,
+        # effective samples per second of chain compute, per trace summary
+        # (models/sampler.TRACE_SUMMARIES) - the mixing-aware throughput
+        "ess_per_sec": ess_per_sec,
         "upload_s": round(res.phase_seconds["upload_s"], 2),
         "fetch_s": round(res.phase_seconds["fetch_s"], 2),
         "assemble_s": round(res.phase_seconds["assemble_s"], 2),
@@ -155,36 +196,24 @@ def main():
     #   ride the tunnel; measured 0.86-1.45 s across rounds 3-5 (~0.95 s
     #   at round 5's bias-free bf16_3x sweep), so 2.5 s means the sweep
     #   or the accumulation genuinely regressed - OR the tunneled chip is
-    #   being timeshared (observed inflating chain_s several-fold on
-    #   identical binaries), which is why the gate retries below before
-    #   failing.
-    # The tight bounds only hold at the default north-star shape; an env-
-    # overridden quick run (e.g. BENCH_ITERS=100 sanity checks) keeps the
-    # loose accuracy guard and skips the chain_s budget.
-    default_shape = (P_TOTAL, G, N, K_TOTAL, ITERS) == (
-        10_000, 64, 500, 512, 1000)
+    #   timeshared, which is what the MEDIAN-of-3 above absorbs (a real
+    #   regression fails most runs; one contended run no longer decides,
+    #   and one lucky run no longer excuses).
+    # The tight bounds only hold at the default north-star shape and a
+    # single chain; an env-overridden quick run (e.g. BENCH_ITERS=100 or
+    # BENCH_CHAINS=4) keeps the loose accuracy guard and skips the
+    # chain_s budget.
     err_bound = 0.18 if default_shape else 0.3
     status = 0
     if not np.isfinite(err) or err > err_bound:
         print(f"ACCURACY REGRESSION: rel frob err {err:.3f} > {err_bound}",
               file=sys.stderr)
         status = 1
-    chain_s = res.phase_seconds["chain_s"]
-    if default_shape and chain_s > 2.5:
-        # The chip behind the tunnel is intermittently TIMESHARED, and a
-        # contended run inflates chain_s several-fold on identical
-        # binaries (README "Performance") - automate the judge-on-repeat
-        # rule: a real code regression fails every run, contention
-        # usually clears.  Gate on the best of up to 3 timed runs.
-        for _ in range(2):
-            r2 = fit(Y, cfg)
-            chain_s = min(chain_s, r2.phase_seconds["chain_s"])
-            if chain_s <= 2.5:
-                break
-    if default_shape and chain_s > 2.5:
-        print(f"CHAIN REGRESSION: chain_s {chain_s:.2f}"
-              " > 2.5 s at the bench shape (tunnel-independent budget, "
-              "best of 3 runs)",
+    if default_shape and chain_s_med > chain_budget_s:
+        print(f"CHAIN REGRESSION: median chain_s {chain_s_med:.2f}"
+              f" > {chain_budget_s} s at the bench shape "
+              f"(tunnel-independent budget, samples "
+              f"{[round(s, 2) for s in chain_samples]})",
               file=sys.stderr)
         status = 1
     return status

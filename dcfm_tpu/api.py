@@ -37,6 +37,7 @@ from dcfm_tpu.models.priors import make_prior
 from dcfm_tpu.models.sampler import (
     TRACE_SUMMARIES, ChainStats, chain_keys, effective_ranks, init_chain,
     num_saved_draws, run_chunk, schedule_array)
+from dcfm_tpu.models.state import num_upper_pairs, packed_pair_indices
 from dcfm_tpu.utils.diagnostics import ess, split_rhat
 from dcfm_tpu.parallel.mesh import make_mesh, shards_per_device
 from dcfm_tpu.parallel.multihost import place_sharded_global
@@ -48,7 +49,7 @@ from dcfm_tpu.utils.checkpoint import (
     save_checkpoint, save_checkpoint_multiprocess)
 from dcfm_tpu.utils.estimate import (
     assemble_from_q8, assemble_from_upper, dequantize_panels,
-    draw_covariance_entries, extract_upper_blocks, full_blocks_from_upper)
+    draw_covariance_entries, full_blocks_from_upper)
 from dcfm_tpu.utils.preprocess import (
     PreprocessResult, caller_to_shard_index, preprocess,
     restore_data_matrix)
@@ -72,7 +73,12 @@ class FitResult:
     # fetch, which on a tunneled device fluctuates with link weather.
     chain_iters_per_sec: float = 0.0
     # (num_chains, executed_iters, len(TRACE_SUMMARIES)) per-iteration scalar
-    # chain summaries (models/sampler.TRACE_SUMMARIES order).
+    # chain summaries (models/sampler.TRACE_SUMMARIES order).  Each row is
+    # computed on the SWEEP's output state; on the rare burn-in iterations
+    # where adaptive rank truncation fires (ModelConfig.rank_adapt), the
+    # carried state may additionally have columns re-masked, so the trace
+    # reflects the pre-adaptation sweep state there (the health panel
+    # watches the carried one).
     traces: Optional[np.ndarray] = None
     # {"rhat": {summary: float}, "ess": {summary: float}} on the post-burnin
     # draws; rhat requires num_chains > 1 (utils/diagnostics.py).
@@ -228,7 +234,7 @@ class FitResult:
 
 @functools.lru_cache(maxsize=32)
 def _local_fns(model: ModelConfig, num_iters: int, num_chains: int = 1,
-               num_stored_draws: int = 0):
+               num_stored_draws: int = 0, unroll: int = 1):
     """Jitted single-device init/chunk functions, cached on the frozen model
     config and scan length so repeated fit() calls (warm-up, chunked
     schedules, notebooks) reuse compilations instead of re-tracing per call.
@@ -242,12 +248,18 @@ def _local_fns(model: ModelConfig, num_iters: int, num_chains: int = 1,
     (the same derivation as parallel/shard.py, so the two layouts stay
     chain-for-chain identical)."""
     prior = make_prior(model)
+    # packed upper-panel index map, built once; single device carries the
+    # full padded set (its pair slice is the whole map)
+    rows, cols = packed_pair_indices(model.num_shards)
     init_one = functools.partial(
         init_chain, cfg=model, prior=prior,
         num_global_shards=model.num_shards,
-        num_stored_draws=num_stored_draws)
+        num_stored_draws=num_stored_draws,
+        num_local_pairs=rows.size)
     chunk_one = functools.partial(
-        run_chunk, cfg=model, prior=prior, num_iters=num_iters)
+        run_chunk, cfg=model, prior=prior, num_iters=num_iters,
+        num_global_shards=model.num_shards,
+        pair_rows=rows, pair_cols=cols, unroll=unroll)
     # donate the carry: the accumulator is the biggest buffer on the device
     # (p^2/g bytes single-device); donation lets XLA update it in place
     # instead of holding old + new across every chunk call.
@@ -267,11 +279,12 @@ def _local_fns(model: ModelConfig, num_iters: int, num_chains: int = 1,
 
 @functools.lru_cache(maxsize=32)
 def _mesh_fns(mesh, model: ModelConfig, num_iters: int, num_chains: int = 1,
-              num_stored_draws: int = 0):
+              num_stored_draws: int = 0, unroll: int = 1):
     prior = make_prior(model)
     return build_mesh_chain(mesh, model, prior, num_iters=num_iters,
                             num_chains=num_chains,
-                            num_stored_draws=num_stored_draws)
+                            num_stored_draws=num_stored_draws,
+                            unroll=unroll)
 
 
 def _cast_for_link(u, mode: str):
@@ -292,12 +305,16 @@ def _cast_for_link(u, mode: str):
 
 @functools.lru_cache(maxsize=64)
 def _fetch_jit(g: int, num_chains: int, mode: str, mesh=None):
-    """Jitted device-side fetch prep: chain-average, upper-triangle panel
-    extraction, and the down-cast/quantization for the link.  Cached on
-    (g, chains, mode, mesh) so repeated fit() calls reuse the compilation
-    (a fresh ``jax.jit(lambda ...)`` per call would re-trace every time);
-    single- and multi-process fits therefore compile separately, and the
-    cached entry keeps its Mesh alive.
+    """Jitted device-side fetch prep: chain-average, padding trim, and the
+    down-cast/quantization for the link.  The carry already stores the
+    packed upper-triangle panels in canonical triu order
+    (models.state.packed_pair_indices), so the fetch reads them NATIVELY -
+    no on-device re-packing materialization; only the few padding panels
+    past g(g+1)/2 are sliced off.  Cached on (g, chains, mode, mesh) so
+    repeated fit() calls reuse the compilation (a fresh
+    ``jax.jit(lambda ...)`` per call would re-trace every time); single-
+    and multi-process fits therefore compile separately, and the cached
+    entry keeps its Mesh alive.
 
     ``mesh`` (multi-process runs only): replicate the output over the mesh
     so every process can materialize it on host - XLA inserts the
@@ -306,9 +323,11 @@ def _fetch_jit(g: int, num_chains: int, mode: str, mesh=None):
     ``inv_count`` (traced): 1/saved-draw-count - the accumulators are raw
     sums over saved draws (models.sampler.ChainCarry), so the posterior
     mean is formed here, on device, before any down-cast/quantization."""
+    n_pairs = num_upper_pairs(g)
+
     def prep(acc, inv_count):
-        u = extract_upper_blocks(
-            acc.mean(axis=0) if num_chains > 1 else acc, g=g) * inv_count
+        u = (acc.mean(axis=0) if num_chains > 1 else acc)
+        u = u[:n_pairs] * inv_count
         return _cast_for_link(u, mode)
     if mesh is None:
         return jax.jit(prep)
@@ -328,13 +347,15 @@ def _fetch_sd_jit(g: int, num_chains: int, mode: str, mesh=None):
     the same quant8/f16 link optimizations as the mean (the old design
     forced a full-f32 fetch of both moment panels instead, 4x the
     bytes)."""
+    n_pairs = num_upper_pairs(g)
+
     def prep(acc, acc_sq, inv_count, bessel):
         if num_chains > 1:
             acc, acc_sq = acc.mean(axis=0), acc_sq.mean(axis=0)
-        # upper panels first: the grid is exactly symmetric, so the
-        # variance/sqrt math runs on g(g+1)/2 panels instead of g^2
-        mean = extract_upper_blocks(acc, g=g) * inv_count
-        m2 = extract_upper_blocks(acc_sq, g=g) * inv_count
+        # the carry is already packed upper panels; trim the padding and
+        # run the variance/sqrt math on g(g+1)/2 panels
+        mean = acc[:n_pairs] * inv_count
+        m2 = acc_sq[:n_pairs] * inv_count
         sd = jnp.sqrt(jnp.maximum(m2 - mean * mean, 0.0) * bessel)
         return _cast_for_link(sd, mode)
     if mesh is None:
@@ -553,6 +574,15 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
         # between fit() calls re-traces instead of reusing a stale lowering.
         m = dataclasses.replace(
             m, lambda_kernel=m.lambda_kernel + "-interpret")
+
+    # Scan-dispatch fusion factor (RunConfig.sweep_unroll; 0 = auto).
+    # Auto resolves per RESOLVED platform: 8 on TPU (where the per-
+    # iteration dispatch envelope dominates the sweep - VERDICT r5), 1
+    # elsewhere (the CPU lane is compile-bound and gains nothing).
+    # Results are identical across unroll values by construction; the
+    # factor is a compile-time static, so it keys the jit caches.
+    unroll = run.sweep_unroll or (
+        8 if devices[0].platform == "tpu" else 1)
 
     # Chunk schedule: full chunks + one remainder chunk (exactly total_iters;
     # per-iteration RNG keys are derived from the *global* iteration index in
@@ -851,14 +881,20 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
                 # all processes fall back to the already-loaded light
                 # carry.  The sidecar load transiently holds both carries
                 # (same 2x-accumulator class as the snapshot transient).
+                # The signature includes acc_start (4th element): two
+                # hosts could agree on iteration/kind/count yet hold
+                # sidecars whose accumulation windows started at
+                # different iterations (e.g. mixed stale files after
+                # repeated light resumes) - committing those would
+                # silently divide by inconsistent n_saved divisors.
                 elig = _sidecar_eligibility(max(window, 0))
                 if elig is None:
-                    e_sig = np.asarray([-1, -1, -1], np.int64)
+                    e_sig = np.asarray([-1, -1, -1, -1], np.int64)
                 else:
                     e_sig = np.asarray(
                         [elig[1], 0 if elig[0][0] == "plain" else 1,
                          (-1 if elig[0][0] == "plain"
-                          else elig[0][1][0])], np.int64)
+                          else elig[0][1][0]), elig[2]], np.int64)
                 all_e = multihost_utils.process_allgather(e_sig)
                 if (e_sig[0] >= 0
                         and bool(np.all(all_e == e_sig[None, :]))):
@@ -1105,7 +1141,7 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
                 # jitted jnp.copy allocates fresh device-owned
                 # buffers).
                 from jax.sharding import NamedSharding, PartitionSpec
-                specs = _mesh_fns(mesh, m, chunk, C, S_draws)[2]
+                specs = _mesh_fns(mesh, m, chunk, C, S_draws, unroll)[2]
                 spec_leaves = jax.tree.leaves(
                     specs, is_leaf=lambda x: isinstance(x, PartitionSpec))
                 _, treedef = jax.tree.flatten(c)
@@ -1116,9 +1152,9 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
 
             (carry, stats, executed, traces, chunk_secs, done, acc_start,
              ck_error) = _run_chain(
-                _mesh_fns(mesh, m, chunk, C, S_draws)[0],
-                lambda ni: _mesh_fns(mesh, m, ni, C, S_draws)[1], Yd,
-                commit_fn=None if multiproc else _commit_mesh)
+                _mesh_fns(mesh, m, chunk, C, S_draws, unroll)[0],
+                lambda ni: _mesh_fns(mesh, m, ni, C, S_draws, unroll)[1],
+                Yd, commit_fn=None if multiproc else _commit_mesh)
         else:
             with jax.default_device(devices[0]):
                 t_up = time.perf_counter()
@@ -1135,11 +1171,11 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
                 # jit with the committed Yd) would present a different
                 # sharding signature and trigger a full recompile of the
                 # chunk function (~7s at the p=10k bench shape).
-                init_fn = _local_fns(m, chunk, C, S_draws)[0]
+                init_fn = _local_fns(m, chunk, C, S_draws, unroll)[0]
                 (carry, stats, executed, traces, chunk_secs, done, acc_start,
                  ck_error) = _run_chain(
                     lambda k, Y: jax.device_put(init_fn(k, Y), devices[0]),
-                    lambda ni: _local_fns(m, ni, C, S_draws)[1], Yd,
+                    lambda ni: _local_fns(m, ni, C, S_draws, unroll)[1], Yd,
                     # jit copy FIRST (fresh XLA-owned buffers - a raw
                     # device_put of the loader's numpy can zero-copy
                     # alias memory that dies at the commit rebind; see
@@ -1180,11 +1216,11 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
         trace_arr = np.zeros((C, 0, len(TRACE_SUMMARIES)))
     diagnostics = _diagnose(trace_arr, done, run)
 
-    # Fetch results: the block accumulator dominates device->host traffic
-    # (p^2/g^2 bytes per block pair); its grid is exactly symmetric, so only
-    # the upper-triangle panels cross the link (see extract_upper_blocks),
-    # optionally down-cast or int8-quantized (backend.fetch_dtype) on a slow
-    # link.  Chains are averaged on device first (each chain is an
+    # Fetch results: the packed panel accumulator dominates device->host
+    # traffic (p^2/g^2 bytes per block pair); the carry already stores
+    # exactly the upper-triangle panels, so the fetch trims the padding
+    # and sends them as-is, optionally down-cast or int8-quantized
+    # (backend.fetch_dtype) on a slow link.  Chains are averaged on device first (each chain is an
     # equal-weight posterior-mean estimate, so the mixture mean is the
     # pooled estimate).  posterior_sd uses the same link optimizations:
     # the E[X^2] - E[X]^2 difference (which reduced precision would cancel

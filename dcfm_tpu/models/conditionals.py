@@ -283,6 +283,97 @@ def _gibbs_sweep(key, Y, state, cfg, prior, *, shard_offset, reduce_fn):
                         active=state.active), sse
 
 
+def covariance_panels(
+    Lam_all: jax.Array,
+    ps_all: jax.Array,
+    rho: float,
+    pair_rows: jax.Array,
+    pair_cols: jax.Array,
+    *,
+    eta_all: Optional[jax.Array] = None,
+    compute_dtype=None,
+) -> jax.Array:
+    """Per-draw PACKED upper-triangle covariance panels - the combine step
+    the chain actually accumulates (models/sampler.run_chunk).
+
+    The block grid is exactly symmetric under both estimators
+    (block_cr = block_rc'), so only the g(g+1)/2 upper-triangle panels
+    carry information; computing and storing exactly those halves both the
+    combine FLOPs and the accumulator HBM relative to the dense
+    (Gl, G, P, P) row-panel layout (:func:`covariance_blocks`, kept as the
+    dense reference oracle).  Per-entry arithmetic is identical to the
+    dense path - same contraction order, same precision scopes - so the
+    packed panels match the dense blocks bitwise at their (row, col)
+    pairs (pinned by tests/test_packed_acc.py).
+
+    Args:
+      Lam_all: (G, P, K) ALL shards' loadings (identity locally; the mesh
+        layout all_gathers - any device can then compute any pair).
+      ps_all: (G, P) all shards' residual precisions (for the diagonal
+        pairs' residual-variance add; a (G, P) gather is negligible next
+        to the O(p^2 K) block products).
+      rho: cross-shard factor correlation (plain rule only).
+      pair_rows / pair_cols: (Q,) global shard indices of the packed pairs
+        THIS call computes - the full map from
+        models.state.packed_pair_indices on one device, the local
+        contiguous slice of it under shard_map.
+      eta_all: (G, n, K) all shards' factor draws for the scaled
+        estimator, or None for the plain reference rule.
+      compute_dtype: input dtype for the block matmuls (None = float32 at
+        HIGHEST precision; jnp.bfloat16 feeds the MXU at native rate).
+        Accumulation and output stay in the state dtype.
+
+    Returns: (Q, P, P) packed Sigma panels, panel q = block
+    (pair_rows[q], pair_cols[q]).
+    """
+    G, P, K = Lam_all.shape
+    out_dtype = Lam_all.dtype
+    pair_rows = jnp.asarray(pair_rows)
+    pair_cols = jnp.asarray(pair_cols)
+    diag = (pair_rows == pair_cols).astype(out_dtype)           # (Q,)
+    Lam_r = jnp.take(Lam_all, pair_rows, axis=0)                # (Q, P, K)
+    Lam_c = jnp.take(Lam_all, pair_cols, axis=0)
+    if compute_dtype is not None:
+        Lam_r_c = Lam_r.astype(compute_dtype)
+        Lam_c_c = Lam_c.astype(compute_dtype)
+    else:
+        Lam_r_c, Lam_c_c = Lam_r, Lam_c
+    # precision semantics mirror covariance_blocks: explicit HIGHEST when
+    # "full precision" was requested (the TPU MXU default is bf16-class),
+    # default (fastest) when a reduced compute_dtype was chosen
+    prec = jax.lax.Precision.HIGHEST if compute_dtype is None else None
+    ein = functools.partial(jnp.einsum, preferred_element_type=out_dtype,
+                            precision=prec)
+    if eta_all is not None:
+        n = eta_all.shape[1]
+        # The K x K cross-moments are cheap (G^2 K^2 floats - ~1 MB at the
+        # north-star shape) - form the FULL grid with the same einsum the
+        # dense oracle uses and gather the pairs from it, which keeps the
+        # packed panels bitwise equal to the dense blocks; full precision
+        # always (explicitly: TPU default precision is not full).
+        H_grid = jnp.einsum("rnk,cnj->rckj", eta_all, eta_all,
+                            precision=jax.lax.Precision.HIGHEST) / n
+        H = H_grid[pair_rows, pair_cols]                         # (Q, K, K)
+        LH = ein("qpk,qkj->qpj", Lam_r_c,
+                 H.astype(compute_dtype or out_dtype))           # (Q, P, K)
+        blocks = ein("qpj,qlj->qpl",
+                     LH.astype(compute_dtype or out_dtype), Lam_c_c)
+    else:
+        # reference rule: rho off the diagonal, exactly 1 on it (where, not
+        # rho + (1-rho)*diag: that sum is not exactly 1.0 in float32)
+        blocks = ein("qpk,qlk->qpl", Lam_r_c, Lam_c_c)
+        scale = jnp.where(pair_rows == pair_cols,
+                          jnp.asarray(1.0, out_dtype),
+                          jnp.asarray(rho, out_dtype))
+        blocks = blocks * scale[:, None, None]
+    # residual variances on the diagonal pairs
+    eye_P = jnp.eye(P, dtype=out_dtype)
+    inv_ps_r = 1.0 / jnp.take(ps_all, pair_rows, axis=0)         # (Q, P)
+    blocks = blocks + (diag[:, None, None]
+                       * inv_ps_r[:, :, None] * eye_P)
+    return blocks
+
+
 def covariance_blocks(
     Lam_local: jax.Array,
     ps_local: jax.Array,
@@ -295,7 +386,9 @@ def covariance_blocks(
     compute_dtype=None,
     col_offset: int = 0,
 ) -> jax.Array:
-    """Per-draw covariance blocks for the combine step ("conquer").
+    """DENSE per-draw covariance row-panels - the reference oracle for the
+    packed combine (:func:`covariance_panels`), no longer on the chain's
+    hot path (tests pin the packed panels to these blocks bitwise).
 
     Reference semantics (``divideconquer.m:180-196``): diagonal block
     Lambda_m Lambda_m' + Omega_m, off-diagonal rho * Lambda_r Lambda_c'.

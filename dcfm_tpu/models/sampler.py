@@ -10,9 +10,12 @@ runs:
 * mesh: inside ``shard_map``, reduce = local sum + psum, gather =
   all_gather over the shard mesh axis.
 
-Accumulation happens on device in (Gl, G, P, P) row-panels - p^2/n_devices
-per device - and is stitched to the full p x p only on host
-(utils/estimate.py), which is what makes p = 50k feasible (SURVEY.md
+Accumulation happens on device in PACKED upper-triangle block panels,
+(Q, P, P) with Q the local slice of g(g+1)/2 pairs (padded to a multiple
+of g; models/state.packed_pair_indices) - ~p^2/(2 n_devices) per device,
+half the dense row-panel layout's HBM and combine FLOPs, since the block
+grid is exactly symmetric.  Panels are stitched to the full p x p only on
+host (utils/estimate.py), which is what makes p = 50k feasible (SURVEY.md
 section 7 "the combine at p=10k-50k").
 """
 
@@ -24,12 +27,30 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+# The chunked combine's rendezvous barriers (lax.optimization_barrier, see
+# the accumulate body) must compose with the num_chains vmap axis, but this
+# jax version ships no batching rule for the primitive and vmap dies with
+# NotImplementedError.  The op is an identity per operand, so the rule is
+# trivial: bind as-is, batch dims pass through unchanged.  Registered only
+# when jax doesn't already provide one (newer versions do).
+try:  # pragma: no cover - exercised only on jax versions missing the rule
+    from jax._src.lax.lax import optimization_barrier_p as _opt_barrier_p
+    from jax.interpreters import batching as _batching
+
+    if _opt_barrier_p not in _batching.primitive_batchers:
+        def _opt_barrier_batcher(args, dims):
+            return _opt_barrier_p.bind(*args), dims
+        _batching.primitive_batchers[_opt_barrier_p] = _opt_barrier_batcher
+except Exception:  # future jax moved the private primitive: rule ships there
+    pass
+
 from dcfm_tpu.config import ModelConfig, RunConfig
 from dcfm_tpu.models.adapt import adapt_rank
 from dcfm_tpu.models.conditionals import (
-    covariance_blocks, gibbs_sweep, impute_missing_y, local_sum)
+    covariance_panels, gibbs_sweep, impute_missing_y, local_sum)
 from dcfm_tpu.models.priors import Prior
-from dcfm_tpu.models.state import SamplerState, init_state
+from dcfm_tpu.models.state import (
+    SamplerState, init_state, num_padded_pairs, packed_pair_indices)
 
 
 class DrawBuffers(NamedTuple):
@@ -51,26 +72,35 @@ class DrawBuffers(NamedTuple):
     ps: jax.Array            # (S, Gl, P)
     X: jax.Array             # (S, n, K) - replicated, like state.X
     # (S, Gl, G, K, K) per-draw factor cross-moment row-panels (sharded
-    # like sigma_acc), or None when estimator="plain" (the plain rule
-    # needs no factor moments).
+    # over the local-shard axis), or None when estimator="plain" (the
+    # plain rule needs no factor moments).
     H: Optional[jax.Array] = None
 
 
 class ChainCarry(NamedTuple):
     state: SamplerState
-    sigma_acc: jax.Array      # (Gl, G, P, P) running SUM of Sigma row-panels
-                              # over saved draws; divide by num_saved_draws()
-                              # at fetch.  Raw sums (not 1/num_saved-weighted
-                              # means) so a resumed run may extend the chain:
-                              # the weight is applied once, at the end, with
-                              # the actual saved count.
+    sigma_acc: jax.Array      # (Q, P, P) PACKED running SUM of the
+                              # upper-triangle Sigma block panels over saved
+                              # draws, in models.state.packed_pair_indices
+                              # order (Q = the local slice of
+                              # num_padded_pairs(g): the full padded set on
+                              # one device, a contiguous 1/n_devices slice
+                              # under shard_map).  The grid is exactly
+                              # symmetric, so the lower triangle is never
+                              # stored - half the HBM and write bandwidth of
+                              # the old dense (Gl, G, P, P) row-panels.
+                              # Divide by num_saved_draws() at fetch.  Raw
+                              # sums (not 1/num_saved-weighted means) so a
+                              # resumed run may extend the chain: the weight
+                              # is applied once, at the end, with the actual
+                              # saved count.
     iteration: jax.Array      # scalar int32 - global Gibbs iteration count
     health: jax.Array         # (Gl, 4) running [max |log shrink-scale|,
                               # min ps, max ps, #iterations with non-finite
                               # state] over every iteration seen
-    # (Gl, G, P, P) running SUM of Sigma**2 (elementwise second moment) for
-    # posterior-SD estimation, or None when ModelConfig.posterior_sd is off
-    # (None keeps the default pytree structure unchanged).
+    # (Q, P, P) packed running SUM of Sigma**2 (elementwise second moment)
+    # for posterior-SD estimation, or None when ModelConfig.posterior_sd is
+    # off (None keeps the default pytree structure unchanged).
     sigma_sq_acc: Optional[jax.Array] = None
     # Thinned draw ring (see DrawBuffers), or None when store_draws is off.
     draws: Optional[DrawBuffers] = None
@@ -232,19 +262,26 @@ def init_chain(
     shard_offset=0,
     dtype=jnp.float32,
     num_stored_draws: int = 0,
+    num_local_pairs: Optional[int] = None,
 ) -> ChainCarry:
     """``num_stored_draws``: static size of the thinned-draw buffers
     (RunConfig.num_saved when store_draws is on; 0 = no storage).  Static
     because buffer shapes must be known at trace time - enabling draw
     storage therefore compiles per schedule, unlike the schedule-agnostic
-    default path."""
+    default path.
+
+    ``num_local_pairs``: length of THIS device's slice of the packed
+    upper-panel axis (num_padded_pairs(g) // n_devices under shard_map;
+    default = the full padded set, the single-device layout)."""
     Gl, n, P = Y.shape
     K = cfg.factors_per_shard
     state = init_state(
         key, prior, num_local_shards=Gl, n=n, P=P, K=K,
         as_=cfg.as_, bs=cfg.bs, shard_offset=shard_offset,
         rank_adapt=cfg.rank_adapt, dtype=dtype)
-    sigma_acc = jnp.zeros((Gl, num_global_shards, P, P), dtype)
+    if num_local_pairs is None:
+        num_local_pairs = num_padded_pairs(num_global_shards)
+    sigma_acc = jnp.zeros((num_local_pairs, P, P), dtype)
     draws = None
     if num_stored_draws:
         draws = DrawBuffers(
@@ -272,20 +309,37 @@ def run_chunk(
     prior: Prior,
     *,
     num_iters: int,
+    num_global_shards: Optional[int] = None,
+    pair_rows=None,
+    pair_cols=None,
     shard_offset=0,
     reduce_fn: Callable = local_sum,
     gather_fn: Callable = lambda x: x,
+    unroll: int = 1,
 ) -> tuple[ChainCarry, ChainStats, jax.Array]:
     """Run ``num_iters`` Gibbs iterations from ``carry`` under one scan.
 
     ``sched`` packs the chain schedule as traced values
     (see :func:`schedule_array`) so one compilation serves any
-    burnin/thin combination - only ``num_iters`` (the scan length) and the
-    model config are compile-time static.
+    burnin/thin combination - only ``num_iters`` (the scan length), the
+    model config, and ``unroll`` are compile-time static.
 
-    Accumulates raw SUMS of Sigma row-panels on every thin-th post-burn-in
-    draw; the caller divides by :func:`num_saved_draws` at fetch (the
-    reference folds the 1/effsamp weight into the accumulation,
+    ``pair_rows``/``pair_cols`` are this device's slice of the packed
+    upper-panel index map (models.state.packed_pair_indices; the full map
+    by default), matching ``carry.sigma_acc``'s leading axis.
+    ``num_global_shards`` defaults to the carried state's local shard
+    count (correct for the single-device layout only).
+
+    ``unroll`` unrolls the scan body by that factor (remainder handled by
+    lax.scan), amortizing the per-iteration loop/dispatch envelope over
+    ``unroll`` sweeps WITHOUT changing any per-iteration semantics: every
+    iteration still runs its own save-condition, so burn-in and thinning
+    boundaries land exactly where they do at unroll=1 (pinned by
+    tests/test_packed_acc.py's cadence test).
+
+    Accumulates raw SUMS of the packed upper Sigma panels on every thin-th
+    post-burn-in draw; the caller divides by :func:`num_saved_draws` at
+    fetch (the reference folds the 1/effsamp weight into the accumulation,
     ``divideconquer.m:194`` - summing instead is what makes chain
     extension on resume exact).  ``lax.cond`` skips the O(p^2 K / g) block
     work on non-saved iterations, so burn-in costs only the sweep.
@@ -296,6 +350,12 @@ def run_chunk(
     """
     burnin = sched[0].astype(jnp.int32)
     thin = sched[1].astype(jnp.int32)
+    if num_global_shards is None:
+        num_global_shards = Y.shape[0]
+    if pair_rows is None:
+        pair_rows, pair_cols = packed_pair_indices(num_global_shards)
+    p_rows = jnp.asarray(pair_rows)
+    p_cols = jnp.asarray(pair_cols)
 
     def body(carry: ChainCarry, it_key: jax.Array) -> tuple[ChainCarry, None]:
         # Full-precision matmuls for everything around the sweep too
@@ -336,6 +396,7 @@ def run_chunk(
                 # saved draws (observed entries are constant across draws)
                 y_imp = y_imp + Yc
             Lam_all = gather_fn(state.Lambda)
+            ps_all = gather_fn(state.ps)
             if cfg.estimator == "scaled":
                 eta = (jnp.sqrt(cfg.rho) * state.X[None]
                        + jnp.sqrt(1.0 - cfg.rho) * state.Z)
@@ -345,41 +406,41 @@ def run_chunk(
             c_dtype = (jnp.bfloat16
                        if cfg.combine_dtype == "bfloat16" else None)
             if cfg.combine_chunks <= 1:
-                blocks = covariance_blocks(
-                    state.Lambda, state.ps, Lam_all, cfg.rho, shard_offset,
-                    eta_local=eta, eta_all=eta_all, compute_dtype=c_dtype)
+                blocks = covariance_panels(
+                    Lam_all, ps_all, cfg.rho, p_rows, p_cols,
+                    eta_all=eta_all, compute_dtype=c_dtype)
                 acc = acc + blocks
                 if acc_sq is not None:
                     acc_sq = acc_sq + blocks * blocks
             else:
-                # Column-chunked combine (ModelConfig.combine_chunks): the
-                # einsum over all G columns is the longest collective-free
-                # stretch of the chain; on timeshared virtual meshes the
-                # slowest device thread can reach the next collective
-                # minutes after the first, tripping XLA's rendezvous
-                # termination.  A tiny psum (via reduce_fn) after each
-                # chunk, tied into the next chunk's inputs with
+                # Chunked combine (ModelConfig.combine_chunks), now over
+                # the packed-pair axis: the panel einsum is the longest
+                # collective-free stretch of the chain; on timeshared
+                # virtual meshes the slowest device thread can reach the
+                # next collective minutes after the first, tripping XLA's
+                # rendezvous termination.  A tiny psum (via reduce_fn)
+                # after each chunk, tied into the next chunk's inputs with
                 # optimization_barrier, forces all devices to rendezvous
                 # every chunk - bounding the gap to one chunk's compute.
                 # The barrier token's value is never added to any data.
-                G_all = acc.shape[1]
-                Gc = G_all // cfg.combine_chunks
+                Q = acc.shape[0]
+                bounds = [(i * Q) // cfg.combine_chunks
+                          for i in range(cfg.combine_chunks + 1)]
                 token = jnp.zeros((), acc.dtype)
                 for i in range(cfg.combine_chunks):
-                    c0 = i * Gc
-                    Lam_s = Lam_all[c0:c0 + Gc]
-                    eta_s = None if eta_all is None else eta_all[c0:c0 + Gc]
+                    c0, c1 = bounds[i], bounds[i + 1]
+                    Lam_s = Lam_all
                     if i:
                         Lam_s, token = lax.optimization_barrier(
                             (Lam_s, token))
-                    blocks = covariance_blocks(
-                        state.Lambda, state.ps, Lam_s, cfg.rho,
-                        shard_offset, eta_local=eta, eta_all=eta_s,
-                        compute_dtype=c_dtype, col_offset=c0)
-                    acc = acc.at[:, c0:c0 + Gc].add(blocks)
+                    blocks = covariance_panels(
+                        Lam_s, ps_all, cfg.rho,
+                        p_rows[c0:c1], p_cols[c0:c1],
+                        eta_all=eta_all, compute_dtype=c_dtype)
+                    acc = acc.at[c0:c1].add(blocks)
                     if acc_sq is not None:
-                        acc_sq = acc_sq.at[:, c0:c0 + Gc].add(blocks * blocks)
-                    token = reduce_fn(blocks[:, 0, 0, 0])
+                        acc_sq = acc_sq.at[c0:c1].add(blocks * blocks)
+                    token = reduce_fn(blocks[:, 0, 0])
                 # the final token must survive into the graph or XLA would
                 # DCE every psum above; tie it to the accumulator output
                 acc, token = lax.optimization_barrier((acc, token))
@@ -421,13 +482,19 @@ def run_chunk(
             # the rare burn-in adaptation iterations the carried state may
             # additionally have columns re-masked - health watches that one.
             trace = _trace_now(sweep_state, sse, reduce_fn,
-                               carry.sigma_acc.shape[1], cfg.rho)
+                               num_global_shards, cfg.rho)
         return ChainCarry(state, sigma_acc, it, health, sigma_sq_acc,
                           draw_bufs, y_imp_acc), trace
 
     keys = jax.vmap(lambda i: jax.random.fold_in(key, i))(
         carry.iteration + jnp.arange(num_iters))
-    carry, trace = lax.scan(body, carry, keys)
+    # unroll > 1 batches `unroll` Gibbs sweeps into each compiled loop
+    # trip: identical per-iteration math (the trace rows, save conds, and
+    # RNG lineage are per-iteration either way), ~unroll-times fewer
+    # scan-dispatch envelopes - the dominant non-FLOP cost of the sweep
+    # on a real chip (VERDICT r5).
+    carry, trace = lax.scan(body, carry, keys,
+                            unroll=max(1, min(unroll, num_iters)))
 
     ranks = effective_ranks(carry.state)
     stats = ChainStats(

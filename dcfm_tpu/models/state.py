@@ -26,8 +26,51 @@ from typing import Any, Optional
 import flax.struct
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from dcfm_tpu.models.priors import Prior
+
+
+def num_upper_pairs(g: int) -> int:
+    """g(g+1)/2: blocks in the upper triangle (incl. diagonal) of the
+    g x g covariance block grid."""
+    return g * (g + 1) // 2
+
+
+def num_padded_pairs(g: int) -> int:
+    """The packed-panel axis length the chain carries on device:
+    g(g+1)/2 rounded UP to a multiple of g.
+
+    The round-up (g/2 extra panels for even g, none for odd - <= 1.6% at
+    the north-star g=64) is what makes the packed layout mesh-shardable
+    AND topology-portable: every legal mesh size divides g
+    (parallel.mesh.shards_per_device), so a multiple of g splits evenly
+    over any of them, and a checkpoint written at one topology reloads at
+    any other without a reshape.  Padding slots duplicate pair (0, 0);
+    they are never read (the fetch slices to the true g(g+1)/2)."""
+    n = num_upper_pairs(g)
+    return n + (-n) % g
+
+
+def packed_pair_indices(g: int) -> tuple[np.ndarray, np.ndarray]:
+    """The per-pair index map of the packed accumulator layout, built once
+    (host numpy, baked into the jitted chunk as constants).
+
+    Returns ``(rows, cols)``, each ``(num_padded_pairs(g),)`` int32: entry
+    q is the (global row shard, global col shard) of packed panel q, in
+    canonical ``np.triu_indices`` order - the SAME order the host-side
+    assembler and ``utils.estimate.upper_pair_indices`` use, so the fetch
+    hands panels straight to the native assembler with no re-packing hop.
+    Padding entries (beyond g(g+1)/2) alias pair (0, 0): the duplicate
+    blocks they accumulate are dead weight dropped at fetch, never
+    incorrect values.  On a mesh, device d owns the contiguous packed
+    slice [d*Q_local, (d+1)*Q_local) of this map."""
+    r, c = np.triu_indices(g)
+    pad = num_padded_pairs(g) - r.size
+    if pad:
+        r = np.concatenate([r, np.zeros(pad, r.dtype)])
+        c = np.concatenate([c, np.zeros(pad, c.dtype)])
+    return r.astype(np.int32), c.astype(np.int32)
 
 
 @flax.struct.dataclass

@@ -180,9 +180,11 @@ def assemble_covariance(
     """One-pass upper-panels -> final (p_out, p_out) covariance.
 
     ``upper`` must hold the FULL g(g+1)/2 upper-triangle panel set in
-    jnp.triu_indices order (utils/estimate.extract_upper_blocks output) -
-    the row-major kernel derives each pair's (r, c) from that canonical
-    order.  Returns None when the native library is unavailable (callers
+    np.triu_indices order - exactly what api._fetch_jit hands back from
+    the device's packed accumulator (models.state.packed_pair_indices
+    minus padding), so the fetch wires into this kernel with no
+    re-packing hop.  The row-major kernel derives each pair's (r, c)
+    from that canonical order.  Returns None when the native library is unavailable (callers
     fall back to the NumPy path).  See assemble.cpp for the contract.
     """
     lib = _load()

@@ -25,8 +25,10 @@
 //
 // Shapes/contracts (all row-major, caller-validated in native/__init__.py):
 //   upper:  (n_pairs, P, P), pair k holds block (r_k, c_k) with r_k <= c_k
-//           in jnp.triu_indices order (k = r*g - r(r-1)/2 + (c-r)), which
-//           is exactly what utils/estimate.extract_upper_blocks fetches.
+//           in np.triu_indices order (k = r*g - r(r-1)/2 + (c-r)), which
+//           is exactly the device's packed accumulator layout
+//           (models/state.packed_pair_indices) that api._fetch_jit
+//           forwards, padding trimmed.
 //   scale:  (g*P,) float32 per-shard-coordinate de-standardization scales
 //           (all ones when destandardize is off).
 //   map:    (g*P,) int64: shard coordinate -> output row/col, -1 = dropped

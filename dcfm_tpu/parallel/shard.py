@@ -39,6 +39,7 @@ from dcfm_tpu.config import ModelConfig, RunConfig
 from dcfm_tpu.models.priors import Prior
 from dcfm_tpu.models.sampler import (
     ChainCarry, ChainStats, DrawBuffers, chain_keys, init_chain, run_chunk)
+from dcfm_tpu.models.state import num_padded_pairs, packed_pair_indices
 from dcfm_tpu.parallel.mesh import (
     SHARD_AXIS, replicated_spec, shard_spec, shards_per_device)
 
@@ -67,6 +68,7 @@ def build_mesh_chain(
     num_iters: int,
     num_chains: int = 1,
     num_stored_draws: int = 0,
+    unroll: int = 1,
     compiler_options: Optional[dict] = None,
 ):
     """Returns ``(init_fn, chunk_fn, carry_specs)``: jitted functions
@@ -95,6 +97,13 @@ def build_mesh_chain(
     g = cfg.num_shards
     gl = shards_per_device(g, mesh)
     C = num_chains
+    n_dev = g // gl
+    # Packed upper-panel layout: the padded pair count is a multiple of g
+    # (models.state.num_padded_pairs), so it splits evenly over any legal
+    # mesh; device d owns the contiguous packed slice
+    # [d*q_local, (d+1)*q_local) of the canonical triu-order map.
+    q_local = num_padded_pairs(g) // n_dev
+    pair_rows_all, pair_cols_all = packed_pair_indices(g)
 
     sh = shard_spec()       # leading global-shard axis -> split over mesh
     rep = replicated_spec()
@@ -131,15 +140,29 @@ def build_mesh_chain(
             key, Y, cfg, prior,
             num_global_shards=g,
             shard_offset=_shard_offset(gl),
-            num_stored_draws=num_stored_draws)
+            num_stored_draws=num_stored_draws,
+            num_local_pairs=q_local)
+
+    def _local_pairs():
+        # this device's contiguous slice of the packed-pair index map
+        off = lax.axis_index(SHARD_AXIS) * q_local
+        pr = lax.dynamic_slice(jnp.asarray(pair_rows_all), (off,),
+                               (q_local,))
+        pc = lax.dynamic_slice(jnp.asarray(pair_cols_all), (off,),
+                               (q_local,))
+        return pr, pc
 
     def _chunk_one(key, Y, carry, sched):
+        pr, pc = _local_pairs()
         return run_chunk(
             key, Y, carry, sched, cfg, prior,
             num_iters=num_iters,
+            num_global_shards=g,
+            pair_rows=pr, pair_cols=pc,
             shard_offset=_shard_offset(gl),
             reduce_fn=_mesh_reduce,
-            gather_fn=_mesh_gather)
+            gather_fn=_mesh_gather,
+            unroll=unroll)
 
     def _init(key, Y):
         if C == 1:

@@ -52,7 +52,15 @@ from dcfm_tpu.config import (
 # the friendly version refusal.
 # v5: ChainCarry gained y_imp_acc (posterior-mean imputation accumulator,
 # present when the data has missing entries).
-_FORMAT_VERSION = 5
+# v6: sigma_acc/sigma_sq_acc are PACKED upper-triangle panels
+# (num_padded_pairs(g), P, P) in models.state.packed_pair_indices order,
+# not dense (Gl, G, P, P) row-panels.  v5 checkpoints stay loadable: the
+# grid is exactly symmetric, so the dense accumulators are packed
+# losslessly on restore (_pack_dense_acc) and a resumed chain continues
+# bit-for-bit.  Versions < 5 still refuse with the friendly message.
+_FORMAT_VERSION = 6
+_LEGACY_DENSE_VERSION = 5
+_LOADABLE_VERSIONS = (_FORMAT_VERSION, _LEGACY_DENSE_VERSION)
 
 
 # ChainCarry fields a state-only ("light") save drops.  The accumulators
@@ -89,6 +97,56 @@ def _expand_zeros(carry: Any, template: Any) -> Any:
         if tpl is not None and getattr(carry, f, None) is None:
             fill[f] = np.zeros(np.shape(tpl), np.dtype(tpl.dtype))
     return carry._replace(**fill) if fill else carry
+
+
+def _sigma_leaf_indices(carry: Any) -> list:
+    """Flat-leaf indices of the PACKED covariance accumulators
+    (sigma_acc/sigma_sq_acc) in ``jax.tree.flatten(carry)`` order - the
+    leaves the v5 dense->packed migration rewrites on load."""
+    if not hasattr(carry, "_replace"):
+        return []
+    drop = {f: None for f in ("sigma_acc", "sigma_sq_acc")
+            if getattr(carry, f, None) is not None}
+    if not drop:
+        return []
+    keep = {id(l) for l in jax.tree.leaves(carry._replace(**drop))}
+    return [i for i, l in enumerate(jax.tree.leaves(carry))
+            if id(l) not in keep]
+
+
+def _pack_dense_acc(arr: np.ndarray, g: int,
+                    packed_shape: tuple) -> np.ndarray:
+    """v5 migration: dense (..., Gl=g, G=g, P, P) accumulator -> packed
+    (..., num_padded_pairs(g), P, P) upper panels.
+
+    Lossless: the block grid is exactly symmetric, so the dropped lower
+    triangle carries no information; padding slots restart at zero (they
+    are dead weight never read at fetch, and further accumulation only
+    adds dead duplicates of pair (0, 0))."""
+    expect = tuple(packed_shape[:-3]) + (g, g) + tuple(packed_shape[-2:])
+    if tuple(arr.shape) != expect:
+        raise ValueError(
+            f"v{_LEGACY_DENSE_VERSION} checkpoint accumulator shape "
+            f"{arr.shape} != expected dense {expect} - config/data "
+            "mismatch?")
+    r, c = np.triu_indices(g)
+    packed = np.ascontiguousarray(arr[..., r, c, :, :])
+    pad = packed_shape[-3] - r.size
+    if pad:
+        packed = np.concatenate(
+            [packed, np.zeros(packed.shape[:-3] + (pad,)
+                              + packed.shape[-2:], packed.dtype)],
+            axis=-3)
+    return packed
+
+
+def _legacy_migrations(meta: dict, template: Any) -> dict:
+    """{flat leaf index: g} for the accumulator leaves a v5 (dense-carry)
+    FULL checkpoint must pack on load; empty for v6 or state-only files."""
+    if meta["version"] != _LEGACY_DENSE_VERSION or meta.get("state_only"):
+        return {}
+    g = int(meta["config"]["model"]["num_shards"])
+    return {i: g for i in _sigma_leaf_indices(template)}
 
 
 def _acc_leaf_indices(carry: Any) -> list:
@@ -235,9 +293,10 @@ def read_checkpoint_meta(path: str) -> dict:
     refusal instead of a raw missing-leaf error)."""
     with np.load(path) as z:
         meta = json.loads(bytes(z["__meta__"]).decode())
-    if meta["version"] != _FORMAT_VERSION:
+    if meta["version"] not in _LOADABLE_VERSIONS:
         raise ValueError(
-            f"checkpoint format v{meta['version']} != v{_FORMAT_VERSION}")
+            f"checkpoint format v{meta['version']} != v{_FORMAT_VERSION} "
+            f"(loadable: {sorted(_LOADABLE_VERSIONS)})")
     return meta
 
 
@@ -247,12 +306,18 @@ def load_checkpoint(path: str, carry_template: Any) -> Tuple[Any, dict]:
     ``carry_template`` supplies the pytree structure (build it with the same
     configs via init_chain / jax.eval_shape); leaf shapes are checked so a
     config/data mismatch fails loudly instead of resuming garbage.
+
+    v5 (dense-carry) checkpoints migrate transparently: their
+    (Gl, G, P, P) covariance accumulators are packed into the upper-panel
+    layout on restore (lossless - the grid is exactly symmetric), so a
+    pre-packing run resumes bit-for-bit under the packed chain.
     """
     with np.load(path) as z:
         meta = json.loads(bytes(z["__meta__"]).decode())
-        if meta["version"] != _FORMAT_VERSION:
+        if meta["version"] not in _LOADABLE_VERSIONS:
             raise ValueError(
-                f"checkpoint format v{meta['version']} != v{_FORMAT_VERSION}")
+                f"checkpoint format v{meta['version']} != v{_FORMAT_VERSION}"
+                f" (loadable: {sorted(_LOADABLE_VERSIONS)})")
         state_only = meta.get("state_only", False)
         # state-only files store the SLIM carry (accumulators dropped);
         # match against the slim template and restore the accumulators as
@@ -260,9 +325,12 @@ def load_checkpoint(path: str, carry_template: Any) -> Tuple[Any, dict]:
         # (the caller threads that into the fetch divisor via acc_start)
         template = _slim(carry_template) if state_only else carry_template
         template_leaves, treedef = jax.tree.flatten(template)
+        mig = _legacy_migrations(meta, template)
         leaves = []
         for i, tl in enumerate(template_leaves):
             arr = z[f"leaf_{i}"]
+            if i in mig:
+                arr = _pack_dense_acc(arr, mig[i], tuple(np.shape(tl)))
             if tuple(arr.shape) != tuple(np.shape(tl)):
                 raise ValueError(
                     f"checkpoint leaf {i} shape {arr.shape} != expected "
@@ -397,16 +465,31 @@ def load_checkpoint_resharded(
     State-only sets (light saves) match against the SLIM template; the
     accumulators come back as host zeros (accumulation restarts at the
     recorded iteration).
+
+    v5 (dense-carry) sets assemble against the legacy dense accumulator
+    shapes and are packed into the upper-panel layout afterwards
+    (lossless; see :func:`_pack_dense_acc`).
     """
-    state_only = read_checkpoint_meta(paths[0]).get("state_only", False)
+    meta0 = read_checkpoint_meta(paths[0])
+    state_only = meta0.get("state_only", False)
     template = _slim(carry_template) if state_only else carry_template
     template_leaves, treedef = jax.tree.flatten(template)
+    mig = _legacy_migrations(meta0, template)
+    packed_shapes = {}
+    for i, g_legacy in mig.items():
+        tpl = template_leaves[i]
+        shp = tuple(np.shape(tpl))
+        packed_shapes[i] = shp
+        # assemble the v5 set against its native dense shape; packed after
+        template_leaves[i] = jax.ShapeDtypeStruct(
+            shp[:-3] + (g_legacy, g_legacy) + shp[-2:], np.dtype(tpl.dtype))
     full = [None] * len(template_leaves)
     metas = []
     for fp in paths:
         with np.load(fp) as z:
             meta = json.loads(bytes(z["__meta__"]).decode())
-            if meta["version"] != _FORMAT_VERSION:
+            if (meta["version"] not in _LOADABLE_VERSIONS
+                    or meta["version"] != meta0["version"]):
                 raise ValueError(f"checkpoint format v{meta['version']} != "
                                  f"v{_FORMAT_VERSION}")
             if meta.get("state_only", False) != state_only:
@@ -441,6 +524,8 @@ def load_checkpoint_resharded(
         raise ValueError(
             f"per-process checkpoints disagree on the iteration "
             f"({sorted(iters)}) - a crash between two processes' saves")
+    for i, g_legacy in mig.items():
+        full[i] = _pack_dense_acc(full[i], g_legacy, packed_shapes[i])
     carry = jax.tree.unflatten(treedef, full)
     if state_only:
         carry = _expand_zeros(carry, carry_template)
@@ -538,7 +623,22 @@ def load_checkpoint_multiprocess(path: str, carry_like: Any,
         raise FileNotFoundError(
             f"no complete checkpoint set at {path}(.procK-of-N)")
     kind, found = source
-    if kind == "plain" or found[0] != jax.process_count():
+    legacy_full = False
+    if kind != "plain" and found[0] == jax.process_count():
+        # v5 dense-carry sets cannot take the shard-local fast path (their
+        # saved shard offsets describe the dense layout); route them
+        # through the reshard assembly, which packs on load.
+        my_meta = read_checkpoint_meta(
+            proc_path(path, jax.process_index(), jax.process_count()))
+        legacy_full = (my_meta["version"] == _LEGACY_DENSE_VERSION
+                       and not my_meta.get("state_only", False))
+        if legacy_full and kind == "local-set":
+            raise ValueError(
+                f"v{_LEGACY_DENSE_VERSION} dense-carry checkpoint on "
+                "per-host local disks cannot be migrated shard-locally - "
+                "resume it once on a shared filesystem (or single-process) "
+                "to rewrite it in the packed v6 layout")
+    if kind == "plain" or found[0] != jax.process_count() or legacy_full:
         if kind == "local-set":
             # api._resume_state_multiproc fabricates this kind when only
             # this process's own file is visible (per-host local disks);
@@ -570,9 +670,12 @@ def load_checkpoint_multiprocess(path: str, carry_like: Any,
     target = proc_path(path, jax.process_index(), jax.process_count())
     with np.load(target) as z:
         meta = json.loads(bytes(z["__meta__"]).decode())
-        if meta["version"] != _FORMAT_VERSION:
+        # v5 reaches here only state-only (slim carries have no
+        # accumulator leaves, so their shard layout is unchanged)
+        if meta["version"] not in _LOADABLE_VERSIONS:
             raise ValueError(
-                f"checkpoint format v{meta['version']} != v{_FORMAT_VERSION}")
+                f"checkpoint format v{meta['version']} != v{_FORMAT_VERSION}"
+                f" (loadable: {sorted(_LOADABLE_VERSIONS)})")
         state_only = meta.get("state_only", False)
         template = _slim(carry_like) if state_only else carry_like
         leaves_like, treedef = jax.tree.flatten(template)
@@ -703,12 +806,23 @@ class AsyncCheckpointWriter:
             snap = device_snapshot(carry)
         except Exception:
             # on-device copy failed (e.g. RESOURCE_EXHAUSTED near device
-            # memory capacity): synchronous host fetch instead - the chain
-            # thread stalls for the fetch, but the save still happens.
-            # Counted into last_save_seconds so the auto cadence is sized
-            # from the FULL cost of a save in this regime, not just the
-            # background write.
+            # memory capacity): fall back to saving without the snapshot.
+            # On a multi-host run the old fallback - jax.device_get of the
+            # live carry - would itself raise: sharded leaves are not
+            # fully addressable, and device_get cannot materialize them
+            # (ADVICE r5).  The per-process save_fn only ever reads each
+            # leaf's ADDRESSABLE shards, so run it synchronously on the
+            # live carry instead (safe: the next chunk, which would donate
+            # the carry's buffers, is not dispatched until submit
+            # returns).  Fully-addressable carries keep the cheaper path:
+            # one synchronous host fetch, then the background write.
             t0 = _time.perf_counter()
+            if any(isinstance(l, jax.Array) and not l.is_fully_addressable
+                   for l in jax.tree.leaves(carry)):
+                save_fn(path, carry, cfg, fingerprint=fingerprint,
+                        **save_kwargs)
+                self.last_save_seconds = _time.perf_counter() - t0
+                return
             snap = jax.device_get(carry)
             sync_fetch_s = _time.perf_counter() - t0
 

@@ -1,10 +1,13 @@
 """Combine / estimator layer ("conquer"): host-side stitching.
 
-The devices hand back per-shard row-panels of posterior-mean covariance
-blocks, (g, g, P, P); this module stitches them into the (p_used, p_used)
-matrix, symmetrizes (reference ``divideconquer.m:194-195``), and maps back
-to caller coordinates via utils/preprocess.restore_covariance.  Only the
-host ever holds the full p x p matrix.
+The devices hand back the PACKED upper-triangle panels of the
+posterior-mean covariance block grid, (g(g+1)/2, P, P) in canonical triu
+order (the same layout the chain accumulates on device -
+models.state.packed_pair_indices); this module stitches them into the
+(p_used, p_used) matrix, symmetrizes (reference
+``divideconquer.m:194-195``), and maps back to caller coordinates via
+utils/preprocess.restore_covariance.  Only the host ever holds the full
+p x p matrix.
 """
 
 from __future__ import annotations
@@ -18,27 +21,17 @@ from dcfm_tpu.utils.preprocess import PreprocessResult, restore_covariance
 
 
 def upper_pair_indices(g: int) -> tuple[np.ndarray, np.ndarray]:
-    """Row/col indices of the g(g+1)/2 upper-triangle block pairs."""
+    """Row/col indices of the g(g+1)/2 upper-triangle block pairs, in the
+    canonical triu order the device-side packed accumulator also uses
+    (models.state.packed_pair_indices is this map plus mesh padding) - the
+    shared convention that lets the fetch hand panels straight to the
+    assembler with no re-packing hop on device or host."""
     r, c = np.triu_indices(g)
     return r.astype(np.int32), c.astype(np.int32)
 
 
-def extract_upper_blocks(sigma_acc, g: int):
-    """Device-side: (g, g, P, P) accumulator -> (g(g+1)/2, P, P) panels.
-
-    Both covariance estimators produce exactly symmetric block grids
-    (block_cr = block_rc' - for "scaled", H_cr = H_rc' so
-    Lam_c H_cr Lam_r' = (Lam_r H_rc Lam_c')'), so the lower triangle carries
-    no information.  Halving what crosses the device->host link matters: the
-    accumulator is the single biggest artifact of a run (p^2/g^2 per pair).
-    Jit this and fetch its output instead of the full accumulator.
-    """
-    r, c = upper_pair_indices(g)
-    return sigma_acc[r, c]
-
-
 def full_blocks_from_upper(upper: np.ndarray, g: int) -> np.ndarray:
-    """Host-side inverse of extract_upper_blocks (transposes fill the rest).
+    """Host-side unpacking of the upper panels (transposes fill the rest).
 
     The g diagonal blocks are explicitly symmetrized (they carry float-level
     asymmetry from the einsum accumulation order), so the stitched matrix is
@@ -233,8 +226,8 @@ def posterior_covariance(
     """Blocks -> covariance in the caller's original coordinates (fixes Q5).
 
     ``assume_symmetric`` skips the defensive symmetrization when the blocks
-    are known exactly symmetric (the fit() path, whose blocks round-trip
-    through extract_upper_blocks/full_blocks_from_upper)."""
+    are known exactly symmetric (the fit() path, whose blocks come from the
+    packed upper panels via full_blocks_from_upper)."""
     S = stitch_blocks(np.asarray(sigma_blocks),
                       symmetrize=not assume_symmetric)
     return restore_covariance(

@@ -7,20 +7,21 @@ proves the layout holds at that scale on the 8-virtual-CPU-device mesh:
 
 * 256 shards over 8 devices = 32 shards/device via the vmap-within-shard_map
   layout (the same code path as TPU pods);
-* the (Gl, G, P, P) row-panel accumulator = 32*256*196^2 f32 = 1.26 GB per
-  device - exactly p^2/n_devices; the full p x p exists only after host
-  stitching;
+* the PACKED upper-panel accumulator = (g(g+1)/2 + pad)/8 = 4112 panels *
+  196^2 f32 = 0.63 GB per device - ~p^2/(2*n_devices), HALF the old dense
+  row-panel layout (the grid is exactly symmetric, so the lower triangle
+  was pure waste); the full p x p exists only after host stitching;
 * the X update's cross-shard psum and the combine's all_gather compile and
   execute at this shape.
 
 Memory accounting (f32, per device, n=16, P=196, K=2):
-    sigma_acc row-panel   32*256*196*196*4  = 1.26 GB   <- dominates
+    sigma_acc packed panels  4112*196*196*4  = 0.63 GB   <- dominates
     Y + state             ~32*(16+196)*2*4 + 32*196*4  < 2 MB
     all_gather'd Lambda   256*196*2*4                   = 0.4 MB
     all_gather'd eta      256*16*2*4                    = 33 KB
-Total ~1.3 GB/device; a TPU v5e (16 GB HBM) holds it 12x over.  At p=100k
-(P=391) the panel is 5 GB/device - still fits; beyond that, shard P or
-stream panels per saved draw.
+Total ~0.65 GB/device; a TPU v5e (16 GB HBM) holds it 24x over.  At p=100k
+(P=391) the packed panel set is 2.5 GB/device - still fits; beyond that,
+shard P or stream panels per saved draw.
 
 Run:  python scripts/pod_scale_demo.py          (~4-8 min on 8 virtual CPUs)
       PODDEMO_SYNTH=1 PODDEMO_ITERS=200 PODDEMO_THIN=10 PODDEMO_N=64 \\
@@ -52,8 +53,10 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     flags += " --xla_force_host_platform_device_count=8"
-if "collective_timeout" not in flags:
-    flags += " --xla_cpu_collective_timeout_seconds=1200"
+# (the collective rendezvous timeouts are raised per-jit via the
+# compiler_options passed to build_mesh_chain below; the old global
+# --xla_cpu_collective_timeout_seconds flag no longer exists in current
+# XLA and would abort the process at backend init)
 os.environ["XLA_FLAGS"] = flags.strip()
 
 import jax  # noqa: E402
@@ -118,20 +121,33 @@ def run_demo(g=256, n_devices=8, P=196, n=16, K=2, iters=3, seed=0,
     else:
         Y = rng.standard_normal((g, n, P)).astype(np.float32)
 
-    panel_gb = gl * g * P * P * 4 / 1e9 * (2 if posterior_sd else 1)
+    from dcfm_tpu.models.state import num_padded_pairs
+    q_pad = num_padded_pairs(g)
+    q_local = q_pad // n_devices
+    panel_gb = q_local * P * P * 4 / 1e9 * (2 if posterior_sd else 1)
     if verbose:
         print(f"p={p:,} g={g} -> {gl} shards/device on {n_devices} devices; "
-              f"row-panel accumulator{'s (mean+SD)' if posterior_sd else ''} "
+              f"packed upper-panel accumulator"
+              f"{'s (mean+SD)' if posterior_sd else ''} "
               f"{panel_gb:.2f} GB/device "
-              f"({n_devices * panel_gb:.1f} GB total, full p^2 "
+              f"({n_devices * panel_gb:.1f} GB total, ~half the dense "
+              f"row-panel layout; full p^2 "
               f"{p * p * 4 / 1e9:.1f} GB never on one device)")
 
     t0 = time.perf_counter()
     # Raise the collective rendezvous timeouts: on the 1-core virtual mesh
     # the 8 device threads reach each all-reduce up to minutes apart (see
     # build_mesh_chain docstring); XLA's 40 s default aborts the process.
+    # Probe first: newer XLA renamed/dropped these debug options and
+    # rejects unknown compile options at jit time - run without them then
+    # (combine_chunks still bounds the collective-free stretch).
     opts = {"xla_cpu_collective_call_warn_stuck_seconds": "600",
             "xla_cpu_collective_call_terminate_timeout_seconds": "3600"}
+    try:
+        jax.jit(lambda x: x + 1, compiler_options=opts)(
+            np.zeros((), np.float32))
+    except Exception:
+        opts = None
     init_fn, chunk_fn, _ = build_mesh_chain(mesh, cfg, prior_triple, num_iters=iters,
                                          compiler_options=opts)
     Yd = place_sharded(Y, mesh)
@@ -146,15 +162,16 @@ def run_demo(g=256, n_devices=8, P=196, n=16, K=2, iters=3, seed=0,
     t_run = time.perf_counter() - t0
 
     blocks = carry.sigma_acc
-    # global logical shape: (g, G, P, P), sharded over the row axis so each
-    # device holds only its (gl, G, P, P) panel
-    assert blocks.shape == (g, g, P, P)
-    # per-device shard check without fetching the 10 GB accumulator: the
-    # diagonal blocks carry the residual variances, so their trace is
-    # strictly positive, and every entry must be finite.
+    # global logical shape: packed upper panels (q_pad, P, P) in canonical
+    # triu order, sharded over the pair axis so each device holds only its
+    # (q_pad/n_devices, P, P) slice
+    assert blocks.shape == (q_pad, P, P)
+    # per-device shard check without fetching the multi-GB accumulator:
+    # panel 0 is diagonal block (0, 0), whose trace carries the residual
+    # variances and is strictly positive; every entry must be finite.
     finite = bool(jax.jit(
         lambda b: jnp.isfinite(b).all())(blocks))
-    tr0 = float(jax.jit(lambda b: jnp.trace(b[0, 0]))(blocks))
+    tr0 = float(jax.jit(lambda b: jnp.trace(b[0]))(blocks))
     assert finite, "non-finite covariance blocks at pod scale"
     assert tr0 > 0, "empty accumulator - no draw saved"
     it = int(np.asarray(carry.iteration).reshape(-1)[0])
@@ -167,12 +184,12 @@ def run_demo(g=256, n_devices=8, P=196, n=16, K=2, iters=3, seed=0,
         # the two raw-sum accumulators - finiteness + a sane positive
         # median pin the full SD path at pod scale without any big fetch
         acc_sq = carry.sigma_sq_acc
-        assert acc_sq is not None and acc_sq.shape == (g, g, P, P)
+        assert acc_sq is not None and acc_sq.shape == (q_pad, P, P)
 
         @jax.jit
         def _sd00(acc, acc_sq):
-            m = acc[0, 0] / max(n_saved, 1)
-            m2 = acc_sq[0, 0] / max(n_saved, 1)
+            m = acc[0] / max(n_saved, 1)          # packed panel 0 = (0, 0)
+            m2 = acc_sq[0] / max(n_saved, 1)
             b = n_saved / max(n_saved - 1, 1)
             return jnp.sqrt(jnp.maximum(m2 - m * m, 0.0) * b)
 
@@ -183,26 +200,34 @@ def run_demo(g=256, n_devices=8, P=196, n=16, K=2, iters=3, seed=0,
     rel_err = None
     if synth:
         # Rel Frobenius error vs the known truth, on device, sharded, in
-        # column chunks: neither the p x p estimate nor the p x p truth is
-        # ever materialized (each chunk is (g, Gc, P, P) sharded over rows).
+        # packed-pair chunks: neither the p x p estimate nor the p x p
+        # truth is ever materialized.  Off-diagonal pairs weight double
+        # (each packed panel stands for its mirror block too), making the
+        # sum the exact full-matrix Frobenius norm.
+        from dcfm_tpu.models.state import num_upper_pairs, packed_pair_indices
+        rows_np, cols_np = packed_pair_indices(g)
+        n_pairs = num_upper_pairs(g)
         Lt = jax.device_put(L_true)          # (g, P, K) replicated, ~0.5 MB
 
         @jax.jit
         def _err(acc, Lt):
-            Gc = max(g // 16, 1)          # ~16 chunks; last may be ragged
+            Qc = max(n_pairs // 16, 1)    # ~16 chunks; last may be ragged
             num = den = 0.0
-            for c0 in range(0, g, Gc):
-                w = min(Gc, g - c0)
-                true_blk = jnp.einsum("rpk,cqk->rcpq",
-                                      Lt, Lt[c0:c0 + w])
-                eyeP = jnp.eye(P, dtype=acc.dtype)
-                diag = jax.nn.one_hot(jnp.arange(g) - c0, w,
-                                      dtype=acc.dtype)
+            eyeP = jnp.eye(P, dtype=acc.dtype)
+            for c0 in range(0, n_pairs, Qc):
+                w = min(Qc, n_pairs - c0)
+                pr = jnp.asarray(rows_np[c0:c0 + w])
+                pc = jnp.asarray(cols_np[c0:c0 + w])
+                true_blk = jnp.einsum("qpk,qlk->qpl",
+                                      jnp.take(Lt, pr, axis=0),
+                                      jnp.take(Lt, pc, axis=0))
+                diag = (pr == pc).astype(acc.dtype)
                 true_blk += (noise * noise) * (
-                    diag[:, :, None, None] * eyeP)
-                d = acc[:, c0:c0 + w] / max(n_saved, 1) - true_blk
-                num += jnp.sum(d * d)
-                den += jnp.sum(true_blk * true_blk)
+                    diag[:, None, None] * eyeP)
+                wgt = (2.0 - diag)[:, None, None]
+                d = acc[c0:c0 + w] / max(n_saved, 1) - true_blk
+                num += jnp.sum(wgt * d * d)
+                den += jnp.sum(wgt * true_blk * true_blk)
             return jnp.sqrt(num / den)
 
         rel_err = float(_err(blocks, Lt))

@@ -43,20 +43,25 @@ def test_quant8_fetch_matches_float32():
 def test_quant8_zero_panel_safe():
     # The quantizer's per-panel max-abs scale must not divide by zero on an
     # all-zero panel (e.g. a chain that saved no draws yet).  Exercise the
-    # guard directly: craft an accumulator whose off-diagonal panels are
+    # guard directly: craft a PACKED accumulator (the carry layout,
+    # models.state.packed_pair_indices order) whose off-diagonal panels are
     # exactly zero and quantize it.
     from dcfm_tpu.api import _fetch_jit
+    from dcfm_tpu.models.state import (
+        num_padded_pairs, num_upper_pairs, packed_pair_indices)
     g, P = 3, 4
-    acc = np.zeros((g, g, P, P), np.float32)
-    for i in range(g):
-        acc[i, i] = np.eye(P) * (i + 1.0)   # only diagonal panels nonzero
+    rows, cols = packed_pair_indices(g)
+    acc = np.zeros((num_padded_pairs(g), P, P), np.float32)
+    for q_idx in range(num_upper_pairs(g)):
+        if rows[q_idx] == cols[q_idx]:      # only diagonal panels nonzero
+            acc[q_idx] = np.eye(P) * (rows[q_idx] + 1.0)
     q, scale = _fetch_jit(g, 1, "quant8")(acc, np.float32(1.0))
     q, scale = np.asarray(q), np.asarray(scale)
     deq = q.astype(np.float32) * scale[:, None, None] / 127.0
     assert np.isfinite(deq).all()
     # zero panels round-trip to exactly zero, nonzero ones to scale accuracy
-    from dcfm_tpu.utils.estimate import extract_upper_blocks
-    ref = np.asarray(extract_upper_blocks(acc, g=g))
+    ref = acc[:num_upper_pairs(g)]
+    assert deq.shape == ref.shape           # fetch trims the mesh padding
     assert np.abs(deq - ref).max() <= (np.abs(ref).max() / 254 + 1e-7)
 
 

@@ -14,8 +14,8 @@ from tests.conftest import make_synthetic
 
 from dcfm_tpu import native
 from dcfm_tpu.utils.estimate import (
-    assemble_from_upper, extract_upper_blocks, full_blocks_from_upper,
-    stitch_blocks, upper_pair_indices)
+    assemble_from_upper, full_blocks_from_upper, stitch_blocks,
+    upper_pair_indices)
 from dcfm_tpu.utils.preprocess import preprocess, restore_covariance
 
 

@@ -149,8 +149,15 @@ def test_named_scopes_in_hlo():
                        as_=cfg.as_, bs=cfg.bs)
     fn = functools.partial(gibbs_sweep, cfg=cfg, prior=prior)
     # scopes live in the location metadata (debug_info) and survive into
-    # the compiled module, which is what profilers read
-    hlo = jax.jit(fn).lower(key, Y, state).as_text(debug_info=True)
+    # the compiled module, which is what profilers read.  The kwarg moved
+    # across jax versions: newer Lowered.as_text takes debug_info=..., on
+    # older ones the same metadata is read off the stablehlo module asm.
+    lowered = jax.jit(fn).lower(key, Y, state)
+    try:
+        hlo = lowered.as_text(debug_info=True)
+    except TypeError:
+        hlo = lowered.compiler_ir(dialect="stablehlo").operation.get_asm(
+            enable_debug_info=True)
     for scope in ("z_update", "x_update", "lambda_update", "prior_update",
                   "ps_update"):
         assert scope in hlo, f"named scope {scope} missing from HLO"

@@ -1,0 +1,259 @@
+"""Packed upper-triangle accumulator layout + scan-dispatch fusion (PR 2).
+
+The chain carries only the g(g+1)/2 upper-triangle covariance panels
+(models.state.packed_pair_indices; mesh-padded to a multiple of g) - half
+the HBM and combine FLOPs of the old dense (Gl, G, P, P) row-panels.
+These tests pin:
+
+* bit-level packed-vs-dense equivalence of the combine, on the
+  single-device layout AND inside shard_map (covariance_panels vs the
+  dense covariance_blocks oracle, both estimators);
+* carry shape/HBM: the largest on-device accumulator IS the packed panel
+  set, ~half the dense footprint;
+* checkpoint migration: a v5 dense-carry checkpoint resumes bit-for-bit
+  under the packed chain;
+* scan-dispatch fusion (RunConfig.sweep_unroll): burn-in/thin boundaries
+  and every trace row identical to the unroll=1 reference.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import lax
+
+from tests.conftest import make_synthetic
+
+from dcfm_tpu import BackendConfig, FitConfig, ModelConfig, RunConfig, fit
+from dcfm_tpu.models.conditionals import covariance_blocks, covariance_panels
+from dcfm_tpu.models.state import (
+    num_padded_pairs, num_upper_pairs, packed_pair_indices)
+
+
+def _rand_draw(g, P, K, n, seed=0):
+    rng = np.random.default_rng(seed)
+    Lam = rng.standard_normal((g, P, K)).astype(np.float32)
+    ps = rng.uniform(0.5, 2.0, (g, P)).astype(np.float32)
+    eta = rng.standard_normal((g, n, K)).astype(np.float32)
+    return Lam, ps, eta
+
+
+@pytest.mark.parametrize("estimator", ["scaled", "plain"])
+def test_packed_matches_dense_bitwise_single_device(estimator):
+    g, P, K, n = 6, 5, 3, 11
+    Lam, ps, eta = _rand_draw(g, P, K, n)
+    rows, cols = packed_pair_indices(g)
+    n_pairs = num_upper_pairs(g)
+    ea = jnp.asarray(eta) if estimator == "scaled" else None
+    dense = np.asarray(jax.jit(lambda: covariance_blocks(
+        jnp.asarray(Lam), jnp.asarray(ps), jnp.asarray(Lam), 0.8, 0,
+        eta_local=ea, eta_all=ea))())
+    packed = np.asarray(jax.jit(lambda: covariance_panels(
+        jnp.asarray(Lam), jnp.asarray(ps), 0.8, rows, cols,
+        eta_all=ea))())
+    # bit-level: same contraction order and precision scopes by design
+    np.testing.assert_array_equal(packed[:n_pairs],
+                                  dense[rows[:n_pairs], cols[:n_pairs]])
+    # padding slots alias pair (0, 0) - harmless duplicates, never fetched
+    np.testing.assert_array_equal(packed[n_pairs:],
+                                  np.broadcast_to(
+                                      dense[0, 0],
+                                      (rows.size - n_pairs, P, P)))
+
+
+@pytest.mark.parametrize("estimator", ["scaled", "plain"])
+def test_packed_matches_dense_bitwise_mesh(estimator):
+    """The shard_map layout: each device computes its contiguous packed
+    slice from gathered inputs; bitwise equal to the dense per-device
+    row-panel oracle at the corresponding (row, col) pairs."""
+    from jax.sharding import PartitionSpec as Psp
+
+    from dcfm_tpu.parallel.mesh import SHARD_AXIS, make_mesh
+    from dcfm_tpu.parallel.shard import _mesh_gather, shard_map
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 virtual CPU devices")
+    g, P, K, n, D = 8, 5, 3, 11, 4
+    Lam, ps, eta = _rand_draw(g, P, K, n, seed=1)
+    rows, cols = packed_pair_indices(g)
+    n_pairs = num_upper_pairs(g)
+    mesh = make_mesh(D)
+    q_local = rows.size // D
+    gl = g // D
+    scaled = estimator == "scaled"
+
+    def f_packed(Lam_l, ps_l, eta_l):
+        off = lax.axis_index(SHARD_AXIS) * q_local
+        pr = lax.dynamic_slice(jnp.asarray(rows), (off,), (q_local,))
+        pc = lax.dynamic_slice(jnp.asarray(cols), (off,), (q_local,))
+        return covariance_panels(
+            _mesh_gather(Lam_l), _mesh_gather(ps_l), 0.8, pr, pc,
+            eta_all=_mesh_gather(eta_l) if scaled else None)
+
+    def f_dense(Lam_l, ps_l, eta_l):
+        off = lax.axis_index(SHARD_AXIS) * gl
+        return covariance_blocks(
+            Lam_l, ps_l, _mesh_gather(Lam_l), 0.8, off,
+            eta_local=eta_l if scaled else None,
+            eta_all=_mesh_gather(eta_l) if scaled else None)
+
+    specs = (Psp(SHARD_AXIS),) * 3
+    packed = np.asarray(jax.jit(shard_map(
+        f_packed, mesh=mesh, in_specs=specs,
+        out_specs=Psp(SHARD_AXIS)))(Lam, ps, eta))
+    dense = np.asarray(jax.jit(shard_map(
+        f_dense, mesh=mesh, in_specs=specs,
+        out_specs=Psp(SHARD_AXIS)))(Lam, ps, eta))
+    np.testing.assert_array_equal(packed[:n_pairs],
+                                  dense[rows[:n_pairs], cols[:n_pairs]])
+
+
+def test_carry_accumulator_is_packed_and_halved():
+    """Acceptance pin: the largest on-device accumulator is the packed
+    (g(g+1)/2 [+pad], P, P) panel set - asserted on shapes and bytes, not
+    eyeballed - at ~half the dense (g, g, P, P) footprint."""
+    from dcfm_tpu.models.priors import make_prior
+    from dcfm_tpu.models.sampler import init_chain
+
+    g, P, K, n = 64, 6, 2, 9
+    m = ModelConfig(num_shards=g, factors_per_shard=K, rho=0.9,
+                    posterior_sd=True)
+    carry = jax.eval_shape(
+        lambda k, Y: init_chain(k, Y, m, make_prior(m),
+                                num_global_shards=g),
+        jax.ShapeDtypeStruct((), jax.random.key(0).dtype),
+        jax.ShapeDtypeStruct((g, n, P), jnp.float32))
+    q_pad = num_padded_pairs(g)
+    assert carry.sigma_acc.shape == (q_pad, P, P)
+    assert carry.sigma_sq_acc.shape == (q_pad, P, P)
+    # padding is bounded: within one block-row of the true triangle
+    assert num_upper_pairs(g) <= q_pad < num_upper_pairs(g) + g
+    # the accumulator dominates every other carry leaf...
+    acc_bytes = int(np.prod(carry.sigma_acc.shape)) * 4
+    for leaf in jax.tree.leaves(carry.state):
+        assert int(np.prod(leaf.shape)) * leaf.dtype.itemsize <= acc_bytes
+    # ...and is ~half (<= 0.52x at g=64) of the old dense layout
+    dense_bytes = g * g * P * P * 4
+    assert acc_bytes <= 0.52 * dense_bytes
+    # no carry leaf is a dense (g, g, P, P) block grid anymore
+    for leaf in jax.tree.leaves(carry):
+        assert tuple(leaf.shape[-4:]) != (g, g, P, P)
+
+
+def test_mesh_carry_shards_packed_axis():
+    """The mesh carry shards the packed axis: global (q_pad, P, P), an
+    even (q_pad/D, P, P) slice per device."""
+    from dcfm_tpu.models.priors import make_prior
+    from dcfm_tpu.parallel.mesh import make_mesh
+    from dcfm_tpu.parallel.shard import build_mesh_chain
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 virtual CPU devices")
+    g, P, n, D = 8, 4, 10, 4
+    m = ModelConfig(num_shards=g, factors_per_shard=2, rho=0.8)
+    mesh = make_mesh(D)
+    init_fn, _, specs = build_mesh_chain(
+        mesh, m, make_prior(m), num_iters=2)
+    q_pad = num_padded_pairs(g)
+    assert q_pad % D == 0
+    carry = jax.eval_shape(init_fn,
+                           jax.ShapeDtypeStruct((), jax.random.key(0).dtype),
+                           jax.ShapeDtypeStruct((g, n, P), jnp.float32))
+    assert carry.sigma_acc.shape == (q_pad, P, P)
+
+
+def _fit_cfg(Y_p=48, *, unroll=0, mesh=0, estimator="scaled"):
+    return FitConfig(
+        model=ModelConfig(num_shards=4, factors_per_shard=2, rho=0.8,
+                          estimator=estimator, posterior_sd=True),
+        run=RunConfig(burnin=17, mcmc=21, thin=3, seed=0, chunk_size=13,
+                      sweep_unroll=unroll),
+        backend=BackendConfig(mesh_devices=mesh))
+
+
+def test_sweep_unroll_preserves_cadence_and_results():
+    """K-batched sweeps (sweep_unroll) must land burn-in/thin boundaries
+    exactly where unroll=1 does: every trace row, the accumulated Sigma,
+    and the posterior SD are identical.  The schedule is chosen so chunk
+    boundaries, thin points, and the unroll factor interleave awkwardly
+    (chunk 13, thin 3, unroll 5 - nothing divides anything)."""
+    Y, _ = make_synthetic(40, 48, 2, seed=5)
+    r1 = fit(Y, _fit_cfg(unroll=1))
+    r5 = fit(Y, _fit_cfg(unroll=5))
+    np.testing.assert_array_equal(r1.traces, r5.traces)
+    np.testing.assert_array_equal(r1.upper_panels, r5.upper_panels)
+    np.testing.assert_array_equal(r1.Sigma, r5.Sigma)
+    np.testing.assert_array_equal(r1.Sigma_sd, r5.Sigma_sd)
+    np.testing.assert_array_equal(np.asarray(r1.state.Lambda),
+                                  np.asarray(r5.state.Lambda))
+
+
+def test_dense_v5_checkpoint_migrates_and_resumes_exactly(tmp_path):
+    """Acceptance pin: resuming a pre-change dense-carry (v5) checkpoint
+    produces the same posterior mean as an uninterrupted packed run.
+
+    A real v6 checkpoint is rewritten in the v5 on-disk layout (dense
+    (g, g, P, P) accumulators, version=5) and resumed into a longer
+    schedule; the result must match the uninterrupted run bit-for-bit."""
+    import json
+
+    g = 4
+    Y, _ = make_synthetic(40, 48, 2, seed=9)
+    ck = str(tmp_path / "ck.npz")
+    model = ModelConfig(num_shards=g, factors_per_shard=2, rho=0.8,
+                        posterior_sd=True)
+    run_short = RunConfig(burnin=10, mcmc=10, thin=2, seed=0, chunk_size=10)
+    run_long = dataclasses.replace(run_short, mcmc=20)
+    fit(Y, FitConfig(model=model, run=run_short, checkpoint_path=ck))
+
+    # rewrite the packed v6 file in the legacy dense v5 layout
+    with np.load(ck) as z:
+        entries = {k: z[k] for k in z.files}
+    meta = json.loads(bytes(entries["__meta__"]).decode())
+    assert meta["version"] == 6
+    rows, cols = packed_pair_indices(g)
+    n_pairs = num_upper_pairs(g)
+    r, c = rows[:n_pairs], cols[:n_pairs]
+    for i in meta["acc_leaf_indices"]:
+        packed = entries[f"leaf_{i}"]
+        assert packed.ndim == 3 and packed.shape[0] == num_padded_pairs(g)
+        P = packed.shape[-1]
+        dense = np.zeros((g, g, P, P), packed.dtype)
+        # mirror first, canonical panels second: the accumulated diagonal
+        # blocks carry ulp-level einsum asymmetry, and the migration must
+        # recover the canonical (untransposed) panel exactly
+        dense[c, r] = packed[:n_pairs].transpose(0, 2, 1)
+        dense[r, c] = packed[:n_pairs]
+        entries[f"leaf_{i}"] = dense
+    meta["version"] = 5
+    # drop the config key v5 never had (RunConfig grew sweep_unroll in v6)
+    meta["config"]["run"].pop("sweep_unroll", None)
+    entries["__meta__"] = np.frombuffer(
+        json.dumps(meta).encode(), dtype=np.uint8)
+    np.savez(ck, **entries)
+
+    resumed = fit(Y, FitConfig(model=model, run=run_long,
+                               checkpoint_path=ck, resume=True))
+    uninterrupted = fit(Y, FitConfig(model=model, run=run_long))
+    np.testing.assert_array_equal(resumed.Sigma, uninterrupted.Sigma)
+    np.testing.assert_array_equal(resumed.Sigma_sd, uninterrupted.Sigma_sd)
+    # ...and the rewritten file is re-saved packed (v6) at the new end
+    from dcfm_tpu.utils.checkpoint import read_checkpoint_meta
+    assert read_checkpoint_meta(ck)["version"] == 6
+
+
+def test_fetch_reads_packed_natively():
+    """The fetched upper panels are exactly the carry's packed panels
+    (padding trimmed, divided by the saved count) - no re-packing hop."""
+    Y, _ = make_synthetic(40, 48, 2, seed=3)
+    res = fit(Y, _fit_cfg())
+    n_pairs = num_upper_pairs(4)
+    assert res.upper_panels.shape == (n_pairs,
+                                      res.upper_panels.shape[1],
+                                      res.upper_panels.shape[2])
+    # stitched blocks are symmetric by construction from the upper panels
+    blocks = res.sigma_blocks
+    np.testing.assert_array_equal(
+        blocks, np.transpose(blocks, (1, 0, 3, 2)))

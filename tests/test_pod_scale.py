@@ -1,10 +1,10 @@
 """Pod-scale shape test (BASELINE.json config 5; VERDICT item 9).
 
 p = 50,176 features as 256 shards on the 8-virtual-device mesh - 32 shards
-per device through the vmap-within-shard_map layout - proving the
-(Gl, G, P, P) row-panel accumulator and both collectives (X-update psum,
-combine all_gather) compile and execute at the scale where the full p x p
-(10 GB f32) could never live on one device.
+per device through the vmap-within-shard_map layout - proving the packed
+upper-panel accumulator (sharded over the pair axis) and both collectives
+(X-update psum, combine all_gather) compile and execute at the scale
+where the full p x p (10 GB f32) could never live on one device.
 
 Marked slow (~5 min, ~29 GB host RAM) and run in a SUBPROCESS: on the
 one-core virtual mesh XLA aborts the whole process if a device thread
@@ -30,8 +30,8 @@ def test_pod_scale_shapes_hold():
     # FULL config-5 width (p = 256*196 = 50,176).  Deterministic even on a
     # one-core host since ModelConfig.combine_chunks bounds each saved
     # draw's collective-free stretch (the demo sets it; 3/3 consecutive
-    # full-width passes measured - BASELINE.md).  ~1.26 GB/device
-    # row-panel accumulators, ~11 GB host RAM.
+    # full-width passes measured - BASELINE.md).  ~0.63 GB/device packed
+    # panel accumulators (half the old dense row-panels), ~6 GB host RAM.
     env["PODDEMO_P"] = "196"
     env["PYTHONPATH"] = os.pathsep.join(
         [_REPO] + [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
