@@ -35,6 +35,71 @@ CHAINS = int(os.environ.get("BENCH_CHAINS", 1))
 BASELINE_SECONDS = 60.0
 
 
+SERVE_QUERIES = int(os.environ.get("BENCH_SERVE_QUERIES", 2000))
+SERVE_CLIENTS = int(os.environ.get("BENCH_SERVE_CLIENTS", 8))
+
+
+def _serve_probe(res):
+    """One serve-phase round: export `res` to a fresh artifact, start the
+    real loopback HTTP server, fire SERVE_QUERIES entry queries from
+    SERVE_CLIENTS client threads, and measure client-side latency.
+    Returns {"qps", "p50_ms", "p99_ms"}."""
+    import json as _json
+    import tempfile
+    import threading
+    import urllib.request
+
+    from dcfm_tpu.serve.server import PosteriorServer
+
+    with tempfile.TemporaryDirectory() as td:
+        art = res.export_artifact(os.path.join(td, "artifact"))
+        srv = PosteriorServer(art, port=0, max_queue=4096,
+                              cache_bytes=512 << 20)
+        try:
+            host, port = srv.start()
+            base = f"http://{host}:{port}"
+            per_client = SERVE_QUERIES // SERVE_CLIENTS
+            lat_ms = [[] for _ in range(SERVE_CLIENTS)]
+            errors = []
+            p = art.p_original
+
+            def client(c):
+                rng = np.random.default_rng(c)
+                for _ in range(per_client):
+                    i, j = rng.integers(0, p, 2)
+                    t0 = time.perf_counter()
+                    try:
+                        with urllib.request.urlopen(
+                                f"{base}/v1/entry?i={i}&j={j}",
+                                timeout=30) as r:
+                            _json.loads(r.read())
+                    except Exception as e:   # counted, fails the probe
+                        errors.append(repr(e))
+                        return
+                    lat_ms[c].append((time.perf_counter() - t0) * 1e3)
+
+            threads = [threading.Thread(target=client, args=(c,))
+                       for c in range(SERVE_CLIENTS)]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            wall = time.perf_counter() - t0
+        finally:
+            srv.close()
+        if errors:
+            # a failing read path must fail the bench LOUDLY, not shrink
+            # the sample set and report a flattering p99 from survivors
+            raise RuntimeError(
+                f"serve probe: {len(errors)} client error(s), first: "
+                f"{errors[0]}")
+        lat = np.concatenate([np.asarray(l) for l in lat_ms])
+        return {"qps": len(lat) / max(wall, 1e-9),
+                "p50_ms": float(np.percentile(lat, 50)),
+                "p99_ms": float(np.percentile(lat, 99))}
+
+
 def main():
     import jax
 
@@ -138,6 +203,18 @@ def main():
             chain_samples.append(fit(Y, cfg).phase_seconds["chain_s"])
     chain_s_med = float(np.median(chain_samples))
 
+    # Serve-phase probe: the READ path gets a perf trajectory like the
+    # fit path has.  Export the timed run's posterior to a fresh memmap
+    # artifact (dcfm_tpu/serve) and storm the real loopback HTTP server
+    # with entry queries; queries/sec and client-side p50/p99 latency,
+    # MEDIAN-of-3 rounds with every sample recorded (same discipline as
+    # chain_s - one contended round must not decide either way).  Host
+    # CPU only: none of this rides the tunnel.
+    serve_rounds = [_serve_probe(res) for _ in range(3)]
+    serve_qps = float(np.median([r["qps"] for r in serve_rounds]))
+    serve_p50 = float(np.median([r["p50_ms"] for r in serve_rounds]))
+    serve_p99 = float(np.median([r["p99_ms"] for r in serve_rounds]))
+
     # ESS/s on the chain traces (utils/diagnostics.ess via
     # FitResult.diagnostics): iterations/sec says nothing about MIXING -
     # a sampler change can keep iters/s and halve the information per
@@ -185,6 +262,15 @@ def main():
         "preprocess_s": round(res.phase_seconds["preprocess_s"], 2),
         "init_s": round(res.phase_seconds["init_s"], 2),
         "tunnel_MBps": round(tunnel_mbps, 2),
+        # Serve-phase (read-path) trajectory: entry queries/sec and
+        # client-side latency against a freshly exported artifact via
+        # the real HTTP server, median of 3 rounds (all samples below).
+        # Host-CPU only - judge round-over-round like assemble_s, not
+        # like fetch_s.
+        "serve_qps": round(serve_qps, 1),
+        "serve_p50_ms": round(serve_p50, 3),
+        "serve_p99_ms": round(serve_p99, 3),
+        "serve_qps_samples": [round(r["qps"], 1) for r in serve_rounds],
     }
     print(json.dumps(result))
     # Regression gates - this script exits non-zero so the driver FAILS on

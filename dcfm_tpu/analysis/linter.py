@@ -831,6 +831,54 @@ def _check_threads(mod: _Module, rep: _Reporter) -> None:
                      "joined; bind it and join before teardown")
 
 
+# socketserver-family classes whose instances hold a listening socket and
+# (for the Threading mixins) spawn handler threads - the lifecycles the
+# DCFM503 shutdown discipline covers.
+_SERVER_CLASSES = {
+    "ThreadingHTTPServer", "HTTPServer", "ThreadingTCPServer", "TCPServer",
+    "ThreadingUDPServer", "UDPServer", "UnixStreamServer",
+    "UnixDatagramServer", "ForkingTCPServer", "ForkingUDPServer",
+}
+
+
+def _check_servers(mod: _Module, rep: _Reporter) -> None:
+    """DCFM503: server lifecycles without shutdown()/server_close() on the
+    exit path.  Module-granular like DCFM502: a ``serve_forever()`` needs
+    a ``.shutdown()`` somewhere (it is the only thing that stops the
+    accept loop), and a constructed server needs a ``.server_close()``
+    (or a with-statement, whose __exit__ closes the socket)."""
+    has_shutdown = has_close = False
+    with_ctx: set = set()
+    for n in ast.walk(mod.tree):
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute):
+            if n.func.attr == "shutdown":
+                has_shutdown = True
+            elif n.func.attr == "server_close":
+                has_close = True
+        if isinstance(n, ast.With):
+            for item in n.items:
+                if isinstance(item.context_expr, ast.Call):
+                    with_ctx.add(id(item.context_expr))
+    for n in ast.walk(mod.tree):
+        if not isinstance(n, ast.Call):
+            continue
+        if (isinstance(n.func, ast.Attribute)
+                and n.func.attr == "serve_forever" and not has_shutdown):
+            rep.emit("DCFM503", n,
+                     "serve_forever() in a module with no .shutdown() "
+                     "call - nothing can ever stop the accept loop; put "
+                     "shutdown() on the exit path (from another thread)")
+        base = _last(mod.resolve(n.func))
+        if (base in _SERVER_CLASSES and id(n) not in with_ctx
+                and not has_close):
+            rep.emit("DCFM503", n,
+                     f"{base} constructed in a module with no "
+                     ".server_close() call and outside a with-statement - "
+                     "the listening socket (and any handler threads) "
+                     "outlive interpreter teardown; close it on the exit "
+                     "path")
+
+
 # =====================================================================
 # driver
 # =====================================================================
@@ -848,6 +896,7 @@ def lint_source(source: str, path: str = "<string>") -> list:
     _check_dtype_module(mod, rep)
     _check_ffi(mod, rep)
     _check_threads(mod, rep)
+    _check_servers(mod, rep)
     rep.findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return rep.findings
 

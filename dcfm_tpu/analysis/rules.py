@@ -83,4 +83,11 @@ RULES = {r.id: r for r in [
          "Thread started as a temporary (threading.Thread(...).start()) "
          "or in a module with no .join() anywhere - nothing can join it "
          "before interpreter teardown"),
+    Rule("DCFM503", "server-without-shutdown", "thread",
+         "a socketserver/http.server lifecycle with no exit path: "
+         "serve_forever() called in a module that never calls "
+         ".shutdown(), or a ThreadingHTTPServer/TCPServer-style server "
+         "constructed (outside a with-statement) in a module that never "
+         "calls .server_close() - its worker threads and socket outlive "
+         "teardown, the DCFM501 SIGABRT class"),
 ]}

@@ -57,6 +57,15 @@ from dcfm_tpu.utils.preprocess import (
 
 @dataclasses.dataclass
 class FitResult:
+    """A completed fit: the posterior in the caller's coordinates.
+
+    The posterior dies with this process unless exported:
+    :meth:`export_artifact` writes a durable, memory-mapped artifact the
+    serving subsystem (``dcfm_tpu/serve``, ``dcfm-tpu serve``) opens in
+    milliseconds and answers entry/block/interval queries over without
+    re-assembling the dense matrix - see README "Serving the posterior".
+    """
+
     Sigma: np.ndarray              # (p, p) posterior-mean covariance in the
                                    # caller's coordinates (de-permuted,
                                    # de-standardized, zero cols reinserted)
@@ -219,6 +228,15 @@ class FitResult:
             q = np.quantile(vals, [alpha / 2, 1.0 - alpha / 2], axis=0)
             lo[valid], hi[valid] = q[0], q[1]
         return lo.reshape(shape), hi.reshape(shape)
+
+    def export_artifact(self, path: str):
+        """Write the durable serving artifact (serve/artifact.py): the
+        int8 posterior panels (+ SD panels when accumulated), per-panel
+        scales, and the preprocess maps, memmap-loadable by
+        ``dcfm-tpu serve`` with no refit and no dense Sigma.  Returns
+        the opened :class:`~dcfm_tpu.serve.artifact.PosteriorArtifact`."""
+        from dcfm_tpu.serve.artifact import export_fit_result
+        return export_fit_result(self, path)
 
     def posterior_sd(self, *, destandardize=True, reinsert_zero_cols=False):
         """Entrywise posterior SD with the same coordinate options as

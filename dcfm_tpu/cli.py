@@ -47,6 +47,55 @@ def build_parser() -> argparse.ArgumentParser:
              "crash (SIGABRT/SIGSEGV) fails one file instead of the "
              "whole suite")
 
+    # Posterior-serving subsystem (dcfm_tpu/serve; README "Serving the
+    # posterior"): export a completed fit to a memory-mapped artifact,
+    # then serve entry/block/interval queries over HTTP.
+    e = sub.add_parser(
+        "export", help="export a posterior to a servable memmap artifact "
+        "(from a fresh fit, or from an existing v6 checkpoint - no refit)")
+    e.add_argument("data", help="observations, (n, p) .npy or .csv (for "
+                   "--from-checkpoint this is the SAME data the "
+                   "checkpointed chain ran on; the fingerprint is checked)")
+    e.add_argument("--out", "-o", required=True,
+                   help="artifact directory to write")
+    e.add_argument("--from-checkpoint", default=None, metavar="PATH",
+                   help="export from this v6 checkpoint (plain file or "
+                        ".procK-of-N set) instead of running a fit")
+    e.add_argument("--shards", "-g", type=int, default=0,
+                   help="feature shards g (fit-and-export mode)")
+    e.add_argument("--factors", "-k", type=int, default=0,
+                   help="TOTAL latent factors k (fit-and-export mode)")
+    e.add_argument("--burnin", type=int, default=1000)
+    e.add_argument("--mcmc", type=int, default=1000)
+    e.add_argument("--thin", type=int, default=1)
+    e.add_argument("--rho", type=float, default=0.9)
+    e.add_argument("--prior", default="mgp",
+                   choices=["mgp", "horseshoe", "dl"])
+    e.add_argument("--posterior-sd", action="store_true",
+                   help="also accumulate + export entrywise posterior-SD "
+                        "panels (enables /v1/interval on the server)")
+    e.add_argument("--seed", type=int, default=0)
+
+    s = sub.add_parser(
+        "serve", help="serve a posterior artifact over HTTP "
+        "(/v1/entry /v1/block /v1/interval /healthz /metrics); "
+        "drains gracefully on SIGTERM")
+    s.add_argument("artifact", help="artifact directory (dcfm-tpu export)")
+    s.add_argument("--host", default="127.0.0.1")
+    s.add_argument("--port", type=int, default=8080,
+                   help="TCP port; 0 picks a free port (printed on stdout)")
+    s.add_argument("--cache-mb", type=int, default=256,
+                   help="byte budget of the dequantized-panel LRU cache")
+    s.add_argument("--max-queue", type=int, default=1024,
+                   help="bounded entry-query queue; a full queue rejects "
+                        "with 429 + retry (backpressure, never unbounded "
+                        "growth)")
+    s.add_argument("--max-batch", type=int, default=256,
+                   help="max entry queries coalesced into one batch")
+    s.add_argument("--request-timeout", type=float, default=2.0,
+                   help="per-request deadline (seconds); queued requests "
+                        "past it fail 504 instead of being served late")
+
     f = sub.add_parser("fit", help="fit the model and write Sigma-hat")
     f.add_argument("data", help="observations, (n, p) .npy or .csv")
     f.add_argument("--shards", "-g", type=int, required=True,
@@ -174,6 +223,15 @@ def main(argv=None) -> int:
         from dcfm_tpu.analysis.isolate import main as isolate_main
         return isolate_main(raw[1:])
     args = build_parser().parse_args(argv)
+    # serve/export dispatch before the jax-heavy fit imports: serving an
+    # existing artifact needs no accelerator stack at all, and export's
+    # jax use (checkpoint template) is loaded lazily inside it.
+    if args.command == "serve":
+        from dcfm_tpu.serve.server import serve_main
+        return serve_main(args)
+    if args.command == "export":
+        from dcfm_tpu.serve.artifact import export_main
+        return export_main(args)
     from dcfm_tpu.config import (
         BackendConfig, FitConfig, ModelConfig, RunConfig)
     from dcfm_tpu.api import fit

@@ -1,0 +1,31 @@
+"""Posterior-serving subsystem: fit once, serve forever.
+
+Turns a completed fit into a durable, memory-mapped artifact and serves
+entry/block/interval queries over it concurrently - see README
+"Serving the posterior".  Layering (each importable without jax):
+
+* :mod:`dcfm_tpu.serve.artifact` - versioned on-disk format, export from
+  a ``FitResult`` or a v6 checkpoint, ``np.memmap`` zero-copy open;
+* :mod:`dcfm_tpu.serve.engine` - panel-LRU query engine, bitwise-equal
+  to the offline assembler;
+* :mod:`dcfm_tpu.serve.batcher` - panel-coalescing microbatcher with a
+  bounded queue and explicit backpressure;
+* :mod:`dcfm_tpu.serve.server` - stdlib JSON HTTP API with latency
+  histograms, cache metrics, and graceful SIGTERM drain.
+"""
+
+from dcfm_tpu.serve.artifact import (
+    ARTIFACT_VERSION, ArtifactError, ArtifactVersionError,
+    PosteriorArtifact, create_sparse_artifact, export_fit_result,
+    export_from_checkpoint, quantize_panels, write_artifact)
+from dcfm_tpu.serve.batcher import DeadlineExceeded, Overloaded, QueryBatcher
+from dcfm_tpu.serve.engine import PanelCache, QueryEngine
+from dcfm_tpu.serve.server import PosteriorServer
+
+__all__ = [
+    "ARTIFACT_VERSION", "ArtifactError", "ArtifactVersionError",
+    "PosteriorArtifact", "create_sparse_artifact", "export_fit_result",
+    "export_from_checkpoint", "quantize_panels", "write_artifact",
+    "QueryEngine", "PanelCache", "QueryBatcher", "Overloaded",
+    "DeadlineExceeded", "PosteriorServer",
+]

@@ -1,0 +1,552 @@
+"""Durable, memory-mapped posterior artifact: fit once, serve forever.
+
+A fit ends at :class:`~dcfm_tpu.api.FitResult`, whose posterior lives in
+the Python process that ran the chain; every consumer question after that
+("what is Sigma[i, j]?  a credible interval?  a sub-block?") re-pays
+dequantization and assembly of a p x p object that at p=50k does not even
+fit in RAM.  This module turns a completed fit into an on-disk artifact
+the serving layer (serve/engine.py) opens in milliseconds and pages on
+demand:
+
+* the packed ``g(g+1)/2`` int8 upper-triangle covariance panels in the
+  SAME canonical triu order the device accumulates and the native
+  assembler consumes (``models.state.packed_pair_indices`` minus padding),
+  quantized with the SAME max-abs rule as the device fetch
+  (``api._cast_for_link``), as a raw binary opened zero-copy via
+  ``np.memmap``;
+* the per-panel float32 scales;
+* the entrywise posterior-SD panels (when the fit accumulated them), same
+  layout;
+* the preprocess metadata needed to answer queries in the CALLER's
+  coordinates: per-column standardization scales, the shard permutation
+  inverse, and the kept/zero-column maps.
+
+Two export sources, no refit either way:
+
+* :func:`export_fit_result` - straight from a ``FitResult`` (the int8
+  panels are reused as-is under the default quant8 fetch; float panels
+  are quantized host-side with the identical rule);
+* :func:`export_from_checkpoint` - from a v6 checkpoint file or
+  ``.procK-of-N`` set plus the original data matrix (preprocessing is
+  deterministic given the seed; the checkpoint's data fingerprint is
+  verified before anything is written).
+
+Layout (a directory; ``meta.json`` is written LAST so a half-written
+artifact fails to open instead of serving garbage)::
+
+    artifact/
+      mean_q8.bin   int8  (n_pairs, P, P) C-order  - memmapped
+      sd_q8.bin     int8  (n_pairs, P, P) C-order  - memmapped, optional
+      maps.npz      per-panel scales + preprocess maps (O(p), loaded whole)
+      meta.json     format tag, version, shape, provenance
+
+Everything in this module is NumPy + stdlib; jax is imported lazily and
+only by the checkpoint export path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Optional
+
+import numpy as np
+
+from dcfm_tpu.utils.preprocess import PreprocessResult
+
+ARTIFACT_FORMAT = "dcfm-posterior-artifact"
+ARTIFACT_VERSION = 1
+
+META_FILE = "meta.json"
+MAPS_FILE = "maps.npz"
+MEAN_PANELS_FILE = "mean_q8.bin"
+SD_PANELS_FILE = "sd_q8.bin"
+
+
+class ArtifactError(ValueError):
+    """Malformed / unreadable artifact (missing files, size mismatch)."""
+
+
+class ArtifactVersionError(ArtifactError):
+    """Artifact format version this library cannot serve."""
+
+
+def _num_pairs(g: int) -> int:
+    return g * (g + 1) // 2
+
+
+def quantize_panels(upper: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Host-side twin of the device quant8 cast (``api._cast_for_link``).
+
+    Max-abs int8 per panel: one float32 scale per P x P block,
+    ``q = round(u * 127/scale)``.  Same float32 operation order and the
+    same round-half-even as the jitted fetch, so an artifact exported
+    from a checkpoint's raw accumulator is bitwise-identical to one
+    exported from the quant8-fetched ``FitResult`` of the same chain.
+    """
+    upper = np.ascontiguousarray(upper, np.float32)
+    scale = np.max(np.abs(upper), axis=(1, 2)).astype(np.float32)
+    safe = np.where(scale > 0, scale, np.float32(1.0)).astype(np.float32)
+    q = np.round(upper * (np.float32(127.0) / safe)[:, None, None]).astype(
+        np.int8)
+    return q, scale
+
+
+@dataclasses.dataclass
+class PosteriorArtifact:
+    """An opened artifact: memmapped panels + in-RAM O(p) maps.
+
+    ``mean_panels`` / ``sd_panels`` are ``np.memmap`` views - opening a
+    p=50k posterior costs milliseconds and no panel bytes are read until
+    a query touches them.  ``pre`` is a shape-only
+    :class:`~dcfm_tpu.utils.preprocess.PreprocessResult` (its ``data``
+    leaf is an empty (g, 0, P) array) that plugs straight into the
+    existing coordinate machinery (``caller_to_shard_index``,
+    ``assembly_maps``, ``restore_covariance``).
+    """
+
+    path: str
+    meta: dict
+    g: int
+    P: int
+    n_pairs: int
+    p_original: int
+    n_pad: int
+    has_sd: bool
+    mean_panels: np.ndarray            # (n_pairs, P, P) int8 memmap
+    mean_scale: np.ndarray             # (n_pairs,) float32
+    sd_panels: Optional[np.ndarray]    # (n_pairs, P, P) int8 memmap or None
+    sd_scale: Optional[np.ndarray]
+    pre: PreprocessResult
+
+    @property
+    def p_used(self) -> int:
+        return self.g * self.P
+
+    @classmethod
+    def open(cls, path: str) -> "PosteriorArtifact":
+        meta_path = os.path.join(path, META_FILE)
+        if not os.path.exists(meta_path):
+            raise ArtifactError(
+                f"{path} is not a posterior artifact (no {META_FILE}; "
+                "a crash mid-export leaves the metadata unwritten - "
+                "re-export)")
+        with open(meta_path, "r", encoding="utf-8") as f:
+            meta = json.load(f)
+        if meta.get("format") != ARTIFACT_FORMAT:
+            raise ArtifactError(
+                f"{path}: unrecognized artifact format "
+                f"{meta.get('format')!r} (expected {ARTIFACT_FORMAT!r})")
+        if meta.get("version") != ARTIFACT_VERSION:
+            raise ArtifactVersionError(
+                f"{path}: artifact format v{meta.get('version')} != "
+                f"v{ARTIFACT_VERSION} supported by this library - "
+                "re-export the artifact (or upgrade dcfm_tpu to a version "
+                "that reads it)")
+        g, P = int(meta["g"]), int(meta["P"])
+        n_pairs = _num_pairs(g)
+        with np.load(os.path.join(path, MAPS_FILE)) as z:
+            mean_scale = np.ascontiguousarray(z["mean_scale"], np.float32)
+            sd_scale = (np.ascontiguousarray(z["sd_scale"], np.float32)
+                        if "sd_scale" in z.files else None)
+            col_scale = np.ascontiguousarray(z["col_scale"], np.float32)
+            col_mean = np.ascontiguousarray(z["col_mean"], np.float32)
+            perm = np.ascontiguousarray(z["perm"], np.int64)
+            inv_perm = np.ascontiguousarray(z["inv_perm"], np.int64)
+            kept_cols = np.ascontiguousarray(z["kept_cols"], np.int64)
+        if mean_scale.shape != (n_pairs,):
+            raise ArtifactError(
+                f"{path}: mean_scale shape {mean_scale.shape} != "
+                f"({n_pairs},) for g={g}")
+        mean_panels = cls._open_panels(path, MEAN_PANELS_FILE, n_pairs, P)
+        has_sd = bool(meta.get("has_sd"))
+        sd_panels = (cls._open_panels(path, SD_PANELS_FILE, n_pairs, P)
+                     if has_sd else None)
+        if has_sd and (sd_scale is None or sd_scale.shape != (n_pairs,)):
+            raise ArtifactError(f"{path}: has_sd but sd_scale missing or "
+                                "mis-shaped in maps.npz")
+        p_original = int(meta["p_original"])
+        n_pad = int(meta["n_pad"])
+        zero_cols = np.setdiff1d(np.arange(p_original, dtype=np.int64),
+                                 kept_cols)
+        pre = PreprocessResult(
+            data=np.empty((g, 0, P), np.float32),   # shape-only
+            perm=perm, inv_perm=inv_perm,
+            col_mean=col_mean, col_scale=col_scale,
+            kept_cols=kept_cols, zero_cols=zero_cols,
+            n_pad=n_pad, p_original=p_original)
+        return cls(path=path, meta=meta, g=g, P=P, n_pairs=n_pairs,
+                   p_original=p_original, n_pad=n_pad, has_sd=has_sd,
+                   mean_panels=mean_panels, mean_scale=mean_scale,
+                   sd_panels=sd_panels, sd_scale=sd_scale, pre=pre)
+
+    @staticmethod
+    def _open_panels(path: str, name: str, n_pairs: int, P: int):
+        fp = os.path.join(path, name)
+        if not os.path.exists(fp):
+            raise ArtifactError(f"{path}: missing panel file {name}")
+        want = n_pairs * P * P
+        have = os.path.getsize(fp)
+        if have != want:
+            raise ArtifactError(
+                f"{path}/{name}: {have} bytes != expected {want} "
+                f"(n_pairs={n_pairs}, P={P}) - truncated or mismatched "
+                "artifact")
+        return np.memmap(fp, dtype=np.int8, mode="r",
+                         shape=(n_pairs, P, P))
+
+    def panels(self, kind: str) -> tuple[np.ndarray, np.ndarray]:
+        """(panels memmap, per-panel scales) for ``kind`` in mean|sd."""
+        if kind == "mean":
+            return self.mean_panels, self.mean_scale
+        if kind == "sd":
+            if self.sd_panels is None:
+                raise ArtifactError(
+                    "artifact has no posterior-SD panels (export a fit run "
+                    "with ModelConfig(posterior_sd=True))")
+            return self.sd_panels, self.sd_scale
+        raise ValueError(f"unknown panel kind {kind!r} (mean | sd)")
+
+    def assemble(self, *, kind: str = "mean", destandardize: bool = True,
+                 reinsert_zero_cols: bool = True) -> np.ndarray:
+        """OFFLINE full assembly of the dense matrix - the ground truth
+        every served answer is tested bitwise against
+        (``utils.estimate.assemble_from_q8``; NumPy fallback when the
+        native library is unavailable).  The fallback de-standardizes
+        with the native q8 kernel's per-entry order - the two column
+        scales combine first, then one multiply,
+        ``v * (s_row * s_col)`` - so the ground truth is the same bits
+        with or without the native assembler.  Materializes (p, p); use
+        the query engine for the serving path."""
+        from dcfm_tpu.utils.estimate import (
+            assemble_from_q8, dequantize_panels, full_blocks_from_upper,
+            stitch_blocks)
+        from dcfm_tpu.utils.preprocess import restore_covariance
+        q, s = self.panels(kind)
+        q = np.ascontiguousarray(q)
+        out = assemble_from_q8(q, s, self.pre, destandardize=destandardize,
+                               reinsert_zero_cols=reinsert_zero_cols)
+        if out is not None:
+            return out
+        S = stitch_blocks(
+            full_blocks_from_upper(dequantize_panels(q, s), self.g),
+            symmetrize=False)
+        if destandardize:
+            sf = self.pre.col_scale.reshape(-1).astype(np.float32)
+            S = S * (sf[:, None] * sf[None, :])
+        return restore_covariance(S, self.pre, destandardize=False,
+                                  reinsert_zero_cols=reinsert_zero_cols)
+
+
+def _write_panels(path: str, name: str, q: np.ndarray) -> None:
+    with open(os.path.join(path, name), "wb") as f:
+        np.ascontiguousarray(q, np.int8).tofile(f)
+
+
+def write_artifact(
+    path: str,
+    *,
+    mean_q8: np.ndarray,
+    mean_scale: np.ndarray,
+    pre: PreprocessResult,
+    sd_q8: Optional[np.ndarray] = None,
+    sd_scale: Optional[np.ndarray] = None,
+    provenance: Optional[dict] = None,
+) -> PosteriorArtifact:
+    """Write a v1 artifact directory from already-quantized panels.
+
+    ``meta.json`` is INVALIDATED first and written last: a crash
+    mid-export leaves a directory :meth:`PosteriorArtifact.open` refuses
+    cleanly, never fresh panel bytes behind a stale-but-healthy metadata
+    entry (the re-export-over-an-existing-artifact case) or a truncated
+    panel file behind a new one.
+    """
+    n_pairs, P, P2 = np.shape(mean_q8)
+    g = pre.num_shards
+    if P != P2 or n_pairs != _num_pairs(g):
+        raise ValueError(
+            f"mean panels {np.shape(mean_q8)} are not the full "
+            f"g(g+1)/2={_num_pairs(g)} upper-triangle set for g={g}")
+    if g * P != pre.p_used:
+        raise ValueError(f"g={g} panels of width {P} != p_used {pre.p_used}")
+    if np.shape(mean_scale) != (n_pairs,):
+        raise ValueError(f"mean_scale must be ({n_pairs},), got "
+                         f"{np.shape(mean_scale)}")
+    if (sd_q8 is None) != (sd_scale is None):
+        raise ValueError("sd_q8 and sd_scale must be passed together")
+    os.makedirs(path, exist_ok=True)
+    # re-export over an existing artifact: drop the old meta BEFORE any
+    # payload write, so every partially-written state is unopenable
+    meta_path = os.path.join(path, META_FILE)
+    if os.path.exists(meta_path):
+        os.unlink(meta_path)
+    if sd_q8 is None and os.path.exists(os.path.join(path, SD_PANELS_FILE)):
+        os.unlink(os.path.join(path, SD_PANELS_FILE))   # stale SD panels
+    _write_panels(path, MEAN_PANELS_FILE, mean_q8)
+    maps = dict(
+        mean_scale=np.asarray(mean_scale, np.float32),
+        col_scale=np.asarray(pre.col_scale, np.float32),
+        col_mean=np.asarray(pre.col_mean, np.float32),
+        perm=np.asarray(pre.perm, np.int64),
+        inv_perm=np.asarray(pre.inv_perm, np.int64),
+        kept_cols=np.asarray(pre.kept_cols, np.int64),
+    )
+    if sd_q8 is not None:
+        if np.shape(sd_q8) != (n_pairs, P, P):
+            raise ValueError(f"sd panels {np.shape(sd_q8)} != mean panels "
+                             f"({n_pairs}, {P}, {P})")
+        _write_panels(path, SD_PANELS_FILE, sd_q8)
+        maps["sd_scale"] = np.asarray(sd_scale, np.float32)
+    np.savez(os.path.join(path, MAPS_FILE), **maps)
+    meta = {
+        "format": ARTIFACT_FORMAT,
+        "version": ARTIFACT_VERSION,
+        "g": int(g),
+        "P": int(P),
+        "p_original": int(pre.p_original),
+        "n_pad": int(pre.n_pad),
+        "has_sd": sd_q8 is not None,
+        "provenance": provenance or {},
+    }
+    tmp = os.path.join(path, META_FILE + ".tmp")
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(meta, f, indent=1)
+    os.replace(tmp, os.path.join(path, META_FILE))
+    return PosteriorArtifact.open(path)
+
+
+def create_sparse_artifact(path: str, *, g: int, P: int,
+                           has_sd: bool = False) -> str:
+    """Synthesize an artifact with ZERO-filled sparse panel files.
+
+    The panel files are created with ``truncate`` (filesystem holes), so a
+    p=50k-scale artifact costs kilobytes of actual disk and opens in
+    milliseconds - used for serving capacity tests and demos; real panel
+    bytes can be patched in afterwards with ``np.memmap(mode='r+')``.
+    Scales default to 1, maps to identity, standardization to none.
+    """
+    n_pairs = _num_pairs(g)
+    p_used = g * P
+    os.makedirs(path, exist_ok=True)
+    names = [MEAN_PANELS_FILE] + ([SD_PANELS_FILE] if has_sd else [])
+    for name in names:
+        with open(os.path.join(path, name), "wb") as f:
+            f.truncate(n_pairs * P * P)
+    maps = dict(
+        mean_scale=np.ones(n_pairs, np.float32),
+        col_scale=np.ones((g, P), np.float32),
+        col_mean=np.zeros((g, P), np.float32),
+        perm=np.arange(p_used, dtype=np.int64),
+        inv_perm=np.arange(p_used, dtype=np.int64),
+        kept_cols=np.arange(p_used, dtype=np.int64),
+    )
+    if has_sd:
+        maps["sd_scale"] = np.ones(n_pairs, np.float32)
+    np.savez(os.path.join(path, MAPS_FILE), **maps)
+    meta = {
+        "format": ARTIFACT_FORMAT, "version": ARTIFACT_VERSION,
+        "g": int(g), "P": int(P), "p_original": int(p_used), "n_pad": 0,
+        "has_sd": bool(has_sd), "provenance": {"source": "synthesized"},
+    }
+    with open(os.path.join(path, META_FILE), "w", encoding="utf-8") as f:
+        json.dump(meta, f, indent=1)
+    return path
+
+
+def export_fit_result(res, path: str) -> PosteriorArtifact:
+    """Export a :class:`~dcfm_tpu.api.FitResult` - no refit, no dense
+    Sigma.  Under the default quant8 fetch the device's int8 panels and
+    scales are written as-is (the artifact is then bitwise the fetch);
+    full-precision fetches are quantized host-side with the identical
+    max-abs rule.  Posterior-SD panels ride along when the fit
+    accumulated them (``ModelConfig(posterior_sd=True)``)."""
+    if res._q8_panels is not None:
+        mean_q8 = np.asarray(res._q8_panels)
+        mean_scale = np.asarray(res._q8_scales, np.float32)
+    else:
+        mean_q8, mean_scale = quantize_panels(res.upper_panels)
+    sd_q8 = sd_scale = None
+    if res._sd_q8_panels is not None:
+        sd_q8 = np.asarray(res._sd_q8_panels)
+        sd_scale = np.asarray(res._sd_q8_scales, np.float32)
+    elif res.sd_upper_panels is not None:
+        sd_q8, sd_scale = quantize_panels(res.sd_upper_panels)
+    m, run = res.config.model, res.config.run
+    provenance = {
+        "source": "fit",
+        "num_shards": m.num_shards,
+        "factors_per_shard": m.factors_per_shard,
+        "prior": m.prior,
+        "estimator": m.estimator,
+        "seed": run.seed,
+        "total_iters": run.total_iters,
+    }
+    return write_artifact(path, mean_q8=mean_q8, mean_scale=mean_scale,
+                          pre=res.preprocess, sd_q8=sd_q8,
+                          sd_scale=sd_scale, provenance=provenance)
+
+
+def export_from_checkpoint(checkpoint_path: str, Y: np.ndarray,
+                           path: str) -> PosteriorArtifact:
+    """Export straight from a v6 checkpoint - NO refit.
+
+    The checkpoint stores the raw packed accumulator sums plus the
+    FitConfig and a fingerprint of the sharded data; preprocessing is
+    deterministic given the seed, so ``Y`` (the original data matrix)
+    is re-preprocessed here and the fingerprint verified before anything
+    is written.  The posterior mean and its quantization replicate the
+    device fetch's float32 operation order exactly (``api._fetch_jit``),
+    so the MEAN panels of a checkpoint-sourced artifact match a
+    FitResult-sourced one bit for bit.  The SD panels agree to within
+    one int8 quantization step: XLA fuses the on-device moment
+    difference ``m2 - mean*mean`` (FMA), which this host replay cannot
+    reproduce bit-exactly (~1e-6 relative, far below the quant step).
+
+    Accepts a plain checkpoint file or a ``.procK-of-N`` multi-process
+    set.  A state-only (light) checkpoint carries no accumulators; its
+    ``.full`` sidecar (``checkpoint_full_every``) is used when present,
+    otherwise the export refuses with a clear error.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from dcfm_tpu.api import _local_fns
+    from dcfm_tpu.models.sampler import num_saved_draws
+    from dcfm_tpu.models.state import num_upper_pairs
+    from dcfm_tpu.utils.checkpoint import (
+        config_from_checkpoint_meta, data_fingerprint, discover_checkpoint,
+        load_checkpoint, load_checkpoint_resharded, read_checkpoint_meta)
+    from dcfm_tpu.utils.preprocess import preprocess
+
+    def _resolve(p):
+        source = discover_checkpoint(p, prefer_plain=True)
+        if source is None:
+            raise FileNotFoundError(
+                f"no checkpoint at {p} (or any .procK-of-N set)")
+        kind, found = source
+        meta = read_checkpoint_meta(p if kind == "plain" else found[1][0])
+        return kind, found, meta
+
+    kind, found, meta = _resolve(checkpoint_path)
+    if meta.get("state_only"):
+        side = checkpoint_path + ".full"
+        # only a genuinely ABSENT sidecar falls back to the friendly
+        # refusal; a present-but-corrupt .full must surface its own read
+        # error, not masquerade as "no sidecar exists"
+        try:
+            kind, found, meta = _resolve(side)
+        except FileNotFoundError:
+            meta = {"state_only": True}
+        if meta.get("state_only"):
+            raise ArtifactError(
+                f"{checkpoint_path} is a state-only (light) checkpoint: it "
+                "stores no covariance accumulators and no .full sidecar "
+                "exists - export from a full checkpoint "
+                "(checkpoint_mode='full' or checkpoint_full_every)")
+        checkpoint_path = side
+
+    cfg = config_from_checkpoint_meta(meta)
+    m, run = cfg.model, cfg.run
+    pre = preprocess(np.asarray(Y), m.num_shards, permute=cfg.permute,
+                     standardize=cfg.standardize,
+                     pad_to_shards=cfg.pad_to_shards, seed=run.seed)
+    fp = data_fingerprint(pre.data)
+    if meta["fingerprint"] != fp:
+        raise ArtifactError(
+            "checkpoint data fingerprint mismatch - the data matrix passed "
+            "to export is not the one the checkpointed chain ran on")
+
+    C = run.num_chains
+    S_draws = run.num_saved if run.store_draws else 0
+    init_fn = _local_fns(m, 1, C, S_draws, 1)[0]
+    template = jax.eval_shape(
+        init_fn, jax.random.key(0),
+        jax.ShapeDtypeStruct(pre.data.shape, jnp.float32))
+    if kind == "plain":
+        carry, meta = load_checkpoint(checkpoint_path, template)
+    else:
+        carry, meta = load_checkpoint_resharded(found[1], template)
+
+    it = int(meta["iteration"])
+    acc0 = int(meta.get("acc_start", 0))
+    n_saved = (num_saved_draws(it, run.burnin, run.thin)
+               - num_saved_draws(acc0, run.burnin, run.thin))
+    if n_saved <= 0:
+        raise ArtifactError(
+            f"checkpoint at iteration {it} has no saved draws in its "
+            "accumulation window - nothing to export (burn-in only, or a "
+            "light resume restarted the window)")
+    n_pairs = num_upper_pairs(m.num_shards)
+    inv_count = np.float32(1.0 / max(n_saved, 1))
+
+    def _mean_panels(acc):
+        acc = np.asarray(acc, np.float32)
+        if C > 1:
+            acc = acc.mean(axis=0)
+        return acc[:n_pairs] * inv_count
+
+    mean = _mean_panels(carry.sigma_acc)
+    mean_q8, mean_scale = quantize_panels(mean)
+    sd_q8 = sd_scale = None
+    if getattr(carry, "sigma_sq_acc", None) is not None:
+        m2 = _mean_panels(carry.sigma_sq_acc)
+        n_draws = max(n_saved * C, 1)
+        bessel = np.float32(n_draws / (n_draws - 1) if n_draws > 1 else 1.0)
+        sd = np.sqrt(np.maximum(m2 - mean * mean, np.float32(0.0)) * bessel)
+        sd_q8, sd_scale = quantize_panels(sd)
+    provenance = {
+        "source": "checkpoint",
+        "checkpoint": os.path.abspath(checkpoint_path),
+        "iteration": it,
+        "n_saved": int(n_saved),
+        "num_chains": C,
+        "num_shards": m.num_shards,
+        "factors_per_shard": m.factors_per_shard,
+        "prior": m.prior,
+        "estimator": m.estimator,
+        "seed": run.seed,
+    }
+    return write_artifact(path, mean_q8=mean_q8, mean_scale=mean_scale,
+                          pre=pre, sd_q8=sd_q8, sd_scale=sd_scale,
+                          provenance=provenance)
+
+
+def export_main(args) -> int:
+    """``dcfm-tpu export`` entry point (argparse Namespace from cli.py)."""
+    from dcfm_tpu.cli import _load
+    Y = _load(args.data)
+    if args.from_checkpoint:
+        art = export_from_checkpoint(args.from_checkpoint, Y, args.out)
+    else:
+        if not args.shards or not args.factors:
+            raise SystemExit(
+                "export without --from-checkpoint runs a fit: --shards and "
+                "--factors are required")
+        if args.factors % args.shards:
+            raise SystemExit(
+                f"--factors {args.factors} must be divisible by --shards "
+                f"{args.shards}")
+        from dcfm_tpu.api import fit
+        from dcfm_tpu.config import (
+            BackendConfig, FitConfig, ModelConfig, RunConfig)
+        cfg = FitConfig(
+            model=ModelConfig(
+                num_shards=args.shards,
+                factors_per_shard=args.factors // args.shards,
+                rho=args.rho, prior=args.prior,
+                posterior_sd=args.posterior_sd),
+            run=RunConfig(burnin=args.burnin, mcmc=args.mcmc,
+                          thin=args.thin, seed=args.seed),
+            backend=BackendConfig(fetch_dtype="quant8"),
+        )
+        art = export_fit_result(fit(Y, cfg), args.out)
+    size = sum(
+        os.path.getsize(os.path.join(args.out, f))
+        for f in os.listdir(args.out))
+    print(json.dumps({
+        "out": args.out, "g": art.g, "P": art.P, "p": art.p_original,
+        "has_sd": art.has_sd, "bytes": int(size),
+        "source": art.meta["provenance"].get("source"),
+    }))
+    return 0

@@ -1,0 +1,279 @@
+"""Concurrent posterior query engine over a memory-mapped artifact.
+
+Answers entry / sub-block / row queries, posterior SD, and normal-
+approximation credible intervals WITHOUT ever materializing the dense
+(p, p) matrix: each query dequantizes only the int8 panels it touches,
+through a byte-budgeted LRU panel cache, and applies de-standardization
+and zero-column reinsertion per query.
+
+Bitwise contract: every value this engine serves is equal, bit for bit,
+to the corresponding entry of the OFFLINE assembly of the same artifact
+(``utils.estimate.assemble_from_q8`` and its NumPy fallback - the two
+are themselves bit-compatible by construction).  That pins the exact
+float32 operation order per entry:
+
+1. dequantize: ``v = float32(q) * (float32(panel_scale) / 127.0)``,
+2. diagonal-pair panels are symmetrized ``0.5 * (B + B')`` (the float
+   asymmetry of the einsum accumulation order - the offline assembler
+   does the same, ``utils.estimate.full_blocks_from_upper``),
+3. de-standardize: ``v * (s[row] * s[col])`` - the two column scales
+   combine FIRST, then one multiply, which is the native q8 kernel's
+   per-entry order (measured: ``restore_covariance``'s two-pass sweep
+   ``(v * s_row) * s_col`` differs from it by 1 ULP on ~40% of
+   entries; ``PosteriorArtifact.assemble``'s no-native fallback uses
+   the same combined-scale order so the ground truth is unique).
+
+Queries take CALLER-coordinate column indices (the same coordinates as
+``FitResult.Sigma`` with zero columns reinserted): entries involving a
+dropped all-zero input column are identically 0.  Thread-safe: the panel
+cache takes a lock; panel reads from the memmap are read-only.
+"""
+
+from __future__ import annotations
+
+import collections
+import math
+import threading
+
+import numpy as np
+
+from dcfm_tpu.serve.artifact import PosteriorArtifact
+from dcfm_tpu.utils.preprocess import caller_to_shard_index
+
+
+class PanelCache:
+    """Byte-budgeted LRU over dequantized float32 panels.
+
+    Keys are ``(kind, pair_index)``; values are the ready-to-serve
+    float32 panels (diagonal pairs already symmetrized).  Eviction is
+    LRU by total byte footprint, and the hit/miss/eviction counters are
+    exported on /metrics - a serving fleet sizes its cache from them.
+    """
+
+    def __init__(self, budget_bytes: int):
+        self.budget_bytes = int(budget_bytes)
+        self._od: "collections.OrderedDict" = collections.OrderedDict()
+        self._bytes = 0
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def get(self, key, make):
+        with self._lock:
+            panel = self._od.get(key)
+            if panel is not None:
+                self.hits += 1
+                self._od.move_to_end(key)
+                return panel
+            self.misses += 1
+        # dequantize OUTSIDE the lock: concurrent misses on different
+        # panels must not serialize on each other's dequant; a racing
+        # double-make of the same panel is benign (identical bytes, the
+        # second insert just wins).
+        panel = make()
+        with self._lock:
+            if key not in self._od:
+                self._od[key] = panel
+                self._bytes += panel.nbytes
+                while self._bytes > self.budget_bytes and len(self._od) > 1:
+                    _, old = self._od.popitem(last=False)
+                    self._bytes -= old.nbytes
+                    self.evictions += 1
+            else:
+                self._od.move_to_end(key)
+        return panel
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"hits": self.hits, "misses": self.misses,
+                    "evictions": self.evictions, "panels": len(self._od),
+                    "bytes": self._bytes,
+                    "budget_bytes": self.budget_bytes}
+
+
+def _norm_ppf(p: float) -> float:
+    """Standard normal inverse CDF (Acklam's rational approximation,
+    |rel err| < 1.2e-9) - scipy-free, enough for interval endpoints whose
+    dominant error is Monte Carlo, not quantile precision."""
+    if not 0.0 < p < 1.0:
+        raise ValueError(f"p must be in (0, 1), got {p}")
+    a = (-3.969683028665376e+01, 2.209460984245205e+02,
+         -2.759285104469687e+02, 1.383577518672690e+02,
+         -3.066479806614716e+01, 2.506628277459239e+00)
+    b = (-5.447609879822406e+01, 1.615858368580409e+02,
+         -1.556989798598866e+02, 6.680131188771972e+01,
+         -1.328068155288572e+01)
+    c = (-7.784894002430293e-03, -3.223964580411365e-01,
+         -2.400758277161838e+00, -2.549732539343734e+00,
+         4.374664141464968e+00, 2.938163982698783e+00)
+    d = (7.784695709041462e-03, 3.224671290700398e-01,
+         2.445134137142996e+00, 3.754408661907416e+00)
+    plow, phigh = 0.02425, 1 - 0.02425
+    if p < plow:
+        q = math.sqrt(-2 * math.log(p))
+        return ((((((c[0] * q + c[1]) * q + c[2]) * q + c[3]) * q + c[4])
+                 * q + c[5])
+                / ((((d[0] * q + d[1]) * q + d[2]) * q + d[3]) * q + 1))
+    if p > phigh:
+        return -_norm_ppf(1 - p)
+    q = p - 0.5
+    r = q * q
+    return ((((((a[0] * r + a[1]) * r + a[2]) * r + a[3]) * r + a[4])
+             * r + a[5]) * q
+            / (((((b[0] * r + b[1]) * r + b[2]) * r + b[3]) * r + b[4])
+               * r + 1))
+
+
+class QueryEngine:
+    """Entry/block/row/SD/interval queries over one opened artifact."""
+
+    def __init__(self, artifact: PosteriorArtifact, *,
+                 cache_bytes: int = 256 << 20):
+        self.artifact = artifact
+        self.cache = PanelCache(cache_bytes)
+        g, P = artifact.g, artifact.P
+        self._g, self._P = g, P
+        # flattened shard-coordinate de-standardization scales (p_used,)
+        self._s = np.ascontiguousarray(
+            artifact.pre.col_scale, np.float32).reshape(-1)
+        # per-panel dequant factors, same op as estimate.dequantize_panels
+        self._factor = {"mean": artifact.mean_scale / 127.0}
+        if artifact.has_sd:
+            self._factor["sd"] = artifact.sd_scale / 127.0
+
+    # -- coordinates ---------------------------------------------------
+    def shard_index(self, idx) -> np.ndarray:
+        """Caller columns -> shard positions (-1 = dropped zero column).
+        Raises IndexError for out-of-range indices."""
+        return caller_to_shard_index(self.artifact.pre, idx)
+
+    def _pair(self, r: int, c: int) -> int:
+        """Canonical triu panel index of shard-block (r, c), r <= c."""
+        return r * self._g - (r * (r - 1)) // 2 + (c - r)
+
+    # -- panels --------------------------------------------------------
+    def _panel(self, kind: str, pair: int, diag: bool) -> np.ndarray:
+        """Dequantized float32 panel via the LRU cache; diagonal-pair
+        panels are stored symmetrized (step 2 of the bitwise contract)."""
+        raw, _ = self.artifact.panels(kind)
+        factor = self._factor[kind]
+
+        def make():
+            p = raw[pair].astype(np.float32) * factor[pair]
+            if diag:
+                p = 0.5 * (p + p.T)
+            return p
+
+        return self.cache.get((kind, pair), make)
+
+    def _value(self, kind: str, si: int, sj: int) -> np.float32:
+        """One entry in SHARD coordinates, pre-destandardization."""
+        P = self._P
+        r, a = divmod(si, P)
+        c, b = divmod(sj, P)
+        if r == c:
+            return self._panel(kind, self._pair(r, c), True)[a, b]
+        if r < c:
+            return self._panel(kind, self._pair(r, c), False)[a, b]
+        return self._panel(kind, self._pair(c, r), False)[b, a]
+
+    # -- queries -------------------------------------------------------
+    def entry(self, i: int, j: int, *, kind: str = "mean",
+              destandardize: bool = True) -> np.float32:
+        """Posterior mean (or SD) of Sigma[i, j], caller coordinates."""
+        si, sj = self.shard_index([i, j])
+        if si < 0 or sj < 0:
+            return np.float32(0.0)      # dropped all-zero column
+        v = self._value(kind, int(si), int(sj))
+        if destandardize:
+            v = v * (self._s[si] * self._s[sj])
+        return np.float32(v)
+
+    def entries(self, queries) -> list:
+        """Batch of ``(i, j, destandardize)`` entry queries, grouped by
+        target panel so ONE dequant (one cache access) serves every
+        query that touches the same panel - the microbatcher's fast
+        path.  Returns float32 values in query order."""
+        out = [np.float32(0.0)] * len(queries)
+        ij = np.asarray([(q[0], q[1]) for q in queries], np.int64).reshape(
+            -1, 2)
+        sidx = self.shard_index(ij.reshape(-1)).reshape(-1, 2)
+        P = self._P
+        by_panel: dict = {}
+        for n, (si, sj) in enumerate(sidx):
+            if si < 0 or sj < 0:
+                continue
+            r, a = divmod(int(si), P)
+            c, b = divmod(int(sj), P)
+            if r > c:
+                r, c, a, b = c, r, b, a
+            by_panel.setdefault((r, c), []).append((n, a, b, si, sj))
+        for (r, c), hits in by_panel.items():
+            panel = self._panel("mean", self._pair(r, c), r == c)
+            for n, a, b, si, sj in hits:
+                v = panel[a, b]
+                if queries[n][2]:
+                    v = v * (self._s[si] * self._s[sj])
+                out[n] = np.float32(v)
+        return out
+
+    def block(self, rows, cols, *, kind: str = "mean",
+              destandardize: bool = True) -> np.ndarray:
+        """Sub-block Sigma[np.ix_(rows, cols)] in caller coordinates,
+        touching only the panels the block intersects."""
+        rows = np.atleast_1d(np.asarray(rows, np.int64))
+        cols = np.atleast_1d(np.asarray(cols, np.int64))
+        sr = self.shard_index(rows)
+        sc = self.shard_index(cols)
+        out = np.zeros((rows.size, cols.size), np.float32)
+        P = self._P
+        vr, vc = np.flatnonzero(sr >= 0), np.flatnonzero(sc >= 0)
+        if vr.size == 0 or vc.size == 0:
+            return out
+        r_shard, r_loc = np.divmod(sr[vr], P)
+        c_shard, c_loc = np.divmod(sc[vc], P)
+        for rs in np.unique(r_shard):
+            rsel = np.flatnonzero(r_shard == rs)
+            for cs in np.unique(c_shard):
+                csel = np.flatnonzero(c_shard == cs)
+                lo, hi = min(rs, cs), max(rs, cs)
+                panel = self._panel(kind, self._pair(int(lo), int(hi)),
+                                    lo == hi)
+                if rs <= cs:
+                    vals = panel[np.ix_(r_loc[rsel], c_loc[csel])]
+                else:
+                    vals = panel[np.ix_(c_loc[csel], r_loc[rsel])].T
+                vals = np.ascontiguousarray(vals)
+                if destandardize:
+                    vals = vals * (self._s[sr[vr[rsel]]][:, None]
+                                   * self._s[sc[vc[csel]]][None, :])
+                out[np.ix_(vr[rsel], vc[csel])] = vals
+        return out
+
+    def row(self, i: int, *, kind: str = "mean",
+            destandardize: bool = True) -> np.ndarray:
+        """Full row i of the posterior matrix, (p_original,)."""
+        return self.block(
+            [i], np.arange(self.artifact.p_original), kind=kind,
+            destandardize=destandardize)[0]
+
+    def sd_entry(self, i: int, j: int, *,
+                 destandardize: bool = True) -> np.float32:
+        return self.entry(i, j, kind="sd", destandardize=destandardize)
+
+    def interval(self, i: int, j: int, *, alpha: float = 0.05,
+                 destandardize: bool = True) -> tuple:
+        """Normal-approximation equal-tailed (1-alpha) credible interval
+        for Sigma[i, j]: mean +/- z_{1-alpha/2} * posterior SD.  The
+        draw-exact quantile interval lives on the fit side
+        (``FitResult.covariance_credible_interval``); this is the
+        serving-time approximation from the two accumulated moments.
+        Returns ``(mean, sd, lo, hi)`` floats."""
+        mean = float(self.entry(i, j, destandardize=destandardize))
+        sd = float(self.sd_entry(i, j, destandardize=destandardize))
+        z = _norm_ppf(1.0 - alpha / 2.0)
+        return mean, sd, mean - z * sd, mean + z * sd
+
+    def stats(self) -> dict:
+        return self.cache.stats()

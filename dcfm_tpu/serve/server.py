@@ -1,0 +1,372 @@
+"""Stdlib-only JSON HTTP server over the posterior query engine.
+
+``ThreadingHTTPServer`` + ``BaseHTTPRequestHandler`` - no framework, no
+dependency the container doesn't already have.  Endpoints:
+
+* ``GET /v1/entry?i=..&j=..[&destandardize=0]`` - one covariance entry,
+  routed through the microbatcher (concurrent requests touching the
+  same panel share one dequant).  429 + ``retry: true`` under
+  backpressure, 504 when the request expires in the queue.
+* ``GET /v1/block?rows=..&cols=..`` - a sub-block; ``rows``/``cols``
+  are comma lists (``0,5,7``) and/or half-open ranges (``10:20``).
+* ``GET /v1/interval?i=..&j=..[&alpha=0.05]`` - normal-approximation
+  credible interval from the mean and posterior-SD panels.
+* ``GET /healthz`` - liveness + mode: ``ok`` when the native assembler
+  is loadable, ``degraded`` when it is not (``DCFM_NATIVE_DISABLE=1``
+  or no compiler) - every query path is pure NumPy and keeps working in
+  degraded mode; the flag exists so a fleet can see it.  ``draining``
+  once shutdown began.
+* ``GET /metrics`` - per-endpoint latency histograms (p50/p99 + bucket
+  counts), panel-cache hit/miss/eviction counters, batcher queue stats.
+
+Shutdown discipline (dcfm-lint DCFM503): ``shutdown()`` +
+``server_close()`` always run on the exit path - ``run()`` installs
+SIGTERM/SIGINT handlers that trigger a graceful drain (stop accepting,
+finish in-flight requests - ``block_on_close`` joins the handler
+threads - then close the batcher's worker).
+"""
+
+from __future__ import annotations
+
+import json
+import signal
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlsplit
+
+from dcfm_tpu.serve.artifact import ArtifactError, PosteriorArtifact
+from dcfm_tpu.serve.batcher import DeadlineExceeded, Overloaded, QueryBatcher
+from dcfm_tpu.serve.engine import QueryEngine
+
+MAX_BLOCK_ENTRIES = 1 << 20       # 4 MB of float32 per response, maximum
+
+
+class _BadRequest(ValueError):
+    pass
+
+
+_BUCKET_BOUNDS_MS = (0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0,
+                     250.0, 1000.0, float("inf"))
+
+
+class LatencyHistogram:
+    """Fixed-bucket latency histogram with percentile readout."""
+
+    def __init__(self):
+        self._counts = [0] * len(_BUCKET_BOUNDS_MS)
+        self._n = 0
+        self._sum_ms = 0.0
+        self._lock = threading.Lock()
+
+    def record(self, ms: float) -> None:
+        with self._lock:
+            for k, bound in enumerate(_BUCKET_BOUNDS_MS):
+                if ms <= bound:
+                    self._counts[k] += 1
+                    break
+            self._n += 1
+            self._sum_ms += ms
+
+    def _percentile(self, q: float) -> float:
+        """Upper bucket bound containing quantile q (inf -> last finite)."""
+        target = q * self._n
+        seen = 0
+        for k, bound in enumerate(_BUCKET_BOUNDS_MS):
+            seen += self._counts[k]
+            if seen >= target:
+                return bound if bound != float("inf") \
+                    else _BUCKET_BOUNDS_MS[-2]
+        return _BUCKET_BOUNDS_MS[-2]
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            if self._n == 0:
+                return {"count": 0}
+            return {
+                "count": self._n,
+                "mean_ms": round(self._sum_ms / self._n, 4),
+                "p50_ms": self._percentile(0.50),
+                "p99_ms": self._percentile(0.99),
+                "buckets_ms": {
+                    ("inf" if b == float("inf") else str(b)): c
+                    for b, c in zip(_BUCKET_BOUNDS_MS, self._counts)},
+            }
+
+
+def _parse_indices(spec: str, p: int) -> list:
+    """'0,5,7' and/or half-open ranges '10:20' -> index list."""
+    out = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if ":" in part:
+            lo_s, hi_s = part.split(":", 1)
+            lo = int(lo_s) if lo_s else 0
+            hi = int(hi_s) if hi_s else p
+            if not (0 <= lo <= hi <= p):
+                raise _BadRequest(f"range {part!r} out of [0, {p}]")
+            out.extend(range(lo, hi))
+        else:
+            v = int(part)
+            if not 0 <= v < p:
+                raise _BadRequest(f"index {v} out of [0, {p})")
+            out.append(v)
+    if not out:
+        raise _BadRequest("empty index list")
+    return out
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "dcfm-serve/1"
+    protocol_version = "HTTP/1.1"
+    # socket timeout: an idle keep-alive connection must not hold its
+    # handler thread open forever - block_on_close joins handler threads
+    # at drain, so an unbounded read here would stall SIGTERM shutdown
+    timeout = 10
+
+    def log_message(self, fmt, *args):   # latency lives in /metrics
+        pass
+
+    def do_GET(self):                    # noqa: N802 (stdlib API name)
+        app = self.server.app
+        parts = urlsplit(self.path)
+        t0 = time.perf_counter()
+        status, payload, headers = app.handle(parts.path,
+                                              parse_qs(parts.query))
+        app.observe(parts.path, status,
+                    (time.perf_counter() - t0) * 1e3)
+        body = json.dumps(payload).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in headers.items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+
+class _Httpd(ThreadingHTTPServer):
+    # non-daemon handler threads + block_on_close: server_close() joins
+    # every in-flight request - the graceful-drain half of DCFM503.
+    daemon_threads = False
+    block_on_close = True
+    allow_reuse_address = True
+    app = None
+
+
+class PosteriorServer:
+    """The servable unit: artifact -> engine -> batcher -> HTTP."""
+
+    def __init__(self, artifact, *, host: str = "127.0.0.1", port: int = 0,
+                 cache_bytes: int = 256 << 20, max_queue: int = 1024,
+                 max_batch: int = 256, request_timeout: float = 2.0):
+        if isinstance(artifact, str):
+            artifact = PosteriorArtifact.open(artifact)
+        self.artifact = artifact
+        self.engine = QueryEngine(artifact, cache_bytes=cache_bytes)
+        # bind BEFORE starting the batcher's non-daemon worker: a bind
+        # failure (port in use) must raise out of __init__ with no
+        # orphaned thread keeping the process alive past the traceback
+        self._httpd = _Httpd((host, port), _Handler)
+        self._httpd.app = self
+        try:
+            self.batcher = QueryBatcher(self.engine, max_queue=max_queue,
+                                        max_batch=max_batch,
+                                        default_timeout=request_timeout)
+        except BaseException:
+            self._httpd.server_close()
+            raise
+        self.address = self._httpd.server_address[:2]
+        self._t0 = time.monotonic()
+        self._draining = False
+        self._accept_thread = None
+        self._close_lock = threading.Lock()
+        self._closed = False
+        self._hist: dict = {}
+        self._hist_lock = threading.Lock()
+        self._status_counts: dict = {}
+
+    _ROUTES = ("/healthz", "/metrics", "/v1/entry", "/v1/block",
+               "/v1/interval")
+
+    # -- observability -------------------------------------------------
+    def observe(self, path: str, status: int, ms: float) -> None:
+        # known routes get their own histogram; everything else folds
+        # into one "other" bucket so a path scanner cannot exhaust the
+        # per-route slots and starve a real endpoint of latency data
+        key = path if path in self._ROUTES else "other"
+        with self._hist_lock:
+            h = self._hist.get(key)
+            if h is None:
+                h = self._hist[key] = LatencyHistogram()
+            self._status_counts[status] = \
+                self._status_counts.get(status, 0) + 1
+        h.record(ms)
+
+    # -- routing -------------------------------------------------------
+    def handle(self, path: str, q: dict) -> tuple:
+        """-> (status, json payload, extra headers)."""
+        try:
+            if path == "/healthz":
+                return 200, self._healthz(), {}
+            if path == "/metrics":
+                return 200, self._metrics(), {}
+            if path == "/v1/entry":
+                return self._entry(q)
+            if path == "/v1/block":
+                return self._block(q)
+            if path == "/v1/interval":
+                return self._interval(q)
+            return 404, {"error": f"no route {path}"}, {}
+        except _BadRequest as e:
+            return 400, {"error": str(e)}, {}
+        except Overloaded as e:
+            return 429, {"error": str(e), "retry": True}, \
+                {"Retry-After": "0.05"}
+        except DeadlineExceeded as e:
+            return 504, {"error": str(e)}, {}
+        except (ArtifactError, ValueError, IndexError) as e:
+            return 400, {"error": str(e)}, {}
+        except Exception as e:           # pragma: no cover - last resort
+            return 500, {"error": repr(e)}, {}
+
+    def _q_int(self, q, name):
+        if name not in q:
+            raise _BadRequest(f"missing required parameter {name!r}")
+        try:
+            v = int(q[name][0])
+        except ValueError:
+            raise _BadRequest(f"{name}={q[name][0]!r} is not an integer") \
+                from None
+        if not 0 <= v < self.artifact.p_original:
+            raise _BadRequest(
+                f"{name}={v} out of [0, {self.artifact.p_original})")
+        return v
+
+    @staticmethod
+    def _q_flag(q, name, default=True):
+        if name not in q:
+            return default
+        return q[name][0] not in ("0", "false", "no")
+
+    def _entry(self, q):
+        i, j = self._q_int(q, "i"), self._q_int(q, "j")
+        dest = self._q_flag(q, "destandardize")
+        value = self.batcher.entry(i, j, destandardize=dest)
+        return 200, {"i": i, "j": j, "value": float(value),
+                     "destandardized": dest}, {}
+
+    def _block(self, q):
+        p = self.artifact.p_original
+        if "rows" not in q or "cols" not in q:
+            raise _BadRequest("block queries need rows= and cols=")
+        rows = _parse_indices(q["rows"][0], p)
+        cols = _parse_indices(q["cols"][0], p)
+        if len(rows) * len(cols) > MAX_BLOCK_ENTRIES:
+            return 413, {"error": f"block of {len(rows)}x{len(cols)} "
+                         f"exceeds {MAX_BLOCK_ENTRIES} entries; tile the "
+                         "request"}, {}
+        dest = self._q_flag(q, "destandardize")
+        kind = q.get("kind", ["mean"])[0]
+        vals = self.engine.block(rows, cols, kind=kind, destandardize=dest)
+        return 200, {"rows": rows, "cols": cols,
+                     "values": [[float(v) for v in row] for row in vals],
+                     "destandardized": dest, "kind": kind}, {}
+
+    def _interval(self, q):
+        i, j = self._q_int(q, "i"), self._q_int(q, "j")
+        alpha = float(q.get("alpha", ["0.05"])[0])
+        if not 0.0 < alpha < 1.0:
+            raise _BadRequest(f"alpha={alpha} must be in (0, 1)")
+        dest = self._q_flag(q, "destandardize")
+        mean, sd, lo, hi = self.engine.interval(
+            i, j, alpha=alpha, destandardize=dest)
+        return 200, {"i": i, "j": j, "alpha": alpha, "mean": mean,
+                     "sd": sd, "lo": lo, "hi": hi}, {}
+
+    def _healthz(self):
+        from dcfm_tpu import native
+        a = self.artifact
+        return {
+            "status": ("draining" if self._draining
+                       else "ok" if native.available() else "degraded"),
+            "native": native.available(),
+            "p": a.p_original, "g": a.g, "P": a.P, "has_sd": a.has_sd,
+            "uptime_s": round(time.monotonic() - self._t0, 3),
+        }
+
+    def _metrics(self):
+        with self._hist_lock:
+            hists = {p: h.snapshot() for p, h in self._hist.items()}
+            statuses = dict(self._status_counts)
+        return {
+            "latency": hists,
+            "statuses": statuses,
+            "cache": self.engine.stats(),
+            "batcher": self.batcher.stats(),
+            "uptime_s": round(time.monotonic() - self._t0, 3),
+        }
+
+    # -- lifecycle -----------------------------------------------------
+    def start(self) -> tuple:
+        """Serve in a background thread (tests, benchmarks, embedding);
+        returns the bound (host, port)."""
+        self._accept_thread = threading.Thread(
+            target=self._httpd.serve_forever, name="dcfm-serve-accept")
+        self._accept_thread.start()
+        return self.address
+
+    def close(self) -> None:
+        """Graceful drain: stop accepting, finish in-flight requests,
+        close the socket and the batcher worker.  Idempotent."""
+        with self._close_lock:
+            if self._closed:
+                return
+            self._closed = True
+        self._draining = True
+        self._httpd.shutdown()            # stops serve_forever
+        if self._accept_thread is not None:
+            self._accept_thread.join()
+            self._accept_thread = None
+        self._httpd.server_close()        # joins in-flight handler threads
+        self.batcher.close()
+
+    def run(self) -> None:
+        """Serve until SIGTERM/SIGINT, then drain gracefully.
+
+        The accept loop runs in a worker thread while the main thread -
+        the only one Python delivers signals to - waits on an event the
+        handlers set; calling ``shutdown()`` from a signal handler while
+        ``serve_forever`` runs on the handler's own thread would
+        deadlock.
+        """
+        stop = threading.Event()
+        prev = {s: signal.signal(s, lambda *_: stop.set())
+                for s in (signal.SIGTERM, signal.SIGINT)}
+        self.start()
+        try:
+            while not stop.wait(0.2):
+                pass
+        finally:
+            for s, h in prev.items():
+                signal.signal(s, h)
+            self.close()
+
+
+def serve_main(args) -> int:
+    """``dcfm-tpu serve`` entry point (argparse Namespace from cli.py)."""
+    server = PosteriorServer(
+        args.artifact, host=args.host, port=args.port,
+        cache_bytes=int(args.cache_mb) << 20, max_queue=args.max_queue,
+        max_batch=args.max_batch, request_timeout=args.request_timeout)
+    host, port = server.address
+    print(json.dumps({"serving": f"http://{host}:{port}",
+                      "artifact": args.artifact,
+                      "p": server.artifact.p_original,
+                      "has_sd": server.artifact.has_sd}), flush=True)
+    server.run()
+    print(json.dumps({"drained": True,
+                      "statuses": server._status_counts}), flush=True)
+    return 0

@@ -197,6 +197,13 @@ def _config_from_json(d: dict) -> FitConfig:
     )
 
 
+def config_from_checkpoint_meta(meta: dict) -> FitConfig:
+    """The FitConfig a checkpoint was written under - the public seam the
+    serving layer's checkpoint export (serve/artifact.py) uses to rebuild
+    preprocessing and the carry template without a refit."""
+    return _config_from_json(meta["config"])
+
+
 def _atomic_savez(target: str, meta: dict, payload: dict) -> None:
     """Atomic npz write (tmp + rename): a crash mid-save never corrupts the
     previous checkpoint.  One home for the durability semantics."""

@@ -1,0 +1,114 @@
+"""Accuracy anchor: the reference_numpy oracle vs fit(), written to disk.
+
+The round-5 VERDICT named the gap: the "MATLAB-equivalent error" claim
+had never been anchored at the north-star shape.  This script runs the
+serial NumPy twin of the corrected sampler (dcfm_tpu/reference_numpy.py
+- shares no code with the JAX path by design) and fit() on the SAME
+synthetic data with the SAME preprocessing, maps both posterior means to
+caller coordinates, and records the relative Frobenius distance between
+them plus each estimator's distance to the ground-truth Sigma in
+ANCHOR.json.
+
+Default shape is the north star (p=10,000, g=64, n=500) - the oracle is
+a deliberate single-core loop-nest, so expect ~an hour there; the
+ANCHOR_* env vars downscale for quick runs, and
+tests/test_anchor.py pins the downscaled (p <= 512) anchor under a
+tolerance in the slow lane:
+
+    ANCHOR_P=256 ANCHOR_G=4 ANCHOR_N=200 ANCHOR_ITERS=400 \
+        python scripts/anchor_north_star.py
+
+The number to watch is ``rel_frob_fit_vs_oracle``: two independent
+correct samplers estimating the same posterior mean differ only by
+Monte Carlo error, so growth here flags a sampler/combine bias that the
+speed gates cannot see.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+P_TOTAL = int(os.environ.get("ANCHOR_P", 10_000))
+G = int(os.environ.get("ANCHOR_G", 64))
+N = int(os.environ.get("ANCHOR_N", 500))
+K_PER_SHARD = int(os.environ.get("ANCHOR_K", 8))
+ITERS = int(os.environ.get("ANCHOR_ITERS", 2000))
+RHO = float(os.environ.get("ANCHOR_RHO", 0.9))
+SEED = int(os.environ.get("ANCHOR_SEED", 0))
+OUT = os.environ.get("ANCHOR_OUT",
+                     os.path.join(os.path.dirname(os.path.dirname(
+                         os.path.abspath(__file__))), "ANCHOR.json"))
+
+
+def run_anchor(p=P_TOTAL, g=G, n=N, k=K_PER_SHARD, iters=ITERS,
+               rho=RHO, seed=SEED):
+    """-> the ANCHOR.json payload dict (shared with tests/test_anchor.py)."""
+    from dcfm_tpu import BackendConfig, FitConfig, ModelConfig, RunConfig, fit
+    from dcfm_tpu.reference_numpy import gibbs_numpy
+    from dcfm_tpu.utils.estimate import posterior_covariance
+    from dcfm_tpu.utils.preprocess import preprocess
+
+    rng = np.random.default_rng(seed)
+    k_true = min(k, 4)
+    L = (rng.standard_normal((p, k_true)) / np.sqrt(k_true)).astype(
+        np.float32)
+    F = rng.standard_normal((n, k_true)).astype(np.float32)
+    Y = F @ L.T + 0.3 * rng.standard_normal((n, p)).astype(np.float32)
+    Sigma_true = L @ L.T + 0.09 * np.eye(p, dtype=np.float32)
+
+    burnin = iters // 2
+    thin = max(iters // 400, 1)
+    mcmc = max(((iters - burnin) // thin) * thin, thin)
+
+    cfg = FitConfig(
+        model=ModelConfig(num_shards=g, factors_per_shard=k, rho=rho),
+        run=RunConfig(burnin=burnin, mcmc=mcmc, thin=thin, seed=seed),
+        backend=BackendConfig(backend="auto"))
+    t0 = time.perf_counter()
+    res = fit(Y, cfg)
+    fit_s = time.perf_counter() - t0
+    Sigma_fit = res.Sigma
+
+    # the oracle consumes the SAME sharded/standardized data fit() saw
+    # (preprocess is deterministic in the seed), so the comparison is
+    # sampler-vs-sampler, not preprocessing-vs-preprocessing
+    pre = preprocess(Y, g, seed=seed)
+    t0 = time.perf_counter()
+    blocks, _ = gibbs_numpy(pre.data.astype(np.float64), k, rho,
+                            burnin, mcmc, thin, seed=seed)
+    oracle_s = time.perf_counter() - t0
+    Sigma_oracle = posterior_covariance(blocks, pre, destandardize=True,
+                                        reinsert_zero_cols=True)
+
+    def rel(a, b):
+        return float(np.linalg.norm(a - b) / np.linalg.norm(b))
+
+    return {
+        "shape": {"p": p, "g": g, "n": n, "k_per_shard": k,
+                  "iters": burnin + mcmc, "burnin": burnin, "thin": thin,
+                  "rho": rho, "seed": seed},
+        "rel_frob_fit_vs_oracle": rel(Sigma_fit, Sigma_oracle),
+        "rel_frob_fit_vs_truth": rel(Sigma_fit, Sigma_true),
+        "rel_frob_oracle_vs_truth": rel(Sigma_oracle, Sigma_true),
+        "fit_seconds": round(fit_s, 2),
+        "oracle_seconds": round(oracle_s, 2),
+        "north_star_shape": (p, g, n) == (10_000, 64, 500),
+    }
+
+
+def main():
+    payload = run_anchor()
+    with open(OUT, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=1)
+    print(json.dumps(payload))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -15,6 +15,24 @@ cd "$(dirname "$0")/.."
 echo "== dcfm-lint: static analysis over dcfm_tpu/ =="
 python -m dcfm_tpu.analysis dcfm_tpu/ || exit 1
 
+# The serving subsystem gets its own named gate: its failure mode
+# (ThreadingHTTPServer / batcher threads alive at teardown, DCFM5xx)
+# is exactly the class that used to SIGABRT tier-1 mid-suite.
+echo "== dcfm-lint: serve subsystem (DCFM5xx thread/server lifecycles) =="
+python -m dcfm_tpu.analysis dcfm_tpu/serve/ || exit 1
+
+# Serve tests always run through the crash-isolated lane IN ADDITION to
+# their in-process tier-1 run below: they exercise native assembly +
+# sockets + thread storms, so a native-level abort here must fail ONE
+# file with its signal named, not silently hide the rest of the suite.
+echo "== serve tests (crash-isolated lane) =="
+for f in tests/test_serve_artifact.py tests/test_serve_engine.py \
+         tests/test_serve_server.py; do
+    JAX_PLATFORMS=cpu python -m dcfm_tpu.analysis.isolate "$f" \
+        -- -q -m 'not slow' --continue-on-collection-errors \
+        -p no:cacheprovider -p no:xdist -p no:randomly || exit 1
+done
+
 echo "== tier-1 tests (CPU) =="
 if [ "${CI_ISOLATED:-0}" = "1" ]; then
     # fallback lane: a native abort fails one file, not the whole run.

@@ -1,0 +1,11 @@
+"""Known-bad server lifecycles: DCFM503 must fire (both shapes)."""
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+def run_forever():
+    # DCFM503 twice: the server is constructed with no .server_close()
+    # anywhere in the module, and serve_forever() runs with no
+    # .shutdown() anywhere - nothing can stop the accept loop or close
+    # the listening socket before interpreter teardown.
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), BaseHTTPRequestHandler)
+    httpd.serve_forever()

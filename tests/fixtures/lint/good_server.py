@@ -1,0 +1,29 @@
+"""Known-good server lifecycles: shutdown + server_close on exit paths."""
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from socketserver import TCPServer
+
+
+class Server:
+    def __init__(self):
+        self._httpd = ThreadingHTTPServer(
+            ("127.0.0.1", 0), BaseHTTPRequestHandler)
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._httpd.serve_forever)
+        self._thread.start()
+
+    def close(self):
+        # the exit path: stop the accept loop, join, close the socket
+        self._httpd.shutdown()
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        self._httpd.server_close()
+
+
+def one_shot(handler):
+    # with-statement lifecycle: __exit__ is server_close
+    with TCPServer(("127.0.0.1", 0), handler) as srv:
+        srv.handle_request()

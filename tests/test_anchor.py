@@ -1,0 +1,28 @@
+"""Downscaled accuracy anchor (slow lane): fit() vs the NumPy oracle.
+
+The full anchor runs at the north-star shape via
+scripts/anchor_north_star.py (ANCHOR.json); this test pins the SAME
+comparison at a p <= 512 shape the CPU slow lane can afford, so a
+sampler/combine bias that drifts the two independent implementations
+apart fails CI before anyone re-runs the big anchor.
+"""
+
+import numpy as np
+import pytest
+
+
+@pytest.mark.slow
+def test_downscaled_anchor_under_tolerance():
+    import scripts.anchor_north_star as anchor
+
+    payload = anchor.run_anchor(p=256, g=4, n=200, k=4, iters=600,
+                                rho=0.9, seed=0)
+    assert payload["shape"]["p"] <= 512
+    # Two independent samplers of the same posterior differ by Monte
+    # Carlo error only; measured 0.0053 at this shape/seed.  0.03 ~ 6x
+    # headroom: MC noise stays well under it, a real bias (wrong
+    # precision weighting, broken combine scaling) lands far over.
+    assert payload["rel_frob_fit_vs_oracle"] < 0.03, payload
+    # and both must actually estimate Sigma (vs-truth sanity, loose)
+    assert payload["rel_frob_fit_vs_truth"] < 0.5, payload
+    assert payload["rel_frob_oracle_vs_truth"] < 0.5, payload

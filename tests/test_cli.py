@@ -182,6 +182,43 @@ def test_cli_imputed_out(tmp_path, capsys, data_npy):
               "--mcmc", "4", "--imputed-out", imp, "--out", out])
 
 
+def test_cli_export_fit_and_checkpoint_sources_agree(tmp_path, capsys,
+                                                     data_npy):
+    """`dcfm-tpu export` works from a fresh fit run AND from an existing
+    v6 checkpoint of the same chain - and the two artifacts' mean panels
+    are bitwise-identical (no refit happened on the checkpoint path)."""
+    path, _, _ = data_npy
+    ck = str(tmp_path / "chain.npz")
+    rc, _ = _run(capsys, [
+        "fit", path, "-g", "2", "-k", "6", "--burnin", "16", "--mcmc",
+        "16", "--thin", "2", "--checkpoint", ck,
+        "--out", str(tmp_path / "s.npy")])
+    assert rc == 0
+    art_ck = str(tmp_path / "art_ck")
+    rc, meta = _run(capsys, [
+        "export", path, "--from-checkpoint", ck, "--out", art_ck])
+    assert rc == 0
+    assert meta["source"] == "checkpoint" and meta["p"] == 24
+    art_fit = str(tmp_path / "art_fit")
+    rc, meta = _run(capsys, [
+        "export", path, "-g", "2", "-k", "6", "--burnin", "16",
+        "--mcmc", "16", "--thin", "2", "--out", art_fit])
+    assert rc == 0
+    assert meta["source"] == "fit"
+    from dcfm_tpu.serve.artifact import PosteriorArtifact
+    a1 = PosteriorArtifact.open(art_ck)
+    a2 = PosteriorArtifact.open(art_fit)
+    np.testing.assert_array_equal(np.asarray(a1.mean_panels),
+                                  np.asarray(a2.mean_panels))
+    np.testing.assert_array_equal(a1.mean_scale, a2.mean_scale)
+
+
+def test_cli_export_without_source_errors(tmp_path, data_npy):
+    path, _, _ = data_npy
+    with pytest.raises(SystemExit, match="--shards"):
+        main(["export", path, "--out", str(tmp_path / "a")])
+
+
 def test_cli_resume_without_checkpoint_errors(data_npy):
     path, _, _ = data_npy
     with pytest.raises(SystemExit):

@@ -54,6 +54,18 @@ def test_bad_thread_fires_501_502():
     assert _rules_fired("bad_thread.py") == {"DCFM501", "DCFM502"}
 
 
+def test_bad_server_fires_503():
+    assert _rules_fired("bad_server.py") == {"DCFM503"}
+
+
+def test_bad_server_flags_both_lifecycle_shapes():
+    findings = lint_file(os.path.join(FIXTURES, "bad_server.py"))
+    msgs = [f.message for f in findings if f.rule == "DCFM503"]
+    # the un-stoppable serve_forever AND the never-closed construction
+    assert any("serve_forever" in m for m in msgs)
+    assert any("server_close" in m for m in msgs)
+
+
 def test_every_rule_family_has_a_firing_fixture():
     """The registry and the fixtures cannot drift apart: every
     registered rule fires somewhere in the known-bad fixture set."""
@@ -71,7 +83,7 @@ def test_every_rule_family_has_a_firing_fixture():
 
 @pytest.mark.parametrize("name", [
     "good_rng.py", "good_jit.py", "good_dtype.py", "good_ffi.py",
-    "good_thread.py"])
+    "good_thread.py", "good_server.py"])
 def test_good_fixture_is_clean(name):
     findings = lint_file(os.path.join(FIXTURES, name))
     assert findings == [], [str(f) for f in findings]

@@ -1,0 +1,210 @@
+"""Posterior artifact (dcfm_tpu/serve/artifact.py): export round-trips.
+
+Pins the durability layer of the serving subsystem: export -> open is
+bitwise for both panel sets, a checkpoint-sourced export matches a
+FitResult-sourced one with no refit, a version mismatch refuses with a
+clear error instead of crashing, and a p=50k-scale artifact opens via
+memmap without materializing anything dense (the panel files are
+filesystem holes - kilobytes of real disk for a 1.3 GB logical
+artifact).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from tests.conftest import make_synthetic
+
+from dcfm_tpu import BackendConfig, FitConfig, ModelConfig, RunConfig, fit
+from dcfm_tpu.serve.artifact import (
+    ArtifactError, ArtifactVersionError, PosteriorArtifact,
+    create_sparse_artifact, export_fit_result, export_from_checkpoint,
+    quantize_panels, write_artifact)
+
+
+@pytest.fixture(scope="module")
+def fitted(tmp_path_factory):
+    """One checkpointed posterior-SD fit shared by the module (the chain
+    is the slow part; every test here exercises the export layer)."""
+    Y, _ = make_synthetic(n=50, p=25, k_true=3, seed=5)
+    Y[:, 7] = 0.0               # exercise the zero-column path
+    td = tmp_path_factory.mktemp("serve_artifact")
+    ck = str(td / "chain.npz")
+    cfg = FitConfig(
+        model=ModelConfig(num_shards=2, factors_per_shard=3, rho=0.9,
+                          posterior_sd=True),
+        run=RunConfig(burnin=30, mcmc=30, thin=2, seed=0, chunk_size=15),
+        backend=BackendConfig(fetch_dtype="quant8"),
+        checkpoint_path=ck)
+    return fit(Y, cfg), Y, ck, td
+
+
+def test_export_open_roundtrip_bitwise(fitted, tmp_path):
+    res, _, _, _ = fitted
+    art = export_fit_result(res, str(tmp_path / "art"))
+    # the default quant8 fetch's int8 panels are written as-is
+    np.testing.assert_array_equal(np.asarray(art.mean_panels),
+                                  np.asarray(res._q8_panels))
+    np.testing.assert_array_equal(art.mean_scale,
+                                  np.asarray(res._q8_scales))
+    np.testing.assert_array_equal(np.asarray(art.sd_panels),
+                                  np.asarray(res._sd_q8_panels))
+    np.testing.assert_array_equal(art.sd_scale,
+                                  np.asarray(res._sd_q8_scales))
+    # reopening reads the same bytes back (memmap vs written arrays)
+    art2 = PosteriorArtifact.open(art.path)
+    np.testing.assert_array_equal(np.asarray(art2.mean_panels),
+                                  np.asarray(art.mean_panels))
+    np.testing.assert_array_equal(np.asarray(art2.sd_panels),
+                                  np.asarray(art.sd_panels))
+    # preprocess maps survive the round trip
+    np.testing.assert_array_equal(art2.pre.inv_perm, res.preprocess.inv_perm)
+    np.testing.assert_array_equal(art2.pre.kept_cols,
+                                  res.preprocess.kept_cols)
+    np.testing.assert_array_equal(art2.pre.zero_cols,
+                                  res.preprocess.zero_cols)
+    np.testing.assert_array_equal(art2.pre.col_scale,
+                                  res.preprocess.col_scale)
+
+
+def test_export_quantizes_float_panels_like_the_device(fitted, tmp_path):
+    """A float32-fetch FitResult quantizes host-side with the device's
+    max-abs rule: same panels as the quant8 fetch of the same chain."""
+    res, _, _, _ = fitted
+    q, s = quantize_panels(res.upper_panels)
+    np.testing.assert_array_equal(q, np.asarray(res._q8_panels))
+    np.testing.assert_array_equal(s, np.asarray(res._q8_scales))
+
+
+def test_checkpoint_export_matches_fitresult_export(fitted, tmp_path):
+    """No-refit export from the v6 checkpoint: MEAN panels and scales are
+    bitwise the FitResult-sourced export's; SD panels agree to within one
+    int8 quantization step (the device fuses m2 - mean^2 into an FMA the
+    host replay cannot reproduce exactly - documented in
+    export_from_checkpoint)."""
+    res, Y, ck, _ = fitted
+    a1 = export_fit_result(res, str(tmp_path / "a_fit"))
+    a2 = export_from_checkpoint(ck, Y, str(tmp_path / "a_ck"))
+    np.testing.assert_array_equal(np.asarray(a1.mean_panels),
+                                  np.asarray(a2.mean_panels))
+    np.testing.assert_array_equal(a1.mean_scale, a2.mean_scale)
+    np.testing.assert_array_equal(a1.pre.inv_perm, a2.pre.inv_perm)
+    np.testing.assert_array_equal(a1.pre.col_scale, a2.pre.col_scale)
+    np.testing.assert_allclose(a1.sd_scale, a2.sd_scale, rtol=1e-5)
+    from dcfm_tpu.utils.estimate import dequantize_panels
+    d1 = dequantize_panels(np.ascontiguousarray(a1.sd_panels), a1.sd_scale)
+    d2 = dequantize_panels(np.ascontiguousarray(a2.sd_panels), a2.sd_scale)
+    step = np.maximum(a1.sd_scale, a2.sd_scale) / 127.0
+    assert (np.abs(d1 - d2) <= step[:, None, None] * 1.001).all()
+
+
+def test_checkpoint_export_refuses_wrong_data(fitted, tmp_path):
+    res, Y, ck, _ = fitted
+    with pytest.raises(ArtifactError, match="fingerprint"):
+        export_from_checkpoint(ck, Y + 1.0, str(tmp_path / "bad"))
+
+
+def test_version_mismatch_is_a_clear_error(fitted, tmp_path):
+    res, _, _, _ = fitted
+    art = export_fit_result(res, str(tmp_path / "art"))
+    meta_path = os.path.join(art.path, "meta.json")
+    with open(meta_path) as f:
+        meta = json.load(f)
+    meta["version"] = 99
+    with open(meta_path, "w") as f:
+        json.dump(meta, f)
+    with pytest.raises(ArtifactVersionError, match="v99"):
+        PosteriorArtifact.open(art.path)
+    # not-an-artifact directory is equally clear
+    with pytest.raises(ArtifactError, match="meta.json"):
+        PosteriorArtifact.open(str(tmp_path))
+
+
+def test_truncated_panel_file_refuses(fitted, tmp_path):
+    res, _, _, _ = fitted
+    art = export_fit_result(res, str(tmp_path / "art"))
+    panels = os.path.join(art.path, "mean_q8.bin")
+    with open(panels, "r+b") as f:
+        f.truncate(os.path.getsize(panels) - 1)
+    with pytest.raises(ArtifactError, match="bytes"):
+        PosteriorArtifact.open(art.path)
+
+
+def test_offline_assembly_matches_fit_sigma(fitted, tmp_path):
+    """The artifact's offline assembly reproduces FitResult.Sigma exactly
+    when the native assembler computed both (same kernel, same panels);
+    the engine tests pin served == offline on top of this."""
+    res, _, _, _ = fitted
+    from dcfm_tpu import native
+    art = export_fit_result(res, str(tmp_path / "art"))
+    got = art.assemble()
+    if native.available():
+        np.testing.assert_array_equal(got, res.Sigma)
+    else:
+        np.testing.assert_allclose(got, res.Sigma, rtol=1e-5, atol=1e-7)
+
+
+def test_p50k_scale_artifact_opens_sparse(tmp_path):
+    """A p=50,000 artifact (g=100, P=500: 1.26 GB of logical panels)
+    opens via memmap in well under a second, costs ~nothing on disk
+    (filesystem holes), and serves entries without touching the dense
+    Sigma - only the pages a query lands on are ever read."""
+    import time
+    path = create_sparse_artifact(str(tmp_path / "big"), g=100, P=500)
+    logical = 100 * 101 // 2 * 500 * 500
+    st = os.stat(os.path.join(path, "mean_q8.bin"))
+    assert st.st_size == logical
+    assert st.st_blocks * 512 < logical // 100     # hole-backed
+    t0 = time.perf_counter()
+    art = PosteriorArtifact.open(path)
+    assert time.perf_counter() - t0 < 1.0
+    assert art.p_original == 50_000
+    assert isinstance(art.mean_panels, np.memmap)
+    # patch one panel's bytes through a writable view and read it back
+    # through the artifact: pair (0, 1) holds rows of shard 0 vs shard 1
+    mm = np.memmap(os.path.join(path, "mean_q8.bin"), dtype=np.int8,
+                   mode="r+", shape=(art.n_pairs, art.P, art.P))
+    mm[1, 3, 4] = 42
+    mm.flush()
+    del mm
+    from dcfm_tpu.serve.engine import QueryEngine
+    eng = QueryEngine(art, cache_bytes=8 << 20)
+    # caller (3, 500 + 4): shard 0 local 3 x shard 1 local 4 -> panel 1
+    v = eng.entry(3, 504, destandardize=False)
+    assert v == np.float32(42.0 / 127.0)
+    assert eng.entry(504, 3, destandardize=False) == v   # symmetry
+    assert eng.entry(0, 0) == np.float32(0.0)            # untouched hole
+
+
+def test_reexport_over_existing_artifact(fitted, tmp_path):
+    """Re-exporting into the same directory stays atomic-by-refusal: the
+    old meta is dropped before any payload write (a crash mid-re-export
+    must not leave new panels validated by stale metadata), and stale SD
+    panels from a previous has_sd export do not linger."""
+    res, _, _, _ = fitted
+    path = str(tmp_path / "art")
+    export_fit_result(res, path)                     # has_sd=True
+    art = write_artifact(path,                       # re-export, no SD
+                         mean_q8=np.asarray(res._q8_panels),
+                         mean_scale=np.asarray(res._q8_scales),
+                         pre=res.preprocess)
+    assert art.has_sd is False
+    assert not os.path.exists(os.path.join(path, "sd_q8.bin"))
+    reopened = PosteriorArtifact.open(path)
+    np.testing.assert_array_equal(np.asarray(reopened.mean_panels),
+                                  np.asarray(res._q8_panels))
+
+
+def test_write_artifact_validates_shapes(fitted, tmp_path):
+    res, _, _, _ = fitted
+    pre = res.preprocess
+    q = np.asarray(res._q8_panels)
+    s = np.asarray(res._q8_scales)
+    with pytest.raises(ValueError, match="upper-triangle"):
+        write_artifact(str(tmp_path / "bad"), mean_q8=q[:-1],
+                       mean_scale=s[:-1], pre=pre)
+    with pytest.raises(ValueError, match="together"):
+        write_artifact(str(tmp_path / "bad2"), mean_q8=q, mean_scale=s,
+                       pre=pre, sd_q8=q)

@@ -1,0 +1,235 @@
+"""Query engine + microbatcher: bitwise answers, cache, backpressure.
+
+The central pin: every value the engine serves equals, BIT FOR BIT, the
+corresponding entry of the offline ``assemble_from_q8``-based assembly
+of the same artifact (``PosteriorArtifact.assemble``) - including the
+destandardize and zero-column-reinsertion paths - while dequantizing
+only the panels each query touches.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tests.conftest import make_synthetic
+
+from dcfm_tpu import BackendConfig, FitConfig, ModelConfig, RunConfig, fit
+from dcfm_tpu.serve.artifact import export_fit_result
+from dcfm_tpu.serve.batcher import DeadlineExceeded, Overloaded, QueryBatcher
+from dcfm_tpu.serve.engine import QueryEngine, _norm_ppf
+
+
+@pytest.fixture(scope="module")
+def served(tmp_path_factory):
+    """Artifact + offline ground truths, shared across the module."""
+    Y, _ = make_synthetic(n=50, p=26, k_true=3, seed=7)
+    Y[:, 3] = 0.0                # dropped zero column
+    cfg = FitConfig(
+        model=ModelConfig(num_shards=2, factors_per_shard=3, rho=0.9,
+                          posterior_sd=True),
+        run=RunConfig(burnin=30, mcmc=30, thin=2, seed=0),
+        backend=BackendConfig(fetch_dtype="quant8"))
+    res = fit(Y, cfg)
+    td = tmp_path_factory.mktemp("serve_engine")
+    art = export_fit_result(res, str(td / "art"))
+    refs = {
+        (True, "mean"): art.assemble(),
+        (False, "mean"): art.assemble(destandardize=False),
+        (True, "sd"): art.assemble(kind="sd"),
+    }
+    return art, refs
+
+
+@pytest.mark.parametrize("destandardize", [True, False])
+def test_entries_bitwise_equal_offline(served, destandardize):
+    art, refs = served
+    ref = refs[(destandardize, "mean")]
+    eng = QueryEngine(art, cache_bytes=4 << 20)
+    rng = np.random.default_rng(0)
+    for _ in range(400):
+        i, j = (int(v) for v in rng.integers(0, art.p_original, 2))
+        got = eng.entry(i, j, destandardize=destandardize)
+        assert np.float32(got) == np.float32(ref[i, j]), (i, j)
+
+
+def test_zero_column_entries_are_exactly_zero(served):
+    art, refs = served
+    eng = QueryEngine(art)
+    assert eng.entry(3, 10) == np.float32(0.0)
+    assert eng.entry(10, 3) == np.float32(0.0)
+    assert eng.entry(3, 3) == np.float32(0.0)
+    assert refs[(True, "mean")][3, 10] == 0.0
+
+
+def test_block_row_and_sd_bitwise_equal_offline(served):
+    art, refs = served
+    eng = QueryEngine(art)
+    rng = np.random.default_rng(1)
+    rows = rng.integers(0, art.p_original, 9)
+    cols = rng.integers(0, art.p_original, 7)
+    np.testing.assert_array_equal(
+        eng.block(rows, cols),
+        refs[(True, "mean")][np.ix_(rows, cols)].astype(np.float32))
+    np.testing.assert_array_equal(
+        eng.block(rows, cols, destandardize=False),
+        refs[(False, "mean")][np.ix_(rows, cols)].astype(np.float32))
+    np.testing.assert_array_equal(
+        eng.block(rows, cols, kind="sd"),
+        refs[(True, "sd")][np.ix_(rows, cols)].astype(np.float32))
+    np.testing.assert_array_equal(
+        eng.row(5), refs[(True, "mean")][5].astype(np.float32))
+
+
+def test_interval_normal_approximation(served):
+    art, _ = served
+    eng = QueryEngine(art)
+    mean, sd, lo, hi = eng.interval(6, 8, alpha=0.05)
+    assert lo < mean < hi and sd > 0
+    z = (hi - mean) / sd
+    assert abs(z - 1.959964) < 1e-5          # z_{0.975}
+    # tighter alpha -> wider interval
+    _, _, lo2, hi2 = eng.interval(6, 8, alpha=0.01)
+    assert lo2 < lo and hi2 > hi
+
+
+def test_norm_ppf_accuracy():
+    # spot values vs known quantiles
+    for p, z in [(0.975, 1.959964), (0.995, 2.575829), (0.5, 0.0),
+                 (0.025, -1.959964), (1e-6, -4.753424)]:
+        assert abs(_norm_ppf(p) - z) < 5e-6
+    with pytest.raises(ValueError):
+        _norm_ppf(0.0)
+
+
+def _caller_in_shard(art, shard):
+    """A caller column whose shard position lands in ``shard`` (skips
+    padding positions): shard position s models caller column
+    kept_cols[perm[s]]."""
+    p_kept = art.p_used - art.n_pad
+    for s in range(shard * art.P, (shard + 1) * art.P):
+        if art.pre.perm[s] < p_kept:
+            return int(art.pre.kept_cols[art.pre.perm[s]])
+    raise AssertionError(f"shard {shard} is all padding?")
+
+
+def test_panel_cache_budget_hits_misses_evictions(served):
+    art, _ = served
+    panel_bytes = art.P * art.P * 4
+    eng = QueryEngine(art, cache_bytes=2 * panel_bytes)   # 2 panels max
+    c0, c1 = _caller_in_shard(art, 0), _caller_in_shard(art, 1)
+    eng.entry(c0, c0)                  # panel (0, 0)
+    eng.entry(c0, c1)                  # panel (0, 1)
+    s0 = eng.stats()
+    assert s0["misses"] == 2 and s0["panels"] == 2
+    eng.entry(c0, c0)                  # hit
+    assert eng.stats()["hits"] == s0["hits"] + 1
+    eng.entry(c1, c1)                  # panel (1, 1) -> eviction
+    s1 = eng.stats()
+    assert s1["evictions"] >= 1
+    assert s1["bytes"] <= 2 * panel_bytes
+
+
+def test_batcher_coalesces_by_panel(served):
+    art, refs = served
+    ref = refs[(True, "mean")]
+    eng = QueryEngine(art)
+    b = QueryBatcher(eng, max_queue=128, max_batch=64)
+    try:
+        rng = np.random.default_rng(2)
+        pairs = [tuple(int(v) for v in rng.integers(0, art.p_original, 2))
+                 for _ in range(40)]
+        results = {}
+
+        def one(i, j):
+            results[(i, j)] = b.entry(i, j)
+
+        threads = [threading.Thread(target=one, args=p) for p in pairs]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for (i, j), v in results.items():
+            assert np.float32(v) == np.float32(ref[i, j])
+        st = b.stats()
+        assert st["served"] == len(pairs)
+        assert st["rejected"] == 0
+        assert st["batches"] >= 1
+    finally:
+        b.close()
+
+
+class _SlowEngine:
+    """Engine shim whose batch compute blocks until released - makes
+    queue-full backpressure and deadline expiry deterministic."""
+
+    def __init__(self, engine):
+        self._engine = engine
+        self.gate = threading.Event()
+
+    def entries(self, queries):
+        self.gate.wait(5.0)
+        return self._engine.entries(queries)
+
+
+def test_batcher_backpressure_rejects_when_full(served):
+    art, _ = served
+    slow = _SlowEngine(QueryEngine(art))
+    b = QueryBatcher(slow, max_queue=2, max_batch=1, default_timeout=5.0)
+    try:
+        # the worker grabs the first request and blocks on the gate; two
+        # more fill the bounded queue; the next must be REJECTED, not
+        # queued or blocked
+        holders = [threading.Thread(target=lambda: _swallow(b))
+                   for _ in range(3)]
+        for t in holders:
+            t.start()
+        deadline = time.monotonic() + 5.0
+        while b.stats()["queue_depth"] < 2 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        with pytest.raises(Overloaded):
+            b.entry(0, 0)
+        assert b.stats()["rejected"] == 1
+        slow.gate.set()
+        for t in holders:
+            t.join()
+    finally:
+        slow.gate.set()
+        b.close()
+
+
+def _swallow(b):
+    try:
+        b.entry(1, 2)
+    except Exception:
+        pass
+
+
+def test_batcher_expires_stale_requests(served):
+    art, _ = served
+    slow = _SlowEngine(QueryEngine(art))
+    b = QueryBatcher(slow, max_queue=8, max_batch=4)
+    try:
+        t = threading.Thread(target=lambda: _swallow(b))
+        t.start()                       # occupies the worker at the gate
+        time.sleep(0.05)
+        err = []
+
+        def stale():
+            try:
+                b.entry(2, 3, timeout=0.05)
+            except DeadlineExceeded:
+                err.append("deadline")
+
+        t2 = threading.Thread(target=stale)
+        t2.start()
+        time.sleep(0.3)                 # let the deadline lapse queued
+        slow.gate.set()
+        t.join()
+        t2.join()
+        assert err == ["deadline"]
+        assert b.stats()["expired"] >= 1
+    finally:
+        slow.gate.set()
+        b.close()
