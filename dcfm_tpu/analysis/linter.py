@@ -880,6 +880,94 @@ def _check_servers(mod: _Module, rep: _Reporter) -> None:
 
 
 # =====================================================================
+# DCFM6xx - robustness discipline
+# =====================================================================
+
+# A call to any of these names inside an except body counts as "the
+# failure was surfaced" (warnings.warn, logging methods, print-style
+# reporting).  Deliberately generous: the rule hunts SILENT swallows.
+_LOG_CALL_NAMES = {"warn", "warning", "error", "exception", "log", "debug",
+                   "info", "critical", "print", "write"}
+
+_VERIFY_CALL_NAMES = {"_verify_crc", "verify_checkpoint", "verify_crc",
+                      "verify_panel", "panel_crc32"}
+
+
+def _is_broad_handler(mod: _Module, handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    elts = t.elts if isinstance(t, ast.Tuple) else [t]
+    return any(_last(mod.resolve(e)) in ("Exception", "BaseException")
+               for e in elts)
+
+
+def _is_leaf_subscript(node: ast.AST) -> bool:
+    """z["leaf_3"] / z[f"leaf_{i}"] - a raw checkpoint payload read."""
+    if not isinstance(node, ast.Subscript):
+        return False
+    sl = node.slice
+    if isinstance(sl, ast.Constant) and isinstance(sl.value, str):
+        return sl.value.startswith("leaf_")
+    if isinstance(sl, ast.JoinedStr) and sl.values:
+        head = sl.values[0]
+        return (isinstance(head, ast.Constant)
+                and isinstance(head.value, str)
+                and head.value.startswith("leaf_"))
+    return False
+
+
+def _check_robustness(mod: _Module, rep: _Reporter) -> None:
+    # DCFM601: swallowed failures.  A broad handler is fine when its body
+    # re-raises, calls a logging/warning function, or USES the bound
+    # exception (building a failure message is handling) - anything else
+    # makes the error vanish.
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if not _is_broad_handler(mod, node):
+            continue
+        body = [m for s in node.body for m in ast.walk(s)]
+        if any(isinstance(m, ast.Raise) for m in body):
+            continue
+        if node.name and any(isinstance(m, ast.Name) and m.id == node.name
+                             for m in body):
+            continue
+        if any(isinstance(m, ast.Call)
+               and _last(_dotted(m.func)).lower() in _LOG_CALL_NAMES
+               for m in body):
+            continue
+        rep.emit("DCFM601", node,
+                 "broad except swallows the failure (no re-raise, no "
+                 "log/warn, bound exception unused) - surface it, or "
+                 "annotate the swallow: `# dcfm: ignore[DCFM601] - <why>`")
+
+    # DCFM602: unverified checkpoint payload reads.  Function-granular
+    # like the FFI contiguity rule: np.load plus a raw 'leaf_*' subscript
+    # with no integrity-verification call in the same function.
+    for fn in ast.walk(mod.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        sub = [m for s in fn.body for m in ast.walk(s)]
+        loads = [m for m in sub if isinstance(m, ast.Call)
+                 and mod.resolve(m.func) == "numpy.load"]
+        if not loads:
+            continue
+        leaf_reads = [m for m in sub if _is_leaf_subscript(m)]
+        if not leaf_reads:
+            continue
+        if any(isinstance(m, ast.Call)
+               and _last(_dotted(m.func)) in _VERIFY_CALL_NAMES
+               for m in sub):
+            continue
+        rep.emit("DCFM602", leaf_reads[0],
+                 "raw checkpoint leaf read with no integrity check in "
+                 "this function - route the payload through "
+                 "utils.checkpoint._verify_crc / verify_checkpoint "
+                 "before resuming on bytes from disk")
+
+
+# =====================================================================
 # driver
 # =====================================================================
 
@@ -897,6 +985,7 @@ def lint_source(source: str, path: str = "<string>") -> list:
     _check_ffi(mod, rep)
     _check_threads(mod, rep)
     _check_servers(mod, rep)
+    _check_robustness(mod, rep)
     rep.findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return rep.findings
 

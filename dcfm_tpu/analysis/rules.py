@@ -90,4 +90,20 @@ RULES = {r.id: r for r in [
          "constructed (outside a with-statement) in a module that never "
          "calls .server_close() - its worker threads and socket outlive "
          "teardown, the DCFM501 SIGABRT class"),
+    # ---- DCFM6xx: robustness discipline ------------------------------
+    Rule("DCFM601", "swallowed-exception", "robust",
+         "a bare `except:` or `except Exception/BaseException` whose "
+         "body neither re-raises, nor logs/warns, nor references the "
+         "bound exception - the failure vanishes silently (the crash-"
+         "recovery antipattern: resume/fallback code that eats the "
+         "error it should surface).  Intentional swallows must carry "
+         "an inline `# dcfm: ignore[DCFM601] - <why>`",
+         library_only=True),
+    Rule("DCFM602", "unverified-checkpoint-load", "robust",
+         "a function reads raw checkpoint payload entries "
+         "(np.load + a 'leaf_*' subscript) without any integrity "
+         "verification call (utils.checkpoint._verify_crc / "
+         "verify_checkpoint) in the same function - bytes from disk "
+         "must be CRC-checked before a chain resumes on them",
+         library_only=True),
 ]}

@@ -42,11 +42,14 @@ from dcfm_tpu.utils.diagnostics import ess, split_rhat
 from dcfm_tpu.parallel.mesh import make_mesh, shards_per_device
 from dcfm_tpu.parallel.multihost import place_sharded_global
 from dcfm_tpu.parallel.shard import build_mesh_chain, place_sharded
+from dcfm_tpu.resilience.faults import fault_plan
+from dcfm_tpu.resilience.sentinel import (
+    ChainDivergedError, DivergenceSentinel)
 from dcfm_tpu.utils.checkpoint import (
     AsyncCheckpointWriter, checkpoint_compatible, data_fingerprint,
     discover_checkpoint, load_checkpoint, load_checkpoint_multiprocess,
     load_checkpoint_resharded, proc_path, read_checkpoint_meta,
-    save_checkpoint, save_checkpoint_multiprocess)
+    retained_checkpoints, save_checkpoint, save_checkpoint_multiprocess)
 from dcfm_tpu.utils.estimate import (
     assemble_from_q8, assemble_from_upper, dequantize_panels,
     draw_covariance_entries, full_blocks_from_upper)
@@ -135,6 +138,16 @@ class FitResult:
     # warned about as soon as it is noticed, further saves stop, and the
     # results are returned with this field set.
     checkpoint_error: Optional[str] = None
+    # Divergence-sentinel rewinds this fit performed (FitConfig.sentinel):
+    # 0 for a healthy chain.  > 0 means NaN/Inf was detected and the chain
+    # rewound to a checkpoint with a re-lineaged RNG key and escalated
+    # ridge jitter - the result is a valid chain but NOT bit-reproducible
+    # against an undiverged run (resilience/sentinel.py).
+    sentinel_rewinds: int = 0
+    # Supervision telemetry (resilience.supervisor.SuperviseReport:
+    # launches, deaths, corrupt fallbacks) when this result came from
+    # resilience.supervise(); None for a direct fit().
+    supervise_report: Optional[Any] = None
     # Backing storage for the lazy .upper_panels property: exactly one of
     # _upper_f32 (full-precision fetch paths) or the (_q8_panels,
     # _q8_scales) pair (default quant8 fetch) is set.  Keeping the int8
@@ -670,7 +683,7 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
             if s_kept <= light_kept:
                 return None
             return source, int(smeta["iteration"]), s_acc0
-        except Exception:
+        except Exception:  # dcfm: ignore[DCFM601] - eligibility probe: any failure = sidecar not usable
             return None
 
     def _try_full_sidecar(template, light_kept):
@@ -688,7 +701,7 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
                 carry, smeta = load_checkpoint_resharded(source[1][1],
                                                          template)
             return carry, int(smeta["iteration"]), s_acc0
-        except Exception:
+        except Exception:  # dcfm: ignore[DCFM601] - sidecar load is best-effort; caller falls back to light resume
             return None
 
     def _resume_state(init_fn, Yd):
@@ -922,7 +935,7 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
                             cfg.checkpoint_path + ".full", template,
                             source=elig[0])
                         s_ok = 1
-                    except Exception:
+                    except Exception:  # dcfm: ignore[DCFM601] - failure becomes s_ok=0, surfaced via the collective gate
                         s_ok = 0
                     all_ok = multihost_utils.process_allgather(
                         np.asarray([s_ok], np.int64))
@@ -975,7 +988,38 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
             carry0 = init_fn(k_init, Yd)
         return carry0, 0, 0
 
-    def _run_chain(init_fn, get_chunk_fn, Yd, commit_fn=None):
+    def _rewind_source(template):
+        """Newest compatible, CRC-clean checkpoint among the retained
+        generations (checkpoint_keep_last) - the sentinel's rewind
+        target.  Returns (host carry, iteration, acc_start) or None."""
+        for p in retained_checkpoints(cfg.checkpoint_path):
+            try:
+                r_meta = read_checkpoint_meta(p)
+                if checkpoint_compatible(r_meta, cfg, fingerprint):
+                    continue
+                c, r_meta = load_checkpoint(p, template)
+                r_it = int(r_meta["iteration"])
+                if r_meta.get("state_only"):
+                    # light file: accumulation restarts at its iteration
+                    return c, r_it, r_it
+                return c, r_it, int(r_meta.get("acc_start", 0))
+            except Exception:  # dcfm: ignore[DCFM601] - walk the retention chain: next generation is the handling
+                continue    # corrupt/unreadable generation: try the next
+        return None
+
+    def _poison_carry(c):
+        # deterministic chaos only (faults op "poison_state"): simulate an
+        # on-device divergence by NaN-ing the loadings; the NEXT chunk's
+        # health reduction trips the sentinel exactly as a real blow-up
+        # would
+        nan = jnp.float32(jnp.nan)
+        return c._replace(
+            state=dataclasses.replace(c.state, Lambda=c.state.Lambda * nan))
+
+    def _run_chain(init_fn, chunk_fns, Yd, commit_fn=None):
+        """``chunk_fns(ni, model)`` -> the jitted chunk callable for a scan
+        of ``ni`` iterations under ``model`` - the base ModelConfig, or the
+        sentinel's jitter-escalated variant after a rewind."""
         t_init = time.perf_counter()
         carry, done, acc_start = (_resume_state_multiproc if multiproc
                                   else _resume_state)(init_fn, Yd)
@@ -1031,15 +1075,126 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
         if auto_cadence:
             cadence = 1
         since_save, saves_done, ck_error = 0, 0, None
-        chunk_lens = _chunks(executed)
-        for ci, ni in enumerate(chunk_lens):
+
+        def _save_failure(e, last):
+            """The ONE home of the save-failure policy: before the final
+            boundary a broken save re-raises (resume-from-last-checkpoint
+            is what the feature is for - fail fast, lose one chunk); once
+            the chain is complete it must never be discarded for a
+            save-only error, so the failure downgrades to a warning +
+            FitResult.checkpoint_error."""
+            nonlocal ck_error
+            if not last:
+                raise e
+            import warnings
+            warnings.warn(
+                f"checkpoint save failed: {e!r}; results are returned "
+                "but the run is NOT resumable from its end", RuntimeWarning)
+            ck_error = repr(e)
+        # Deterministic fault harness (resilience/faults.py): None outside
+        # chaos runs - every hook below is then skipped at one truthiness
+        # check.
+        plan = fault_plan()
+        # Divergence sentinel (FitConfig.sentinel; resilience/sentinel.py):
+        # host-side policy over the per-chunk non-finite reductions the
+        # device already computes.  "auto" resolves to rewind when there
+        # is a checkpoint to rewind to (single-process - a collective
+        # rewind would need its own unanimity protocol), abort otherwise.
+        s_mode = cfg.sentinel
+        if s_mode == "auto":
+            s_mode = ("rewind" if cfg.checkpoint_path and not multiproc
+                      else "abort")
+        elif s_mode == "rewind" and multiproc:
+            import warnings
+            warnings.warn(
+                "sentinel='rewind' is not supported on multi-process "
+                "runs (a collective rewind needs its own unanimity "
+                "protocol); degrading to 'abort' - a divergence will "
+                "raise ChainDivergedError instead of rewinding",
+                RuntimeWarning)
+            s_mode = "abort"
+        sentinel = None
+        if s_mode in ("abort", "rewind") and executed:
+            # baseline: historical non-finite counts a RESUMED carry may
+            # already hold - only NEW divergence trips
+            h = (jax.device_get(_replicate_jit(mesh)(carry.health))
+                 if multiproc else jax.device_get(carry.health))
+            sentinel = DivergenceSentinel(
+                s_mode, max_rewinds=cfg.sentinel_max_rewinds,
+                baseline_nonfinite=float(np.asarray(h)[..., 3].sum()),
+                base_jitter=m.ridge_jitter)
+        m_active = m
+        # local binding: a rewind re-lineages the chain key for THIS run
+        # only (fold_in below); the fit-level k_chain closure must stay
+        # untouched
+        key_chain = k_chain
+        rewind_template = None
+        # global iteration the TRACE array starts at: `done` unless a
+        # rewind falls back to a retained checkpoint older than the
+        # resume point (then the re-run traces start earlier, and the
+        # diagnostics' post-burn-in slice must follow)
+        trace0 = done
+        it_now = done                 # global iteration at chunk boundaries
+        queue = _chunks(executed)
+        qi = 0
+        while qi < len(queue):
+            ni = queue[qi]
+            qi += 1
             tc = time.perf_counter()
-            carry, stats, trace = get_chunk_fn(ni)(k_chain, Yd, carry, sched)
-            traces.append(np.asarray(trace))
+            carry, stats, trace = chunk_fns(ni, m_active)(
+                key_chain, Yd, carry, sched)
+            trace_host = np.asarray(trace)
             chunk_secs.append(time.perf_counter() - tc)
-            if writer is None:
+            it_now += ni
+            traces.append((it_now - ni, trace_host))
+            last = qi == len(queue)
+            if sentinel is not None and sentinel.tripped(stats):
+                reloaded = None
+                if sentinel.mode == "rewind":
+                    if writer is not None:
+                        try:
+                            writer.wait()     # no racing an in-flight save
+                        except Exception:  # dcfm: ignore[DCFM601] - a failed save of a garbage carry is moot mid-rewind
+                            pass   # a failed save is moot mid-rewind
+                    if rewind_template is None:
+                        rewind_template = jax.eval_shape(init_fn, k_init, Yd)
+                    reloaded = _rewind_source(rewind_template)
+                if reloaded is None:
+                    raise ChainDivergedError(
+                        "chain produced non-finite values in the chunk "
+                        f"ending at iteration {it_now}"
+                        + (" and no usable checkpoint exists to rewind to"
+                           if sentinel.mode == "rewind"
+                           else " (sentinel mode 'abort')"),
+                        iteration=it_now, rewinds=sentinel.rewinds)
+                sentinel.record_rewind(it_now)   # raises past the budget
+                bad = carry
+                carry, it_now, acc_start = reloaded
+                trace0 = min(trace0, it_now)
+                jax.tree.map(
+                    lambda a: a.delete() if isinstance(a, jax.Array)
+                    else None, bad)
+                if commit_fn is not None:
+                    carry = commit_fn(carry)
+                # drop the poisoned chunks' traces, re-lineage the chain
+                # key (the retry must not deterministically re-enter the
+                # same blow-up) and escalate the ridge jitter; the resumed
+                # schedule re-chunks the remaining iterations
+                traces = [(s, t) for s, t in traces if s < it_now]
+                key_chain = jax.random.fold_in(key_chain, sentinel.rewinds)
+                m_active = dataclasses.replace(
+                    m_active, ridge_jitter=sentinel.escalated_jitter())
+                queue = _chunks(run.total_iters - it_now)
+                qi = 0
+                since_save = 0
                 continue
-            last = ci == len(chunk_lens) - 1
+            if writer is None:
+                if plan is not None:
+                    plan.maybe_kill(it_now, done, "pre_save")
+                    plan.maybe_kill(it_now, done, "post_save")
+                    if plan.poison_due(it_now, done):
+                        carry = _poison_carry(carry)
+                continue
             if writer.poll_error() is not None and not last:
                 # Durability broke mid-run (disk full, ...): fail at the
                 # NEXT chunk boundary - one chunk of lost compute instead
@@ -1063,6 +1218,11 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
                 cadence = max(1, int(np.ceil(
                     1.5 * writer.last_save_seconds / max(mean_chunk, 1e-9))))
             since_save += 1
+            if plan is not None:
+                # "pre_save" kills land BEFORE this boundary's save, so the
+                # checkpoint never advances past the trigger - the poison-
+                # iteration drill (resilience/faults.py)
+                plan.maybe_kill(it_now, done, "pre_save")
             # the last boundary always saves (so a finished run resumes as
             # a no-op under mode="full", or hands its exact state to a
             # chain extension under "light").  A still-running previous
@@ -1070,6 +1230,7 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
             # instead of join-blocking the chain behind the link - so even
             # a mis-sized cadence (or a periodic full save in light mode)
             # degrades to a later save, never to a stall.
+            saved_this_boundary = False
             if (since_save >= cadence and not writer.busy()) or last:
                 full_due = (light_mode and cfg.checkpoint_full_every > 0
                             and (saves_done + 1)
@@ -1095,21 +1256,31 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
                     writer.submit(save_fn, target, carry, cfg,
                                   fingerprint=fingerprint,
                                   state_only=light_mode and not full_due,
-                                  acc_start=acc_start)
+                                  acc_start=acc_start,
+                                  keep_last=cfg.checkpoint_keep_last)
+                    saved_this_boundary = True
                 except Exception as e:
-                    # submit joins the previous save; its failure on the
-                    # LAST boundary must not discard the finished chain
-                    if not last:
-                        raise
-                    import warnings
-                    warnings.warn(
-                        f"checkpoint save failed: {e!r}; results are "
-                        "returned but the run is NOT resumable from its "
-                        "end", RuntimeWarning)
-                    ck_error = repr(e)
+                    # submit joins the previous save; see _save_failure
+                    _save_failure(e, last)
                 phase["checkpoint_s"] += time.perf_counter() - t_ck
                 since_save = 0
                 saves_done += 1
+            if plan is not None:
+                # chaos determinism: a "post_save" kill must observe a
+                # DURABLE save, so it only arms at a boundary whose save
+                # actually happened (cadence > 1 skips boundaries; the
+                # kill then lands at the NEXT saving boundary) - and the
+                # write-behind writer is flushed first (a background
+                # failure surfaces here exactly as the poll_error path
+                # would, downgraded on the final boundary only)
+                if saved_this_boundary:
+                    try:
+                        writer.wait()
+                    except Exception as e:
+                        _save_failure(e, last)
+                    plan.maybe_kill(it_now, done, "post_save")
+                if plan.poison_due(it_now, done):
+                    carry = _poison_carry(carry)
         if writer is not None:
             # the last save must be durable before fit() returns; a failure
             # here must not discard a finished chain's results
@@ -1117,15 +1288,11 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
             try:
                 writer.wait()
             except Exception as e:
-                import warnings
-                warnings.warn(
-                    f"final checkpoint save failed: {e!r}; results are "
-                    "returned but the run is NOT resumable from its end",
-                    RuntimeWarning)
-                ck_error = repr(e)
+                _save_failure(e, True)    # chain complete: downgrade
             phase["checkpoint_s"] += time.perf_counter() - t_ck
-        return (carry, stats, executed, traces, chunk_secs, done,
-                acc_start, ck_error)
+        return (carry, stats, executed, [t for _, t in traces], chunk_secs,
+                done, acc_start, ck_error,
+                sentinel.rewinds if sentinel is not None else 0, trace0)
 
     C = run.num_chains
     # static draw-buffer size (0 = feature off); see RunConfig.store_draws
@@ -1169,9 +1336,10 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
                                out_shardings=shardings)(c)
 
             (carry, stats, executed, traces, chunk_secs, done, acc_start,
-             ck_error) = _run_chain(
+             ck_error, rewinds, trace0) = _run_chain(
                 _mesh_fns(mesh, m, chunk, C, S_draws, unroll)[0],
-                lambda ni: _mesh_fns(mesh, m, ni, C, S_draws, unroll)[1],
+                lambda ni, m2: _mesh_fns(mesh, m2, ni, C, S_draws,
+                                         unroll)[1],
                 Yd, commit_fn=None if multiproc else _commit_mesh)
         else:
             with jax.default_device(devices[0]):
@@ -1191,9 +1359,10 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
                 # chunk function (~7s at the p=10k bench shape).
                 init_fn = _local_fns(m, chunk, C, S_draws, unroll)[0]
                 (carry, stats, executed, traces, chunk_secs, done, acc_start,
-                 ck_error) = _run_chain(
+                 ck_error, rewinds, trace0) = _run_chain(
                     lambda k, Y: jax.device_put(init_fn(k, Y), devices[0]),
-                    lambda ni: _local_fns(m, ni, C, S_draws, unroll)[1], Yd,
+                    lambda ni, m2: _local_fns(m2, ni, C, S_draws,
+                                              unroll)[1], Yd,
                     # jit copy FIRST (fresh XLA-owned buffers - a raw
                     # device_put of the loader's numpy can zero-copy
                     # alias memory that dies at the commit rebind; see
@@ -1214,7 +1383,13 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
                            ps_min=h[..., 1].min(), ps_max=h[..., 2].max(),
                            rank_min=ranks.min(), rank_max=ranks.max(),
                            rank_mean=ranks.mean(),
-                           nonfinite_count=h[..., 3].sum())
+                           nonfinite_count=h[..., 3].sum(),
+                           # jnp on the (possibly sharded) global array -
+                           # a plain SPMD reduction, host-fetchable scalar
+                           acc_nonfinite=float(np.asarray(jax.device_get(
+                               jnp.sum(jnp.logical_not(jnp.isfinite(
+                                   carry.sigma_acc)).astype(jnp.float32))
+                           ))))
     else:
         # reduce the per-chain stats leaves ((C,) arrays when num_chains > 1)
         # to the scalar cross-chain summary.
@@ -1224,7 +1399,8 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
             ps_min=np.min(stats.ps_min), ps_max=np.max(stats.ps_max),
             rank_min=np.min(stats.rank_min), rank_max=np.max(stats.rank_max),
             rank_mean=np.mean(stats.rank_mean),
-            nonfinite_count=np.sum(stats.nonfinite_count))
+            nonfinite_count=np.sum(stats.nonfinite_count),
+            acc_nonfinite=np.sum(stats.acc_nonfinite))
 
     # Per-iteration scalar traces -> (C, executed, S) + convergence report.
     if traces:
@@ -1232,7 +1408,9 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
             [t if t.ndim == 3 else t[None] for t in traces], axis=1)
     else:
         trace_arr = np.zeros((C, 0, len(TRACE_SUMMARIES)))
-    diagnostics = _diagnose(trace_arr, done, run)
+    # trace0, not done: a sentinel rewind onto a retained checkpoint older
+    # than the resume point makes the traces start below `done`
+    diagnostics = _diagnose(trace_arr, trace0, run)
 
     # Fetch results: the packed panel accumulator dominates device->host
     # traffic (p^2/g^2 bytes per block pair); the carry already stores
@@ -1375,6 +1553,7 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
         draws=draws,
         Y_imputed=Y_imputed,
         checkpoint_error=ck_error,
+        sentinel_rewinds=rewinds,
     )
 
 

@@ -46,6 +46,11 @@ def build_parser() -> argparse.ArgumentParser:
         help="run pytest one subprocess per test file, so a native "
              "crash (SIGABRT/SIGSEGV) fails one file instead of the "
              "whole suite")
+    sub.add_parser(
+        "supervise", add_help=False,
+        help="run any dcfm-tpu command under the crash supervisor "
+             "(auto-resume with backoff, checkpoint integrity fallback, "
+             "poison-iteration abort); see `dcfm-tpu supervise --help`")
 
     # Posterior-serving subsystem (dcfm_tpu/serve; README "Serving the
     # posterior"): export a completed fit to a memory-mapped artifact,
@@ -207,6 +212,34 @@ def build_parser() -> argparse.ArgumentParser:
                         "incompatible checkpoint is a hard refusal, never "
                         "a silent restart (a same-topology resumed chain "
                         "is bitwise-identical to an uninterrupted one)")
+    f.add_argument("--keep-last", type=int, default=1, metavar="K",
+                   help="retain K checkpoint generations (the live file "
+                        "plus K-1 rotated .bakN predecessors); >= 2 lets "
+                        "a CRC-corrupt newest checkpoint fall back to the "
+                        "previous one instead of restarting from zero")
+    f.add_argument("--sentinel", default="auto",
+                   choices=("auto", "off", "abort", "rewind"),
+                   help="divergence sentinel policy on NaN/Inf in the "
+                        "chain: rewind to the last checkpoint with a "
+                        "re-lineaged RNG key and escalated ridge jitter, "
+                        "abort with a typed error, or off (pre-sentinel "
+                        "behavior: garbage runs to completion).  auto = "
+                        "rewind when checkpointing, abort otherwise")
+    f.add_argument("--supervise", action="store_true",
+                   help="run the fit in a supervised child process: on "
+                        "crash/SIGKILL/preemption it resumes from the "
+                        "last good checkpoint with exponential backoff; "
+                        "a CRC-corrupt checkpoint falls back to the "
+                        "previous retained one (--keep-last >= 2); the "
+                        "same iteration killing the child twice aborts "
+                        "with a typed poison report.  Requires "
+                        "--checkpoint")
+    f.add_argument("--supervise-max-retries", type=int, default=5,
+                   metavar="N", help="relaunch budget under --supervise")
+    f.add_argument("--supervise-backoff", type=float, default=1.0,
+                   metavar="S",
+                   help="base of the exponential relaunch backoff "
+                        "(seconds) under --supervise")
     return p
 
 
@@ -222,7 +255,42 @@ def main(argv=None) -> int:
     if raw and raw[0] == "test-isolated":
         from dcfm_tpu.analysis.isolate import main as isolate_main
         return isolate_main(raw[1:])
+    if raw and raw[0] == "supervise":
+        from dcfm_tpu.resilience.supervisor import supervise_cli
+        return supervise_cli(raw[1:])
     args = build_parser().parse_args(argv)
+    if args.command == "fit" and args.supervise:
+        # Supervised mode re-runs THIS CLI (minus the supervise flags,
+        # plus --resume) in child processes; the supervisor handles
+        # relaunch/backoff/poison detection.  Dispatch before any jax
+        # import - the parent never touches the accelerator.
+        if not args.checkpoint:
+            raise SystemExit("--supervise requires --checkpoint (the "
+                             "resume substrate)")
+        from dcfm_tpu.resilience.supervisor import run_supervised_cli
+        child, skip = [], 0
+        for tok in raw:
+            if skip:
+                skip -= 1
+                continue
+            if tok == "--supervise":
+                continue
+            if tok in ("--supervise-max-retries", "--supervise-backoff"):
+                skip = 1
+                continue
+            if tok.startswith(("--supervise-max-retries=",
+                               "--supervise-backoff=")):
+                continue
+            child.append(tok)
+        if "--resume" not in child:
+            child.append("--resume")
+        # the launch/report/typed-error protocol lives in ONE place
+        # (supervisor.run_supervised_cli, shared with `dcfm-tpu
+        # supervise`)
+        return run_supervised_cli(
+            child, checkpoint=args.checkpoint,
+            max_retries=args.supervise_max_retries,
+            backoff_base=args.supervise_backoff)
     # serve/export dispatch before the jax-heavy fit imports: serving an
     # existing artifact needs no accelerator stack at all, and export's
     # jax use (checkpoint template) is loaded lazily inside it.
@@ -264,7 +332,7 @@ def main(argv=None) -> int:
         try:
             resume = discover_checkpoint(args.checkpoint,
                                          prefer_plain=True) is not None
-        except Exception:
+        except Exception:  # dcfm: ignore[DCFM601] - unreadable checkpoint: strict resume surfaces why
             resume = True        # unreadable: let strict mode say why
     cfg = FitConfig(
         model=ModelConfig(
@@ -290,6 +358,8 @@ def main(argv=None) -> int:
         checkpoint_every_chunks=args.checkpoint_every,
         checkpoint_mode=args.checkpoint_mode,
         checkpoint_full_every=args.checkpoint_full_every,
+        checkpoint_keep_last=args.keep_last,
+        sentinel=args.sentinel,
     )
     res = fit(Y, cfg)
     Sigma = (res.covariance(destandardize=False)

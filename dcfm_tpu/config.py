@@ -155,6 +155,14 @@ class ModelConfig:
     # meshes, where devices run truly concurrently).  Must divide
     # num_shards.
     combine_chunks: int = 1
+    # Ridge term added to the two K x K sampling precisions (the Lambda
+    # update's Q and the X update's Qx) before their Cholesky.  0.0 (the
+    # default) adds NOTHING - the compiled graphs are bit-identical to
+    # the pre-knob code.  The divergence sentinel (FitConfig.sentinel)
+    # escalates this on rewind-after-NaN: a failed factorization is the
+    # dominant blow-up mode, and a small ridge makes the retried
+    # trajectory numerically strictly safer.
+    ridge_jitter: float = 0.0
     mgp: MGPConfig = MGPConfig()
     horseshoe: HorseshoeConfig = HorseshoeConfig()
     dl: DLConfig = DLConfig()
@@ -304,6 +312,35 @@ class FitConfig:
     # degrades to the light resume on every process, never to divergent
     # branches).
     checkpoint_full_every: int = 0
+    # Checkpoint retention: keep this many generations - the live file
+    # plus keep_last-1 rotated ``.bakK`` predecessors (utils/checkpoint
+    # retained_checkpoints).  1 (default) = overwrite in place, the old
+    # behavior.  >= 2 is what makes CRC-detected corruption of the
+    # newest checkpoint recoverable: the supervisor (resilience/
+    # supervisor.py) demotes the corrupt file and resumes from the
+    # previous retained one instead of restarting from zero.
+    checkpoint_keep_last: int = 1
+    # Divergence sentinel (resilience/sentinel.py): watches the chain's
+    # per-chunk non-finite reductions and, instead of silently writing
+    # garbage draws after a NaN/Inf blow-up:
+    #   "rewind" - reload the last good checkpoint, re-lineage the chain
+    #              RNG key (fold_in of the rewind count - the retried
+    #              trajectory must not deterministically re-enter the
+    #              same blow-up) and escalate ModelConfig.ridge_jitter;
+    #              documented NON-bit-exact vs an undiverged run.
+    #   "abort"  - raise a typed ChainDivergedError at the chunk
+    #              boundary where the divergence was detected.
+    #   "auto"   - "rewind" when checkpointing is configured (single-
+    #              process runs), "abort" otherwise.  The default: a
+    #              healthy chain is bitwise unaffected either way (the
+    #              sentinel only READS the health stats every chunk).
+    #   "off"    - pre-sentinel behavior (divergence runs to completion
+    #              and poisons the accumulators).
+    sentinel: str = "auto"
+    # Rewind budget: after this many rewinds the sentinel aborts with
+    # ChainDivergedError instead of looping (each rewind escalates the
+    # ridge jitter 10x, so the budget also caps the jitter).
+    sentinel_max_rewinds: int = 3
 
 
 def validate(cfg: FitConfig, n: int, p: int) -> None:
@@ -382,6 +419,25 @@ def validate(cfg: FitConfig, n: int, p: int) -> None:
         raise ValueError(
             f"checkpoint_full_every must be >= 0, got "
             f"{cfg.checkpoint_full_every}")
+    if cfg.checkpoint_keep_last < 1:
+        raise ValueError(
+            f"checkpoint_keep_last must be >= 1, got "
+            f"{cfg.checkpoint_keep_last}")
+    if cfg.sentinel not in ("auto", "off", "abort", "rewind"):
+        raise ValueError(
+            f"unknown sentinel mode {cfg.sentinel!r} "
+            "(auto | off | abort | rewind)")
+    if cfg.sentinel == "rewind" and not cfg.checkpoint_path:
+        raise ValueError(
+            "sentinel='rewind' requires checkpoint_path (there is nothing "
+            "to rewind to); use 'abort', or 'auto' which degrades itself")
+    if cfg.sentinel_max_rewinds < 0:
+        raise ValueError(
+            f"sentinel_max_rewinds must be >= 0, got "
+            f"{cfg.sentinel_max_rewinds}")
+    if m.ridge_jitter < 0:
+        raise ValueError(
+            f"ridge_jitter must be >= 0, got {m.ridge_jitter}")
     if cfg.backend.fetch_dtype not in ("float32", "bfloat16", "float16",
                                        "quant8"):
         raise ValueError(

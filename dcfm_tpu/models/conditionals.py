@@ -155,9 +155,18 @@ def _gibbs_sweep(key, Y, state, cfg, prior, *, shard_offset, reduce_fn):
     # reference's single tic/toc lacks (SURVEY.md section 5 "Tracing").
 
     # ---- I) Z_m | rest  (``divideconquer.m:95-108``) -------------------
+    # Sentinel-escalated ridge (ModelConfig.ridge_jitter): a small extra
+    # diagonal on every K x K sampling precision.  Guarded at TRACE time -
+    # the default 0.0 compiles exactly the pre-knob graph, so healthy runs
+    # are bit-identical; only a divergence rewind (resilience/sentinel.py)
+    # compiles a jittered variant.
+    jit_eps = float(cfg.ridge_jitter)
+
     def z_update(kg, Ym, Lam, ps, X):
         W = weighted(Lam, ps)                                   # (P, K)
         Q = jnp.eye(K, dtype=Ym.dtype) + (1.0 - rho) * (Lam.T @ W)
+        if jit_eps:
+            Q = Q + jit_eps * jnp.eye(K, dtype=Ym.dtype)
         R = Ym - sq_r * (X @ Lam.T)                             # (n, P)
         B = sq_1mr * (R @ W)                                    # (n, K)
         return sample_mvn_precision_shared(kg, Q, B)
@@ -182,6 +191,8 @@ def _gibbs_sweep(key, Y, state, cfg, prior, *, shard_offset, reduce_fn):
         # Model-implied prior precision is I_K (X ~ N(0, I)); the reference
         # uses g*I (quirk Q3) - reproduce via cfg.x_prior_precision.
         Qx = cfg.x_prior_precision * jnp.eye(K, dtype=Y.dtype) + rho * S1
+        if jit_eps:
+            Qx = Qx + jit_eps * jnp.eye(K, dtype=Y.dtype)
         Bx = sq_r * S2
         # Unfolded site key: X is replicated, every device draws identically.
         X = sample_mvn_precision_shared(
@@ -192,6 +203,11 @@ def _gibbs_sweep(key, Y, state, cfg, prior, *, shard_offset, reduce_fn):
 
     # ---- Lambda | rest  (``:136-146``) ---------------------------------
     plam = jax.vmap(prior.row_precision)(state.prior)           # (Gl, P, K)
+    if jit_eps:
+        # the Lambda precision is diag(plam) + ps*E, so adding the ridge
+        # to plam adds exactly jit_eps*I - and flows through the pallas
+        # kernels (which form Q in-kernel from plam) unchanged
+        plam = plam + jit_eps
 
     # Under adaptive rank truncation (models/adapt.py) inactive columns are
     # conditioned at Lambda_h = 0.  Masking eta's inactive columns *before*

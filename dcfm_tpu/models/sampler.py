@@ -41,7 +41,7 @@ try:  # pragma: no cover - exercised only on jax versions missing the rule
         def _opt_barrier_batcher(args, dims):
             return _opt_barrier_p.bind(*args), dims
         _batching.primitive_batchers[_opt_barrier_p] = _opt_barrier_batcher
-except Exception:  # future jax moved the private primitive: rule ships there
+except Exception:  # dcfm: ignore[DCFM601] - future jax moved the private primitive: rule ships there
     pass
 
 from dcfm_tpu.config import ModelConfig, RunConfig
@@ -128,6 +128,15 @@ class ChainStats(NamedTuple):
     # non-finite value - a failed K x K Cholesky propagates NaN into Lambda,
     # so this is the Cholesky-failure/NaN counter.  0 on a healthy chain.
     nonfinite_count: jax.Array
+    # Non-finite entries in the covariance accumulator at chunk end - ONE
+    # cheap all-finite reduction per CHUNK (not per iteration), the
+    # device half of the divergence sentinel (resilience/sentinel.py):
+    # state-level NaN is caught per iteration by `nonfinite_count`, this
+    # catches accumulator poisoning directly (e.g. a resumed corrupt
+    # carry) so a blown-up chain cannot silently write garbage draws.
+    # Plain-float default (not a jax array: constructing one at class
+    # definition would initialize the backend at import time).
+    acc_nonfinite: "jax.Array | float" = 0.0
 
 
 def effective_ranks(state: SamplerState) -> jax.Array:
@@ -505,5 +514,10 @@ def run_chunk(
         rank_max=jnp.max(ranks),
         rank_mean=jnp.mean(ranks),
         nonfinite_count=jnp.sum(carry.health[:, 3]),
+        # once per chunk, amortized over num_iters sweeps - the sentinel's
+        # accumulator watch (see the ChainStats field comment)
+        acc_nonfinite=jnp.sum(
+            jnp.logical_not(jnp.isfinite(carry.sigma_acc))
+            .astype(jnp.float32)),
     )
     return carry, stats, trace

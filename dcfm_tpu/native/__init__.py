@@ -147,7 +147,7 @@ def _load() -> Optional[ctypes.CDLL]:
             except AttributeError:
                 pass
             _lib = lib
-        except Exception:
+        except Exception:  # dcfm: ignore[DCFM601] - no compiler/toolchain: numpy fallback is the handling
             _build_failed = True
             _lib = None
         return _lib

@@ -186,7 +186,9 @@ def build_mesh_chain(
             rank_max=lax.pmax(stats.rank_max, SHARD_AXIS),
             # devices hold equal shard counts, so the mean of means is exact
             rank_mean=lax.pmean(stats.rank_mean, SHARD_AXIS),
-            nonfinite_count=lax.psum(stats.nonfinite_count, SHARD_AXIS))
+            nonfinite_count=lax.psum(stats.nonfinite_count, SHARD_AXIS),
+            # each device counted its own packed-accumulator slice
+            acc_nonfinite=lax.psum(stats.acc_nonfinite, SHARD_AXIS))
         return carry, stats, trace
 
     specs = carry_specs()

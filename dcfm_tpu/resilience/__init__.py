@@ -1,0 +1,47 @@
+"""Fault-tolerant runs: supervised auto-resume, deterministic fault
+injection, and the on-chain divergence sentinel.
+
+At production scale (ROADMAP north star: long sharded Gibbs runs serving
+heavy traffic) preemption, torn writes, and numerical blow-ups are
+routine events, not edge cases.  This package makes surviving them a
+first-class, *tested* subsystem:
+
+* :mod:`dcfm_tpu.resilience.supervisor` - ``supervise()`` /
+  ``dcfm-tpu fit --supervise``: run the fit in a child process and, on
+  crash/SIGKILL/preemption, resume from the last good checkpoint with
+  exponential backoff, a max-retry budget, and poison-iteration
+  detection (the same iteration killing the child twice aborts with a
+  typed :class:`PoisonedRunError` instead of crash-looping forever).
+* :mod:`dcfm_tpu.resilience.faults` - a deterministic fault-injection
+  harness driven by the ``DCFM_FAULT_PLAN`` environment variable
+  (kill-at-iteration, torn checkpoint write, bit-flip corruption,
+  failing/delayed I/O), threaded through ``utils/checkpoint.py`` and
+  ``serve/artifact.py`` so chaos tests replay exact failure sequences.
+* :mod:`dcfm_tpu.resilience.sentinel` - the divergence sentinel api.fit
+  folds into the chunk loop: on NaN/Inf in the chain it rewinds to the
+  last checkpoint with a re-lineaged RNG key and an escalated ridge
+  jitter instead of silently writing garbage draws.
+
+Checkpoint integrity (per-leaf CRC32 verified on load, ``keep_last``
+retention so a fallback always exists) lives with the checkpoint format
+itself in :mod:`dcfm_tpu.utils.checkpoint`.
+"""
+
+from dcfm_tpu.resilience.faults import FaultPlan, fault_plan
+from dcfm_tpu.resilience.sentinel import (
+    ChainDivergedError, DivergenceSentinel)
+from dcfm_tpu.resilience.supervisor import (
+    PoisonedRunError, RetriesExhaustedError, SuperviseReport, supervise,
+    supervise_command)
+
+__all__ = [
+    "ChainDivergedError",
+    "DivergenceSentinel",
+    "FaultPlan",
+    "fault_plan",
+    "PoisonedRunError",
+    "RetriesExhaustedError",
+    "SuperviseReport",
+    "supervise",
+    "supervise_command",
+]

@@ -1,0 +1,48 @@
+"""Child-process fit runner for :func:`dcfm_tpu.resilience.supervise`.
+
+``python -m dcfm_tpu.resilience._child cfg.json Y.npy`` deserializes the
+FitConfig the parent wrote, loads the data matrix, and runs ``fit`` with
+resume-if-anything-exists semantics (strict once a checkpoint source is
+discoverable - identical to the CLI's --resume rule, so an incompatible
+checkpoint is a hard refusal, never a silent restart over the old run's
+progress).  Exit code 0 means the chain COMPLETED and its final full
+checkpoint is durable; any other exit (including death by signal) is the
+supervisor's cue to verify, back off, and relaunch.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import sys
+
+import numpy as np
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if len(argv) != 2:
+        print("usage: python -m dcfm_tpu.resilience._child cfg.json Y.npy",
+              file=sys.stderr)
+        return 2
+    cfg_path, data_path = argv
+    from dcfm_tpu.utils.checkpoint import (
+        config_from_checkpoint_meta, discover_checkpoint)
+
+    with open(cfg_path, "r", encoding="utf-8") as f:
+        cfg = config_from_checkpoint_meta({"config": json.load(f)})
+    resume = False
+    try:
+        resume = discover_checkpoint(cfg.checkpoint_path,
+                                     prefer_plain=True) is not None
+    except Exception:  # dcfm: ignore[DCFM601] - unreadable checkpoint: strict resume surfaces why
+        resume = True      # unreadable: let strict mode surface why
+    cfg = dataclasses.replace(cfg, resume=resume)
+
+    from dcfm_tpu.api import fit
+    fit(np.load(data_path), cfg)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,246 @@
+"""Deterministic fault injection: replay exact failure sequences on purpose.
+
+Crash-recovery code that is only ever exercised by real crashes is
+untested code.  This module turns the failure modes the resilience layer
+claims to survive into *scheduled, reproducible events*, driven by the
+``DCFM_FAULT_PLAN`` environment variable so a chaos test (or a manual
+drill) states exactly which fault fires when - and a failing run can be
+replayed bit-for-bit.
+
+``DCFM_FAULT_PLAN`` holds either the JSON plan itself or ``@/path/to/
+plan.json``.  Schema::
+
+    {"faults": [
+      {"op": "kill",        "at_iteration": 16, "when": "post_save"},
+      {"op": "poison_state","at_iteration": 16},
+      {"op": "torn_write",  "target": "checkpoint", "at_write": 2,
+                            "keep_fraction": 0.5},
+      {"op": "bit_flip",    "target": "checkpoint", "at_write": 2,
+                            "leaf": "leaf_3"},
+      {"op": "io_error",    "target": "checkpoint", "at_write": 1},
+      {"op": "io_delay",    "target": "artifact",   "at_write": 1,
+                            "seconds": 0.25}
+    ]}
+
+Ops:
+
+* ``kill`` - SIGKILL this process at the first chunk boundary whose
+  global iteration is >= ``at_iteration``.  ``when`` is ``"post_save"``
+  (default: the boundary's checkpoint save completes first - the
+  supervised-resume drill) or ``"pre_save"`` (the kill lands before the
+  save, so the checkpoint never advances past the boundary - the
+  poison-iteration drill: every relaunch dies at the same place).
+  A fault only fires when the run *started* below ``at_iteration``, so
+  a resumed child that already progressed past the kill point does not
+  re-die - which is exactly what makes the post-save drill terminate
+  and the pre-save drill loop (until the supervisor's poison detector
+  aborts it).
+* ``poison_state`` - at the matching boundary the caller (api.fit)
+  multiplies the carried sampler state by NaN, simulating an on-device
+  divergence; the next chunk's health reduction trips the sentinel.
+* ``torn_write`` - the ``at_write``-th write to ``target`` is truncated
+  to ``keep_fraction`` of its bytes AFTER the atomic rename, simulating
+  a filesystem that acknowledged then lost the tail of the file.
+* ``bit_flip`` - flips the lowest bit of the first byte of payload
+  entry ``leaf`` (default: the largest entry) on the ``at_write``-th
+  write, AFTER integrity checksums are computed - a silent media error
+  the CRC verification must catch.
+* ``io_error`` / ``io_delay`` - the ``at_write``-th write to ``target``
+  raises ``OSError`` / sleeps ``seconds`` first.
+
+Write counters are 1-based and PER-PROCESS (a relaunched child counts
+its own writes from zero), which keeps every plan deterministic without
+cross-process state.  Targets: ``"checkpoint"`` (``utils/checkpoint``
+saves) and ``"artifact"`` (``serve/artifact`` exports); an optional
+``"path_re"`` regex narrows a fault to matching paths (e.g. exclude the
+``.full`` sidecar).
+
+Everything is stdlib + numpy; with no plan installed every hook is a
+cheap no-op (one truthiness check).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import signal
+import time
+from typing import Optional
+
+import numpy as np
+
+ENV_VAR = "DCFM_FAULT_PLAN"
+
+_VALID_OPS = {"kill", "poison_state", "torn_write", "bit_flip", "io_error",
+              "io_delay"}
+
+
+class FaultPlanError(ValueError):
+    """Malformed DCFM_FAULT_PLAN."""
+
+
+class FaultPlan:
+    """A parsed fault plan plus its per-process trigger state."""
+
+    def __init__(self, spec: dict):
+        faults = spec.get("faults")
+        if not isinstance(faults, list):
+            raise FaultPlanError(
+                "fault plan must be {'faults': [...]}, got "
+                f"{type(spec).__name__} without a 'faults' list")
+        self.faults = []
+        for i, f in enumerate(faults):
+            op = f.get("op")
+            if op not in _VALID_OPS:
+                raise FaultPlanError(
+                    f"fault #{i}: unknown op {op!r} "
+                    f"(expected one of {sorted(_VALID_OPS)})")
+            if op in ("kill", "poison_state") and "at_iteration" not in f:
+                raise FaultPlanError(f"fault #{i}: {op} needs at_iteration")
+            if op in ("torn_write", "bit_flip", "io_error", "io_delay") \
+                    and "at_write" not in f:
+                raise FaultPlanError(f"fault #{i}: {op} needs at_write")
+            self.faults.append(dict(f))
+        # 1-based write counters, keyed per target
+        self._writes: dict = {}
+        self._fired: set = set()
+
+    @classmethod
+    def from_env(cls) -> Optional["FaultPlan"]:
+        raw = os.environ.get(ENV_VAR)
+        if not raw:
+            return None
+        if raw.startswith("@"):
+            with open(raw[1:], "r", encoding="utf-8") as f:
+                raw = f.read()
+        try:
+            spec = json.loads(raw)
+        except json.JSONDecodeError as e:
+            raise FaultPlanError(f"{ENV_VAR} is not valid JSON: {e}") from e
+        return cls(spec)
+
+    # -- boundary faults (kill / poison) -------------------------------
+    def _boundary_due(self, op: str, phase: str, iteration: int,
+                      start_iteration: int):
+        for i, f in enumerate(self.faults):
+            if f["op"] != op or (i, op) in self._fired:
+                continue
+            if op == "kill" and f.get("when", "post_save") != phase:
+                continue
+            at = int(f["at_iteration"])
+            # only runs that STARTED below the trigger fire it: a resumed
+            # child already past the point must not re-die (see module doc)
+            if iteration >= at and start_iteration < at:
+                self._fired.add((i, op))
+                return f
+        return None
+
+    def maybe_kill(self, iteration: int, start_iteration: int,
+                   phase: str) -> None:
+        """SIGKILL this process if a kill fault matches this boundary.
+        ``phase`` is "pre_save" or "post_save"."""
+        f = self._boundary_due("kill", phase, iteration, start_iteration)
+        if f is not None:
+            os.kill(os.getpid(), signal.SIGKILL)
+
+    def poison_due(self, iteration: int, start_iteration: int) -> bool:
+        """True exactly once when a poison_state fault matches."""
+        return self._boundary_due(
+            "poison_state", "post_save", iteration, start_iteration
+        ) is not None
+
+    # -- write faults --------------------------------------------------
+    def _write_faults(self, target: str, path: str, count: int):
+        for f in self.faults:
+            if f["op"] in ("kill", "poison_state"):
+                continue
+            if f.get("target", "checkpoint") != target:
+                continue
+            if int(f["at_write"]) != count:
+                continue
+            pr = f.get("path_re")
+            if pr and not re.search(pr, path):
+                continue
+            yield f
+
+    def on_write(self, target: str, path: str) -> int:
+        """Count a write to ``target`` and apply io_error/io_delay faults.
+        Returns the (1-based) write ordinal, passed to the later stages
+        so all faults of one write agree on the count."""
+        count = self._writes.get(target, 0) + 1
+        self._writes[target] = count
+        for f in self._write_faults(target, path, count):
+            if f["op"] == "io_delay":
+                time.sleep(float(f.get("seconds", 0.1)))
+            elif f["op"] == "io_error":
+                raise OSError(
+                    f"injected I/O failure (DCFM_FAULT_PLAN: write "
+                    f"#{count} to {target} at {path})")
+        return count
+
+    def mutate_payload(self, target: str, path: str, count: int,
+                       payload: dict) -> dict:
+        """Apply bit_flip faults to a to-be-written payload.  Called
+        AFTER integrity checksums were computed, so the flip is exactly
+        the silent corruption CRC verification exists to catch."""
+        out = payload
+        for f in self._write_faults(target, path, count):
+            if f["op"] != "bit_flip":
+                continue
+            if out is payload:
+                out = dict(payload)
+            leaf = f.get("leaf")
+            if leaf is None:
+                leaf = max(out, key=lambda k: np.asarray(out[k]).nbytes)
+            if leaf not in out:
+                raise FaultPlanError(
+                    f"bit_flip leaf {leaf!r} not in payload "
+                    f"({sorted(out)})")
+            arr = np.array(out[leaf], copy=True)
+            flat = arr.view(np.uint8).reshape(-1)
+            flat[0] ^= 1
+            out[leaf] = arr
+        return out
+
+    def after_replace(self, target: str, path: str, count: int) -> None:
+        """Apply torn_write faults to a file that was just atomically
+        renamed into place (simulating a filesystem that lied about
+        durability)."""
+        for f in self._write_faults(target, path, count):
+            if f["op"] != "torn_write":
+                continue
+            size = os.path.getsize(path)
+            keep = int(size * float(f.get("keep_fraction", 0.5)))
+            with open(path, "r+b") as fh:
+                fh.truncate(keep)
+
+
+_ACTIVE: Optional[FaultPlan] = None
+_LOADED = False
+
+
+def fault_plan() -> Optional[FaultPlan]:
+    """The process-wide fault plan, parsed from ``DCFM_FAULT_PLAN`` on
+    first use (None when unset - the production fast path).  Tests may
+    swap it with :func:`install` / :func:`clear`."""
+    global _ACTIVE, _LOADED
+    if not _LOADED:
+        _ACTIVE = FaultPlan.from_env()
+        _LOADED = True
+    return _ACTIVE
+
+
+def install(spec: Optional[dict]) -> Optional[FaultPlan]:
+    """Install a plan in-process (tests); None clears it."""
+    global _ACTIVE, _LOADED
+    _LOADED = True
+    _ACTIVE = FaultPlan(spec) if spec is not None else None
+    return _ACTIVE
+
+
+def clear() -> None:
+    """Forget the cached plan (the next :func:`fault_plan` re-reads the
+    environment)."""
+    global _ACTIVE, _LOADED
+    _ACTIVE, _LOADED = None, False

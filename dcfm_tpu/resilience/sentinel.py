@@ -1,0 +1,95 @@
+"""Divergence sentinel: stop a blown-up chain from writing garbage draws.
+
+A NaN/Inf in the sampler state (the dominant source: a failed K x K
+Cholesky under extreme shrinkage) propagates into every later draw and,
+silently, into the covariance accumulators - the run "completes" and
+reports garbage.  The sweep already pays for the detection machinery:
+``models/sampler`` reduces a per-iteration all-finite health check into
+the carried health panel, and (new) one cheap all-finite reduction over
+the covariance accumulator per chunk (``ChainStats.acc_nonfinite``).
+This module is the HOST-side policy over those on-device reductions -
+it never adds device work, so a healthy chain is bitwise unaffected.
+
+Policy (FitConfig.sentinel): on detection at a chunk boundary,
+
+* ``rewind`` - api.fit reloads the last good (CRC-verified) checkpoint,
+  folds the rewind count into the chain key (a re-lineaged RNG: the
+  retried trajectory must not deterministically walk back into the same
+  blow-up) and escalates ``ModelConfig.ridge_jitter`` 10x per rewind.
+  Documented NON-bit-exact versus an undiverged run - resume-after-
+  crash stays bit-exact, rewind-after-divergence does not.
+* ``abort`` - raise :class:`ChainDivergedError` at the boundary.
+
+The sentinel trips on an INCREASE of the cumulative non-finite counter
+over the run's starting value (a resumed carry may carry historical
+counts), or on any non-finite accumulator entry.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class ChainDivergedError(RuntimeError):
+    """The chain produced NaN/Inf and the sentinel's policy (or rewind
+    budget) forbids continuing.  Carries the global ``iteration`` of the
+    boundary where the divergence was detected and the number of
+    ``rewinds`` already spent."""
+
+    def __init__(self, message: str, *, iteration: int = -1,
+                 rewinds: int = 0):
+        super().__init__(message)
+        self.iteration = iteration
+        self.rewinds = rewinds
+
+
+def _scalar(x) -> float:
+    return float(np.asarray(x).sum())
+
+
+class DivergenceSentinel:
+    """Per-fit sentinel state: trip detection + the rewind budget.
+
+    ``baseline_nonfinite`` is the cumulative non-finite count the carry
+    already held when this fit started (nonzero after resuming a run
+    that diverged before - only NEW divergence trips)."""
+
+    def __init__(self, mode: str, *, max_rewinds: int = 3,
+                 baseline_nonfinite: float = 0.0,
+                 base_jitter: float = 0.0):
+        assert mode in ("abort", "rewind")
+        self.mode = mode
+        self.max_rewinds = int(max_rewinds)
+        self.rewinds = 0
+        self._baseline = float(baseline_nonfinite)
+        self._base_jitter = float(base_jitter)
+
+    def tripped(self, stats) -> bool:
+        """Host-side check of one chunk's ChainStats (already fetched -
+        no extra device sync)."""
+        if _scalar(stats.nonfinite_count) > self._baseline:
+            return True
+        acc_bad = getattr(stats, "acc_nonfinite", None)
+        return acc_bad is not None and _scalar(acc_bad) > 0
+
+    def record_rewind(self, iteration: int) -> None:
+        """Spend one rewind; raises when the budget is exhausted."""
+        self.rewinds += 1
+        if self.rewinds > self.max_rewinds:
+            raise ChainDivergedError(
+                f"chain diverged at iteration {iteration} and the rewind "
+                f"budget ({self.max_rewinds}) is exhausted - every retry "
+                "re-diverged despite RNG re-lineage and ridge escalation; "
+                "the data/config are numerically pathological "
+                "(see FitConfig.sentinel_max_rewinds)",
+                iteration=iteration, rewinds=self.rewinds)
+
+    def escalated_jitter(self) -> float:
+        """Ridge jitter for the next attempt, 10x per rewind: a user-
+        configured base escalates to 10x base on the FIRST rewind (the
+        chain just diverged under the base - retrying at the same value
+        would spend budget for no numerical hardening); an unconfigured
+        (0.0) base starts at the 1e-6 floor."""
+        if self._base_jitter > 0:
+            return float(self._base_jitter * (10.0 ** self.rewinds))
+        return float(1e-6 * (10.0 ** (self.rewinds - 1)))

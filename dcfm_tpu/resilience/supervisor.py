@@ -1,0 +1,410 @@
+"""Run supervisor: crash-only fits that finish anyway.
+
+``supervise()`` (API) and ``dcfm-tpu fit --supervise`` / ``dcfm-tpu
+supervise`` (CLI) run the fit in a CHILD process and treat its death -
+SIGKILL, preemption, OOM, a native crash - as a scheduling event, not a
+failure: verify the newest checkpoint's integrity (falling back to the
+previous retained one when the CRC says the file is lying), relaunch
+with exponential backoff under a max-retry budget, and resume.  Because
+per-iteration RNG keys derive from the global iteration, the supervised
+result is BIT-IDENTICAL to an uninterrupted run, however many times the
+child died (pinned by the chaos lane, tests/test_resilience.py).
+
+Poison-iteration detection is what separates a supervisor from a
+crash-loop: when the checkpoint iteration does not advance between two
+consecutive child deaths - the same iteration killed the child twice -
+the run is deterministically poisoned (a reproducible numerical abort,
+a bad shard of data) and relaunching forever would burn the cluster.
+The supervisor aborts with a typed :class:`PoisonedRunError` carrying
+the offending checkpoint path for offline triage.
+
+Scope: single-host children (the CLI command or a config+data fit).
+On pods, each host's launcher wraps its own process with
+``supervise_command``; the collective resume agreement inside fit()
+(api._resume_state_multiproc) already handles mixed per-host states.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import re
+import subprocess
+import sys
+import tempfile
+import time
+from typing import Callable, Optional
+
+# NOTE: dcfm_tpu.utils.checkpoint is imported lazily inside functions:
+# checkpoint.py itself imports resilience.faults (the chaos seam), so a
+# module-level import here would be circular through the package init.
+
+
+class PoisonedRunError(RuntimeError):
+    """The same iteration killed the child twice: the failure is
+    deterministic, not environmental - relaunching cannot help.
+    ``checkpoint_path`` is the last good checkpoint (the state just
+    before the poisoned iteration), ``iteration`` its saved position."""
+
+    def __init__(self, message: str, *, checkpoint_path: str = "",
+                 iteration: int = -1):
+        super().__init__(message)
+        self.checkpoint_path = checkpoint_path
+        self.iteration = iteration
+
+
+class RetriesExhaustedError(RuntimeError):
+    """The child kept dying (with progress between deaths, so not
+    poison) past the retry budget."""
+
+
+@dataclasses.dataclass
+class SuperviseReport:
+    """What the supervision loop did: evidence for the postmortem."""
+    launches: int = 0              # child processes started (1 = no crash)
+    deaths: list = dataclasses.field(default_factory=list)
+    #                              # (exit_code, checkpoint_iteration) pairs
+    corrupt_fallbacks: int = 0     # CRC-demoted checkpoints
+    final_iteration: int = -1
+    elapsed_s: float = 0.0
+
+
+def _log(msg: str) -> None:
+    print(f"[supervise] {msg}", file=sys.stderr, flush=True)
+
+
+def _checkpoint_slots(path: str) -> list:
+    """The live-file slots the integrity pass must walk: the plain path
+    plus every per-process ``.procK-of-N`` file a multi-host child
+    writes (each slot carries its own ``.bakN`` retention chain through
+    utils.checkpoint._atomic_savez).  Slots whose live file is gone but
+    whose retained generations survive are included too - that is
+    exactly the state a promote must repair."""
+    slots = [path]
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    if os.path.isdir(d):
+        base = re.escape(os.path.basename(path))
+        pat = re.compile(f"^({base}\\.proc\\d+-of-\\d+)(\\.bak\\d+)?$")
+        seen = set()
+        for f in sorted(os.listdir(d)):
+            m = pat.match(f)
+            if m and m.group(1) not in seen:
+                seen.add(m.group(1))
+                slots.append(os.path.join(d, m.group(1)))
+    return slots
+
+
+def _progress_iteration(path: str) -> int:
+    """Chain progress at ``path``: the best iteration among the plain
+    file and any COMPLETE ``.procK-of-N`` set (all members readable and
+    agreeing).  Deliberately jax-free - the supervising parent must
+    never initialize an accelerator backend the child needs - so the
+    set discovery re-derives completeness from filenames alone, like
+    utils.checkpoint.find_multiprocess_checkpoint minus its
+    process-count tie-breaker.  -1 when nothing is readable."""
+    from dcfm_tpu.utils.checkpoint import read_checkpoint_meta
+    best = -1
+    try:
+        best = int(read_checkpoint_meta(path)["iteration"])
+    except Exception:  # dcfm: ignore[DCFM601] - absent/corrupt plain file is simply not progress
+        pass
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    if not os.path.isdir(d):
+        return best
+    pat = re.compile(re.escape(os.path.basename(path))
+                     + r"\.proc(\d+)-of-(\d+)$")
+    by_count: dict = {}
+    for f in os.listdir(d):
+        m = pat.match(f)
+        if m:
+            by_count.setdefault(int(m.group(2)), set()).add(int(m.group(1)))
+    for count, idxs in by_count.items():
+        if idxs != set(range(count)):
+            continue
+        try:
+            its = {int(read_checkpoint_meta(
+                f"{path}.proc{i}-of-{count}")["iteration"])
+                for i in range(count)}
+        except Exception:  # dcfm: ignore[DCFM601] - an unreadable/torn set is simply not progress
+            continue
+        if len(its) == 1:
+            best = max(best, its.pop())
+    return best
+
+
+def _ensure_slot(slot: str, report: SuperviseReport,
+                 log: Callable[[str], None]) -> int:
+    """Walk ONE slot's retention chain newest-first, demoting corrupt
+    generations and promoting the first verified one into the live
+    position.  Returns its iteration (-1 = nothing survived)."""
+    from dcfm_tpu.utils.checkpoint import (
+        retained_checkpoints, verify_checkpoint)
+    for p in retained_checkpoints(slot):
+        try:
+            meta = verify_checkpoint(p)
+        except Exception as e:  # CRC mismatch, torn npz, old format, ...
+            log(f"checkpoint {p} unusable ({e}); demoting")
+            report.corrupt_fallbacks += 1
+            try:
+                os.replace(p, p + ".corrupt")
+            except OSError:
+                pass  # dcfm: ignore[DCFM601] - a vanished file is already demoted
+            continue
+        if p != slot:
+            # promote the retained generation into the live slot; the
+            # child resumes it exactly as if it were the newest save
+            os.replace(p, slot)
+            log(f"promoted retained checkpoint {p} -> {slot} "
+                f"(iteration {meta['iteration']})")
+        return int(meta["iteration"])
+    return -1
+
+
+def _ensure_good_checkpoint(path: str, report: SuperviseReport,
+                            log: Callable[[str], None]) -> int:
+    """Integrity pre-pass before a (re)launch: for the plain path AND
+    every per-process ``.procK-of-N`` slot (multi-host children), walk
+    the retention chain newest-first, demote every CRC-corrupt file to
+    ``<file>.corrupt``, and promote the first verified generation so
+    the child's resume sees only clean bytes.  Returns the resulting
+    chain progress (:func:`_progress_iteration`), or -1 when no
+    checkpoint exists yet (first launch / nothing survived)."""
+    for slot in _checkpoint_slots(path):
+        _ensure_slot(slot, report, log)
+    return _progress_iteration(path)
+
+
+def supervise_command(
+    argv: list,
+    *,
+    checkpoint_path: str,
+    max_retries: int = 5,
+    backoff_base: float = 1.0,
+    backoff_max: float = 60.0,
+    poison_deaths: int = 2,
+    env: Optional[dict] = None,
+    log: Callable[[str], None] = _log,
+) -> SuperviseReport:
+    """Run ``argv`` as a child process until it exits 0, resuming it
+    through crashes.  The generic core both CLI modes and
+    :func:`supervise` build on.
+
+    Contract for ``argv``: it must checkpoint to ``checkpoint_path`` and
+    resume from it when relaunched unchanged (the ``dcfm-tpu fit
+    --checkpoint ... --resume`` CLI and the internal ``_child`` runner
+    both satisfy it).
+
+    Raises :class:`PoisonedRunError` when ``poison_deaths`` consecutive
+    deaths show the same checkpoint iteration with no progress (default
+    2: the same iteration killed the child twice),
+    :class:`RetriesExhaustedError` past ``max_retries``
+    relaunches-after-death.  CAVEAT: on heavily-preempted fleets whose
+    checkpoint cadence is long, two RANDOM preemptions can land inside
+    one save window and mimic poison; raise ``poison_deaths`` there (the
+    budget trades crash-loop protection against false aborts).
+    """
+    report = SuperviseReport()
+    t0 = time.perf_counter()
+    full_env = dict(os.environ)
+    if env:
+        full_env.update(env)
+    prev_death_iter: Optional[int] = None
+    same_iter_deaths = 0
+    while True:
+        it_before = _ensure_good_checkpoint(checkpoint_path, report, log)
+        report.launches += 1
+        log(f"launch #{report.launches} (checkpoint at iteration "
+            f"{it_before})")
+        proc = subprocess.run(argv, env=full_env)
+        if proc.returncode == 0:
+            # leave the live slot VERIFIED on the way out too: the final
+            # save itself can be the corrupt one (observed under chaos
+            # plans whose write counters hit the last boundary), and a
+            # future resume should find the newest CLEAN generation
+            # promoted, not trip over bad bytes
+            report.final_iteration = _ensure_good_checkpoint(
+                checkpoint_path, report, log)
+            report.elapsed_s = time.perf_counter() - t0
+            log(f"child finished after {report.launches} launch(es), "
+                f"{report.corrupt_fallbacks} corrupt fallback(s)")
+            return report
+        it_died = _progress_iteration(checkpoint_path)
+        report.deaths.append((proc.returncode, it_died))
+        log(f"child died (exit {proc.returncode}) at checkpoint "
+            f"iteration {it_died}")
+        # Poison = the same iteration killed the child ``poison_deaths``
+        # times in a row: each counted death shows NO progress over the
+        # child's own launch point AND sits at the previous death's
+        # iteration.  Both conditions matter - a corruption fallback
+        # legitimately moves a launch point BACKWARDS, so two deaths at
+        # the same iteration with progress in between (resumed from an
+        # older retained file) must keep retrying, while consecutive
+        # no-progress deaths are deterministic and must not crash-loop.
+        if it_died <= it_before and it_died == prev_death_iter:
+            same_iter_deaths += 1
+        else:
+            same_iter_deaths = 1
+        if same_iter_deaths >= poison_deaths:
+            report.elapsed_s = time.perf_counter() - t0
+            raise PoisonedRunError(
+                f"iteration {it_died} killed the child {same_iter_deaths} "
+                f"times in a row (exit {proc.returncode}) - the failure "
+                "is deterministic, not environmental; inspect the run at "
+                f"the offending checkpoint: {checkpoint_path}",
+                checkpoint_path=checkpoint_path, iteration=it_died)
+        prev_death_iter = it_died
+        retries = report.launches  # deaths so far == launches (none exited 0)
+        if retries > max_retries:
+            report.elapsed_s = time.perf_counter() - t0
+            raise RetriesExhaustedError(
+                f"child died {retries} times (retry budget {max_retries}); "
+                f"last exit {proc.returncode} at iteration {it_died}")
+        delay = min(backoff_max, backoff_base * (2.0 ** (retries - 1)))
+        log(f"backing off {delay:.2f}s before relaunch")
+        time.sleep(delay)
+
+
+def supervise(Y, cfg, *, max_retries: int = 5, backoff_base: float = 1.0,
+              backoff_max: float = 60.0, workdir: Optional[str] = None,
+              log: Callable[[str], None] = _log):
+    """Supervised ``fit(Y, cfg)``: the chain runs in child processes
+    (crash-isolated, resumable); the parent returns the completed
+    :class:`~dcfm_tpu.api.FitResult`.
+
+    Requires ``cfg.checkpoint_path`` (the resume substrate) and
+    ``checkpoint_mode="full"`` (the parent materializes the result by a
+    no-op resume of the finished checkpoint, which a light save cannot
+    serve).  ``checkpoint_keep_last >= 2`` is recommended so a corrupt
+    newest checkpoint falls back instead of restarting from zero.
+
+    The data matrix and config are handed to the child via a scratch
+    directory (``workdir``; a temp dir by default) - the child re-runs
+    preprocessing deterministically from the seed, exactly like any
+    resume."""
+    import numpy as np
+
+    if not cfg.checkpoint_path:
+        raise ValueError("supervise() requires cfg.checkpoint_path - "
+                         "without a checkpoint there is nothing to resume")
+    if cfg.checkpoint_mode != "full":
+        raise ValueError(
+            "supervise() requires checkpoint_mode='full': the parent "
+            "materializes the result from the finished checkpoint, which "
+            "a state-only (light) final save cannot provide")
+    from dcfm_tpu.utils.checkpoint import _config_to_json
+
+    own_tmp = workdir is None
+    workdir = workdir or tempfile.mkdtemp(prefix="dcfm-supervise-")
+    os.makedirs(workdir, exist_ok=True)
+    data_path = os.path.join(workdir, "Y.npy")
+    cfg_path = os.path.join(workdir, "cfg.json")
+    np.save(data_path, np.asarray(Y))
+    with open(cfg_path, "w", encoding="utf-8") as f:
+        json.dump(_config_to_json(cfg), f)
+    argv = [sys.executable, "-m", "dcfm_tpu.resilience._child",
+            cfg_path, data_path]
+    try:
+        report = supervise_command(
+            argv, checkpoint_path=cfg.checkpoint_path,
+            max_retries=max_retries, backoff_base=backoff_base,
+            backoff_max=backoff_max, log=log)
+    finally:
+        if own_tmp:
+            for p in (data_path, cfg_path):
+                try:
+                    os.unlink(p)
+                except OSError:
+                    pass  # dcfm: ignore[DCFM601] - scratch cleanup only
+            try:
+                os.rmdir(workdir)
+            except OSError:
+                pass  # dcfm: ignore[DCFM601] - scratch cleanup only
+    # The children completed the chain; materialize the FitResult in this
+    # process via a no-op resume (loads the finished checkpoint, executes
+    # zero iterations, fetches + assembles) - with the supervision
+    # telemetry attached (FitResult.supervise_report), so API callers see
+    # the launches/deaths/fallbacks, not just the CLI's stderr JSON.
+    from dcfm_tpu.api import fit
+    res = fit(np.asarray(Y), dataclasses.replace(cfg, resume=True))
+    return dataclasses.replace(res, supervise_report=report)
+
+
+def run_supervised_cli(child_argv: list, *, checkpoint: str,
+                       max_retries: int = 5, backoff_base: float = 1.0,
+                       backoff_max: float = 60.0,
+                       poison_deaths: int = 2) -> int:
+    """The ONE home of the CLI supervision protocol, shared by
+    ``dcfm-tpu fit --supervise`` and ``dcfm-tpu supervise``: run the
+    dcfm-tpu subcommand ``child_argv`` under :func:`supervise_command`,
+    print the JSON report (or the typed failure) to stderr, and return
+    the process exit code (0 success, 3 poisoned/exhausted)."""
+    try:
+        report = supervise_command(
+            [sys.executable, "-m", "dcfm_tpu.cli"] + list(child_argv),
+            checkpoint_path=checkpoint, max_retries=max_retries,
+            backoff_base=backoff_base, backoff_max=backoff_max,
+            poison_deaths=poison_deaths)
+    except (PoisonedRunError, RetriesExhaustedError) as e:
+        print(json.dumps({
+            "error": type(e).__name__, "message": str(e),
+            "checkpoint": getattr(e, "checkpoint_path", None),
+            "iteration": getattr(e, "iteration", None),
+        }), file=sys.stderr)
+        return 3
+    print(json.dumps({
+        "supervised": True, "launches": report.launches,
+        "deaths": report.deaths,
+        "corrupt_fallbacks": report.corrupt_fallbacks,
+        "final_iteration": report.final_iteration,
+    }), file=sys.stderr)
+    return 0
+
+
+def supervise_cli(argv: list) -> int:
+    """``dcfm-tpu supervise [options] -- <dcfm-tpu subcommand ...>``:
+    run any dcfm-tpu command (typically ``fit ... --checkpoint ...``)
+    under the crash supervisor.  ``--checkpoint`` is read from the child
+    command when not given explicitly."""
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="dcfm-tpu supervise",
+        description=supervise_cli.__doc__)
+    p.add_argument("--checkpoint", default=None,
+                   help="checkpoint path to monitor (default: extracted "
+                        "from the child command's --checkpoint)")
+    p.add_argument("--max-retries", type=int, default=5)
+    p.add_argument("--backoff", type=float, default=1.0,
+                   help="base of the exponential relaunch backoff (s)")
+    p.add_argument("--backoff-max", type=float, default=60.0)
+    p.add_argument("--poison-deaths", type=int, default=2,
+                   help="consecutive same-iteration no-progress deaths "
+                        "that count as a poisoned run (raise on heavily-"
+                        "preempted fleets with long save cadences)")
+    p.add_argument("command", nargs=argparse.REMAINDER,
+                   help="the dcfm-tpu command to supervise (a leading "
+                        "'--' separator is accepted)")
+    args = p.parse_args(argv)
+    cmd = list(args.command)
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+    if not cmd:
+        p.error("no child command given (e.g. `dcfm-tpu supervise -- "
+                "fit Y.npy --shards 4 ... --checkpoint ck.npz`)")
+    ck = args.checkpoint
+    if ck is None:
+        for i, tok in enumerate(cmd):
+            if tok == "--checkpoint" and i + 1 < len(cmd):
+                ck = cmd[i + 1]
+            elif tok.startswith("--checkpoint="):
+                ck = tok.split("=", 1)[1]
+    if not ck:
+        p.error("the child command has no --checkpoint (nothing to "
+                "resume from); pass one, or --checkpoint to supervise")
+    if cmd[0] == "fit" and "--resume" not in cmd:
+        cmd.append("--resume")
+    return run_supervised_cli(
+        cmd, checkpoint=ck, max_retries=args.max_retries,
+        backoff_base=args.backoff, backoff_max=args.backoff_max,
+        poison_deaths=args.poison_deaths)

@@ -15,15 +15,17 @@ entry/block/interval queries over it concurrently - see README
 """
 
 from dcfm_tpu.serve.artifact import (
-    ARTIFACT_VERSION, ArtifactError, ArtifactVersionError,
-    PosteriorArtifact, create_sparse_artifact, export_fit_result,
-    export_from_checkpoint, quantize_panels, write_artifact)
+    ARTIFACT_VERSION, ArtifactCorruptError, ArtifactError,
+    ArtifactVersionError, PosteriorArtifact, create_sparse_artifact,
+    export_fit_result, export_from_checkpoint, quantize_panels,
+    write_artifact)
 from dcfm_tpu.serve.batcher import DeadlineExceeded, Overloaded, QueryBatcher
 from dcfm_tpu.serve.engine import PanelCache, QueryEngine
 from dcfm_tpu.serve.server import PosteriorServer
 
 __all__ = [
-    "ARTIFACT_VERSION", "ArtifactError", "ArtifactVersionError",
+    "ARTIFACT_VERSION", "ArtifactCorruptError", "ArtifactError",
+    "ArtifactVersionError",
     "PosteriorArtifact", "create_sparse_artifact", "export_fit_result",
     "export_from_checkpoint", "quantize_panels", "write_artifact",
     "QueryEngine", "PanelCache", "QueryBatcher", "Overloaded",

@@ -49,10 +49,12 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
+import zlib
 from typing import Optional
 
 import numpy as np
 
+from dcfm_tpu.resilience.faults import fault_plan
 from dcfm_tpu.utils.preprocess import PreprocessResult
 
 ARTIFACT_FORMAT = "dcfm-posterior-artifact"
@@ -70,6 +72,31 @@ class ArtifactError(ValueError):
 
 class ArtifactVersionError(ArtifactError):
     """Artifact format version this library cannot serve."""
+
+
+class ArtifactCorruptError(ArtifactError):
+    """A panel failed its recorded CRC32: the memmapped bytes are not
+    the bytes the export wrote (silent media corruption, a torn copy).
+    Raised LAZILY by the query engine on the first touch of the corrupt
+    panel; the server maps it to a typed 503 (the artifact needs
+    re-export or a re-synced replica - retrying the request cannot
+    help).  ``panel`` is the canonical triu pair index."""
+
+    def __init__(self, message: str, *, panel: int = -1, kind: str = ""):
+        super().__init__(message)
+        self.panel = panel
+        self.kind = kind
+
+
+def panel_crc32(panel: np.ndarray) -> int:
+    """CRC32 of one int8 panel's raw bytes (zero-copy view).
+
+    DELIBERATE twin of ``utils.checkpoint._leaf_crc`` rather than an
+    import: this module's contract is "NumPy + stdlib, no jax" (the
+    serving path must open artifacts without an accelerator stack) and
+    checkpoint.py imports jax at module level.  Keep the two three-line
+    bodies identical if either ever changes."""
+    return zlib.crc32(np.ascontiguousarray(panel).reshape(-1).view(np.uint8))
 
 
 def _num_pairs(g: int) -> int:
@@ -119,6 +146,13 @@ class PosteriorArtifact:
     sd_panels: Optional[np.ndarray]    # (n_pairs, P, P) int8 memmap or None
     sd_scale: Optional[np.ndarray]
     pre: PreprocessResult
+    # per-panel CRC32s from meta.json ({"mean": (n_pairs,), "sd": ...}
+    # int64 arrays), or {} for artifacts written before the integrity
+    # format / synthesized sparse artifacts - those serve unverified.
+    # The query engine checks a panel's CRC lazily on its FIRST dequant
+    # (serve/engine.py), so opening stays O(1) and cold panels cost
+    # nothing until touched.
+    panel_crc: dict = dataclasses.field(default_factory=dict)
 
     @property
     def p_used(self) -> int:
@@ -176,10 +210,19 @@ class PosteriorArtifact:
             col_mean=col_mean, col_scale=col_scale,
             kept_cols=kept_cols, zero_cols=zero_cols,
             n_pad=n_pad, p_original=p_original)
+        panel_crc = {}
+        for kind, crcs in (meta.get("panel_crc") or {}).items():
+            crcs = np.asarray(crcs, np.int64)
+            if crcs.shape != (n_pairs,):
+                raise ArtifactError(
+                    f"{path}: panel_crc[{kind!r}] has {crcs.shape} entries"
+                    f" != n_pairs {n_pairs}")
+            panel_crc[kind] = crcs
         return cls(path=path, meta=meta, g=g, P=P, n_pairs=n_pairs,
                    p_original=p_original, n_pad=n_pad, has_sd=has_sd,
                    mean_panels=mean_panels, mean_scale=mean_scale,
-                   sd_panels=sd_panels, sd_scale=sd_scale, pre=pre)
+                   sd_panels=sd_panels, sd_scale=sd_scale, pre=pre,
+                   panel_crc=panel_crc)
 
     @staticmethod
     def _open_panels(path: str, name: str, n_pairs: int, P: int):
@@ -195,6 +238,25 @@ class PosteriorArtifact:
                 "artifact")
         return np.memmap(fp, dtype=np.int8, mode="r",
                          shape=(n_pairs, P, P))
+
+    def verify_panel(self, kind: str, pair: int) -> None:
+        """Check one panel's memmapped bytes against the CRC32 recorded
+        at export.  No-op for artifacts without recorded CRCs (pre-
+        integrity exports, synthesized sparse artifacts).  Raises the
+        typed :class:`ArtifactCorruptError` on mismatch - the engine
+        calls this lazily on a panel's first dequant, the server maps it
+        to 503."""
+        crcs = self.panel_crc.get(kind)
+        if crcs is None:
+            return
+        raw, _ = self.panels(kind)
+        got = panel_crc32(raw[pair])
+        if got != int(crcs[pair]):
+            raise ArtifactCorruptError(
+                f"{self.path}: {kind} panel {pair} fails its CRC32 "
+                f"(stored {int(crcs[pair]):#010x}, computed {got:#010x}) - "
+                "the artifact bytes on disk are corrupt; re-export it or "
+                "re-sync the replica", panel=pair, kind=kind)
 
     def panels(self, kind: str) -> tuple[np.ndarray, np.ndarray]:
         """(panels memmap, per-panel scales) for ``kind`` in mean|sd."""
@@ -276,6 +338,22 @@ def write_artifact(
     if (sd_q8 is None) != (sd_scale is None):
         raise ValueError("sd_q8 and sd_scale must be passed together")
     os.makedirs(path, exist_ok=True)
+    # chaos seam (resilience/faults.py, target "artifact"): failing/
+    # delayed I/O before any byte lands, bit-flips AFTER the per-panel
+    # CRCs are computed (the silent corruption lazy verification
+    # catches), torn panel files after the write
+    plan = fault_plan()
+    count = plan.on_write("artifact", path) if plan else 0
+    crc = {"mean": [int(panel_crc32(q)) for q in np.asarray(mean_q8)]}
+    if sd_q8 is not None:
+        crc["sd"] = [int(panel_crc32(q)) for q in np.asarray(sd_q8)]
+    if plan:
+        payload = {MEAN_PANELS_FILE: mean_q8}
+        if sd_q8 is not None:
+            payload[SD_PANELS_FILE] = sd_q8
+        mutated = plan.mutate_payload("artifact", path, count, payload)
+        mean_q8 = mutated[MEAN_PANELS_FILE]
+        sd_q8 = mutated.get(SD_PANELS_FILE, sd_q8)
     # re-export over an existing artifact: drop the old meta BEFORE any
     # payload write, so every partially-written state is unopenable
     meta_path = os.path.join(path, META_FILE)
@@ -284,6 +362,9 @@ def write_artifact(
     if sd_q8 is None and os.path.exists(os.path.join(path, SD_PANELS_FILE)):
         os.unlink(os.path.join(path, SD_PANELS_FILE))   # stale SD panels
     _write_panels(path, MEAN_PANELS_FILE, mean_q8)
+    if plan:
+        plan.after_replace("artifact", os.path.join(path, MEAN_PANELS_FILE),
+                           count)
     maps = dict(
         mean_scale=np.asarray(mean_scale, np.float32),
         col_scale=np.asarray(pre.col_scale, np.float32),
@@ -307,6 +388,9 @@ def write_artifact(
         "p_original": int(pre.p_original),
         "n_pad": int(pre.n_pad),
         "has_sd": sd_q8 is not None,
+        # per-panel CRC32s of the bytes as written (pre-fault-injection),
+        # verified lazily on first touch by the query engine
+        "panel_crc": crc,
         "provenance": provenance or {},
     }
     tmp = os.path.join(path, META_FILE + ".tmp")

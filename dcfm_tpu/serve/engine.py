@@ -155,11 +155,20 @@ class QueryEngine:
     # -- panels --------------------------------------------------------
     def _panel(self, kind: str, pair: int, diag: bool) -> np.ndarray:
         """Dequantized float32 panel via the LRU cache; diagonal-pair
-        panels are stored symmetrized (step 2 of the bitwise contract)."""
+        panels are stored symmetrized (step 2 of the bitwise contract).
+
+        Integrity is verified LAZILY, on the cache-miss path only: the
+        panel's memmapped bytes are CRC-checked (against the export-time
+        ``panel_crc`` in meta.json) immediately before the dequant, so a
+        corrupt panel raises the typed ArtifactCorruptError on its first
+        touch - and is re-checked after an eviction - while hot panels
+        served from cache pay nothing.  Artifacts without recorded CRCs
+        (pre-integrity exports, sparse synthetics) skip the check."""
         raw, _ = self.artifact.panels(kind)
         factor = self._factor[kind]
 
         def make():
+            self.artifact.verify_panel(kind, pair)
             p = raw[pair].astype(np.float32) * factor[pair]
             if diag:
                 p = 0.5 * (p + p.T)

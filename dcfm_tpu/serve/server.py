@@ -35,7 +35,8 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlsplit
 
-from dcfm_tpu.serve.artifact import ArtifactError, PosteriorArtifact
+from dcfm_tpu.serve.artifact import (
+    ArtifactCorruptError, ArtifactError, PosteriorArtifact)
 from dcfm_tpu.serve.batcher import DeadlineExceeded, Overloaded, QueryBatcher
 from dcfm_tpu.serve.engine import QueryEngine
 
@@ -227,6 +228,13 @@ class PosteriorServer:
                 {"Retry-After": "0.05"}
         except DeadlineExceeded as e:
             return 504, {"error": str(e)}, {}
+        except ArtifactCorruptError as e:
+            # typed 503, never a stack trace: the artifact's bytes are
+            # bad (lazy CRC verification caught a corrupt panel) - the
+            # request is fine, the REPLICA is not; a client should fail
+            # over while this instance gets re-synced/re-exported
+            return 503, {"error": str(e), "corrupt_panel": e.panel,
+                         "kind": e.kind}, {}
         except (ArtifactError, ValueError, IndexError) as e:
             return 400, {"error": str(e)}, {}
         except Exception as e:           # pragma: no cover - last resort

@@ -21,13 +21,21 @@ python -m dcfm_tpu.analysis dcfm_tpu/ || exit 1
 echo "== dcfm-lint: serve subsystem (DCFM5xx thread/server lifecycles) =="
 python -m dcfm_tpu.analysis dcfm_tpu/serve/ || exit 1
 
+# The resilience subsystem is recovery code: a swallowed failure or an
+# unverified checkpoint read HERE defeats the whole point (DCFM6xx).
+echo "== dcfm-lint: resilience subsystem (DCFM6xx robustness) =="
+python -m dcfm_tpu.analysis dcfm_tpu/resilience/ || exit 1
+
 # Serve tests always run through the crash-isolated lane IN ADDITION to
 # their in-process tier-1 run below: they exercise native assembly +
 # sockets + thread storms, so a native-level abort here must fail ONE
 # file with its signal named, not silently hide the rest of the suite.
-echo "== serve tests (crash-isolated lane) =="
+# The chaos lane ALSO runs crash-isolated: its tests SIGKILL real child
+# processes and inject torn/corrupt writes on purpose; a runaway child
+# must fail one file with its signal named, not take down the suite.
+echo "== serve + chaos tests (crash-isolated lane) =="
 for f in tests/test_serve_artifact.py tests/test_serve_engine.py \
-         tests/test_serve_server.py; do
+         tests/test_serve_server.py tests/test_resilience.py; do
     JAX_PLATFORMS=cpu python -m dcfm_tpu.analysis.isolate "$f" \
         -- -q -m 'not slow' --continue-on-collection-errors \
         -p no:cacheprovider -p no:xdist -p no:randomly || exit 1

@@ -1,0 +1,41 @@
+"""Known-bad fixture for the DCFM6xx robustness family.
+
+Every handler here makes a failure vanish, and the loader resumes on
+unverified bytes - the exact antipatterns the resilience layer exists
+to kill.
+"""
+
+import numpy as np
+
+
+def swallow_bare(x):
+    try:
+        return 1 / x
+    except:                    # noqa: E722  DCFM601: bare, silent
+        pass
+
+
+def swallow_broad():
+    try:
+        step()
+    except Exception:          # DCFM601: no re-raise, no log, unused
+        return None
+
+
+def swallow_bound_but_unused(x):
+    try:
+        return int(x)
+    except Exception as exc:   # DCFM601: bound name never referenced
+        return 0
+
+
+def step():
+    return 0
+
+
+def load_leaves_unverified(path):
+    # DCFM602: raw checkpoint payload reads with no integrity check
+    with np.load(path) as z:
+        first = z["leaf_0"]
+        i = 3
+        return first, z[f"leaf_{i}"]

@@ -356,7 +356,11 @@ def test_resume_auto_survives_bad_checkpoint(tmp_path, data):
 
 def test_resume_auto_survives_corrupt_payload(tmp_path, data):
     """Healthy meta + corrupt leaf payload: auto still falls back to fresh
-    (the load itself raises, not just the compat check)."""
+    (the load itself raises, not just the compat check).  Since the
+    per-leaf CRCs landed, a rewritten leaf surfaces as the TYPED
+    CheckpointCorruptError (caught first, before any shape check)."""
+    from dcfm_tpu.utils.checkpoint import CheckpointCorruptError
+
     ck = str(tmp_path / "half.npz")
     cfg_ck = dataclasses.replace(_cfg(), checkpoint_path=ck)
     fit(data, cfg_ck)                       # writes a good checkpoint
@@ -366,10 +370,10 @@ def test_resume_auto_survives_corrupt_payload(tmp_path, data):
     np.savez(ck, **entries)
     res = fit(data, dataclasses.replace(cfg_ck, resume="auto"))
     assert res.iters_per_sec > 0            # fresh run, no raise
-    # strict mode still surfaces the error
+    # strict mode still surfaces the error, now typed as corruption
     entries["leaf_0"] = np.zeros((3, 3), np.float32)
     np.savez(ck, **entries)
-    with pytest.raises(ValueError, match="shape"):
+    with pytest.raises(CheckpointCorruptError, match="CRC32"):
         fit(data, dataclasses.replace(cfg_ck, resume=True))
 
 
@@ -713,7 +717,10 @@ def test_light_checkpoint_file_is_small_and_tagged(tmp_path, data):
     # light file stores exactly the slim complement
     dropped = full_meta["acc_leaf_indices"]
     assert dropped and n_light == n_full - len(dropped)
-    assert (os.path.getsize(ck_light) < 0.7 * os.path.getsize(ck_full))
+    # 0.75, not 0.7: at this toy shape the per-leaf CRC metadata (a few
+    # hundred bytes, size-independent) is a visible fraction of the file;
+    # at real shapes the accumulators dominate and the ratio collapses
+    assert (os.path.getsize(ck_light) < 0.75 * os.path.getsize(ck_full))
 
 
 def test_light_finished_resume_refuses(tmp_path, data):
@@ -802,7 +809,9 @@ def test_strip_checkpoint_roundtrip(tmp_path, data):
     stripped = str(tmp_path / "stripped.npz")
     strip_checkpoint(ck, stripped)
     import os
-    assert os.path.getsize(stripped) < 0.7 * os.path.getsize(ck)
+    # 0.75: see test_light_checkpoint_file_is_small_and_tagged (CRC
+    # metadata is a visible fraction only at this toy shape)
+    assert os.path.getsize(stripped) < 0.75 * os.path.getsize(ck)
     _, meta = load_checkpoint_meta(stripped)
     assert meta["state_only"] is True and meta["acc_start"] == 32
     # resumes as a chain extension from 32
@@ -935,4 +944,105 @@ def test_final_full_due_save_goes_to_main_path(tmp_path, monkeypatch, data):
     monkeypatch.setattr(api, "save_checkpoint", real)
     res = fit(data, dataclasses.replace(cfg, resume=True))
     assert res.iters_per_sec == 0.0       # finished full file: no-op resume
+    np.testing.assert_array_equal(res.sigma_blocks, res_full.sigma_blocks)
+
+
+# ---- integrity (per-leaf CRC32) and retention (keep_last) -----------------
+
+def test_verify_checkpoint_and_crc_detection(tmp_path, data):
+    """Every save records per-leaf CRC32s; verify_checkpoint passes on a
+    healthy file and a single flipped payload byte surfaces as the typed
+    CheckpointCorruptError from BOTH verify_checkpoint and the loader."""
+    from dcfm_tpu.utils.checkpoint import (
+        CheckpointCorruptError, verify_checkpoint)
+
+    ck = str(tmp_path / "crc.npz")
+    fit(data, dataclasses.replace(_cfg(), checkpoint_path=ck))
+    meta = verify_checkpoint(ck)
+    assert meta["crc_verified"] is True
+    assert meta["leaf_crc"]                     # non-empty mapping
+
+    # corrupt ONE byte of one leaf, keeping the npz container valid
+    with np.load(ck) as z:
+        entries = {k: z[k] for k in z.files}
+    name = max((k for k in entries if k != "__meta__"),
+               key=lambda k: entries[k].nbytes)
+    arr = np.array(entries[name], copy=True)
+    flat = arr.reshape(-1).view(np.uint8)
+    flat[0] ^= 1
+    entries[name] = arr
+    np.savez(ck, **entries)
+
+    with pytest.raises(CheckpointCorruptError, match="CRC32") as ei:
+        verify_checkpoint(ck)
+    assert ei.value.path == ck
+    with pytest.raises(CheckpointCorruptError, match="CRC32"):
+        fit(data, dataclasses.replace(_cfg(), checkpoint_path=ck,
+                                      resume=True))
+    # elastic mode survives it (fresh start), like any unreadable file
+    res = fit(data, dataclasses.replace(_cfg(), checkpoint_path=ck,
+                                        resume="auto"))
+    assert res.iters_per_sec > 0
+
+
+def test_keep_last_retention_chain(tmp_path, monkeypatch, data):
+    """checkpoint_keep_last=2 rotates the previous generation to .bak1 at
+    every save, so the newest file always has a verified fallback; the
+    retained file is a REAL checkpoint (verify_checkpoint passes, and its
+    iteration trails the live one by exactly one boundary)."""
+    from dcfm_tpu.utils.checkpoint import (
+        retained_checkpoints, verify_checkpoint)
+
+    _use_sync_writer(monkeypatch)
+    ck = str(tmp_path / "keep.npz")
+    fit(data, dataclasses.replace(_cfg(), checkpoint_path=ck,
+                                  checkpoint_every_chunks=1,
+                                  checkpoint_keep_last=2))
+    chain = retained_checkpoints(ck)
+    assert chain == [ck, ck + ".bak1"]
+    live = verify_checkpoint(ck)
+    prev = verify_checkpoint(ck + ".bak1")
+    assert live["iteration"] == 32 and prev["iteration"] == 24
+
+    # keep_last=1 (the default) retains nothing
+    ck1 = str(tmp_path / "nokeep.npz")
+    fit(data, dataclasses.replace(_cfg(), checkpoint_path=ck1,
+                                  checkpoint_every_chunks=1))
+    assert retained_checkpoints(ck1) == [ck1]
+
+
+def test_corrupt_latest_resumes_from_retained_inprocess(
+        tmp_path, monkeypatch, data):
+    """The supervisor-level fallback, exercised without a subprocess:
+    corrupt the newest of two retained generations; _ensure_good_checkpoint
+    demotes it, promotes .bak1, and a resume from the promoted file
+    completes bit-identically to an uninterrupted run."""
+    from dcfm_tpu.resilience.supervisor import (
+        SuperviseReport, _ensure_good_checkpoint)
+
+    res_full = fit(data, _cfg())
+    _use_sync_writer(monkeypatch)
+    ck = str(tmp_path / "fb.npz")
+    cfg = dataclasses.replace(_cfg(), checkpoint_path=ck,
+                              checkpoint_every_chunks=1,
+                              checkpoint_keep_last=2)
+    fit(data, cfg)
+
+    with np.load(ck) as z:
+        entries = {k: z[k] for k in z.files}
+    name = max((k for k in entries if k != "__meta__"),
+               key=lambda k: entries[k].nbytes)
+    arr = np.array(entries[name], copy=True)
+    arr.reshape(-1).view(np.uint8)[0] ^= 1
+    entries[name] = arr
+    np.savez(ck, **entries)
+
+    report = SuperviseReport()
+    it = _ensure_good_checkpoint(ck, report, lambda m: None)
+    assert it == 24 and report.corrupt_fallbacks == 1
+    import os
+    assert os.path.exists(ck + ".corrupt")      # demoted, not deleted
+
+    res = fit(data, dataclasses.replace(cfg, resume=True))
+    assert res.iters_per_sec > 0                # re-ran 24..32
     np.testing.assert_array_equal(res.sigma_blocks, res_full.sigma_blocks)

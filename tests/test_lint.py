@@ -66,6 +66,28 @@ def test_bad_server_flags_both_lifecycle_shapes():
     assert any("server_close" in m for m in msgs)
 
 
+def test_bad_robust_fires_601_602():
+    assert _rules_fired("bad_robust.py") == {"DCFM601", "DCFM602"}
+
+
+def test_bad_robust_flags_every_swallow_shape():
+    findings = lint_file(os.path.join(FIXTURES, "bad_robust.py"))
+    lines = {f.line for f in findings if f.rule == "DCFM601"}
+    # bare, broad-silent, and bound-but-unused all fire
+    assert len(lines) == 3
+
+
+def test_robust_rules_skip_test_files():
+    src = ("def f():\n"
+           "    try:\n"
+           "        pass\n"
+           "    except Exception:\n"
+           "        pass\n")
+    assert any(f.rule == "DCFM601" for f in lint_source(src, "mod.py"))
+    assert not any(f.rule == "DCFM601"
+                   for f in lint_source(src, "test_mod.py"))
+
+
 def test_every_rule_family_has_a_firing_fixture():
     """The registry and the fixtures cannot drift apart: every
     registered rule fires somewhere in the known-bad fixture set."""
@@ -83,7 +105,7 @@ def test_every_rule_family_has_a_firing_fixture():
 
 @pytest.mark.parametrize("name", [
     "good_rng.py", "good_jit.py", "good_dtype.py", "good_ffi.py",
-    "good_thread.py", "good_server.py"])
+    "good_thread.py", "good_server.py", "good_robust.py"])
 def test_good_fixture_is_clean(name):
     findings = lint_file(os.path.join(FIXTURES, name))
     assert findings == [], [str(f) for f in findings]

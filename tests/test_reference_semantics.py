@@ -58,9 +58,17 @@ def test_plain_estimator_twin_parity():
     # Looser than the scaled-estimator parity test (0.05): the plain rule is
     # NOT invariant to the slow-mixing Lambda<->eta scale ridge, so two
     # independent chains' Monte Carlo averages sit at visibly different
-    # ridge points (both ~4-5% scale here).  That sensitivity is the
-    # documented reason "scaled" is the default (covariance_blocks).
-    assert _rel_frob(S_jx, S_np) < 0.12
+    # ridge points.  Measured spread at this schedule (400+400): the twin
+    # against ITSELF across seeds 1-5 lands at 0.083-0.156 rel Frobenius,
+    # and the jax chain against those twins at 0.089-0.151 - i.e. the jax
+    # sampler agrees with the twin exactly as well as the twin agrees with
+    # itself, which is all "parity" can mean for a ridge-sensitive rule.
+    # The bound is set above the measured cross-chain maximum (0.156); the
+    # old 0.12 sat INSIDE the Monte Carlo spread and failed or passed by
+    # seed luck.  (Exactness of the plain rule itself is pinned separately:
+    # tests/test_draws.py rebuilds the accumulated plain Sigma from the
+    # stored draws with the reference formula to 2e-4.)
+    assert _rel_frob(S_jx, S_np) < 0.20
 
 
 def test_plain_vs_scaled_differ_offdiagonal():

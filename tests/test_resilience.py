@@ -1,0 +1,397 @@
+"""Chaos lane: scheduled faults, supervised resume, divergence rewind.
+
+Every failure mode the resilience subsystem claims to survive is
+exercised ON PURPOSE here, via the deterministic fault harness
+(``DCFM_FAULT_PLAN``, resilience/faults.py):
+
+* kill-at-iteration under ``dcfm-tpu fit --supervise`` resumes to a
+  Sigma BIT-IDENTICAL to the uninterrupted run (the acceptance demo);
+* a pre-save kill pins the checkpoint below the trigger, so every
+  relaunch dies at the same iteration - the supervisor must abort with
+  the typed PoisonedRunError instead of crash-looping;
+* torn writes and bit-flips produce the typed CheckpointCorruptError
+  and the retained-generation fallback;
+* an injected divergence (poison_state) trips the sentinel, which
+  rewinds to the last checkpoint and finishes with a finite posterior.
+
+The subprocess tests run the REAL CLI (real SIGKILL, real resume), so
+this file also rides the crash-isolated lane in scripts/ci_check.sh.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+from typing import NamedTuple
+
+import numpy as np
+import pytest
+
+from tests.conftest import make_synthetic
+
+from dcfm_tpu import FitConfig, ModelConfig, RunConfig, fit
+from dcfm_tpu.resilience import faults
+from dcfm_tpu.resilience.faults import FaultPlan, FaultPlanError
+from dcfm_tpu.resilience.sentinel import ChainDivergedError
+from dcfm_tpu.utils.checkpoint import (
+    CheckpointCorruptError, load_checkpoint, read_checkpoint_meta,
+    save_checkpoint, verify_checkpoint)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clear_fault_plan():
+    """No fault plan leaks across tests (the harness is process-global)."""
+    yield
+    faults.install(None)
+
+
+@pytest.fixture(scope="module")
+def data():
+    Y, _ = make_synthetic(n=40, p=24, k_true=3, seed=7)
+    return Y
+
+
+def _cfg(**kw):
+    return FitConfig(
+        model=ModelConfig(num_shards=2, factors_per_shard=3, rho=0.8),
+        run=RunConfig(burnin=16, mcmc=16, thin=2, seed=3, chunk_size=8),
+        **kw)
+
+
+class _CarryLike(NamedTuple):
+    a: np.ndarray
+    b: np.ndarray
+    iteration: np.ndarray
+
+
+def _carry():
+    return _CarryLike(a=np.arange(64.0), b=np.ones((32, 32)),
+                      iteration=np.int32(4))
+
+
+def _child_env(plan=None):
+    """Environment for CLI children: CPU platform + the shared XLA
+    compile cache (the suite's wall-clock is compile-dominated)."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["JAX_COMPILATION_CACHE_DIR"] = os.path.join(REPO, ".jax_cache")
+    env.pop("DCFM_FAULT_PLAN", None)
+    if plan is not None:
+        env["DCFM_FAULT_PLAN"] = json.dumps(plan)
+    return env
+
+
+def _cli_fit(data_path, out, extra, env):
+    return subprocess.run(
+        [sys.executable, "-m", "dcfm_tpu.cli", "fit", data_path,
+         "--shards", "2", "--factors", "6", "--burnin", "16",
+         "--mcmc", "16", "--thin", "2", "--chunk-size", "8",
+         "--out", out] + extra,
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=600)
+
+
+# ---------------------------------------------------------------------------
+# fault-plan harness units
+# ---------------------------------------------------------------------------
+
+def test_fault_plan_validation():
+    with pytest.raises(FaultPlanError, match="'faults' list"):
+        FaultPlan({"nope": []})
+    with pytest.raises(FaultPlanError, match="unknown op"):
+        FaultPlan({"faults": [{"op": "explode"}]})
+    with pytest.raises(FaultPlanError, match="at_iteration"):
+        FaultPlan({"faults": [{"op": "kill"}]})
+    with pytest.raises(FaultPlanError, match="at_write"):
+        FaultPlan({"faults": [{"op": "bit_flip"}]})
+    assert FaultPlan({"faults": []}).faults == []
+
+
+def test_fault_plan_from_env_and_file(tmp_path, monkeypatch):
+    faults.clear()
+    monkeypatch.setenv(faults.ENV_VAR, '{"faults": []}')
+    assert faults.fault_plan() is not None
+    faults.clear()
+    p = tmp_path / "plan.json"
+    p.write_text('{"faults": [{"op": "kill", "at_iteration": 4}]}')
+    monkeypatch.setenv(faults.ENV_VAR, f"@{p}")
+    assert len(faults.fault_plan().faults) == 1
+    faults.clear()
+    monkeypatch.delenv(faults.ENV_VAR)
+    assert faults.fault_plan() is None
+
+
+def test_kill_fires_only_for_runs_that_started_below_trigger():
+    plan = FaultPlan({"faults": [{"op": "kill", "at_iteration": 16}]})
+    # a resumed run already past the trigger must not re-die: no fault
+    # matches, so maybe_kill is a no-op (the process survives this call)
+    plan.maybe_kill(24, 16, "post_save")
+    # the boundary below the trigger doesn't fire either
+    assert plan._boundary_due("kill", "post_save", 8, 0) is None
+    # crossing fires exactly once
+    assert plan._boundary_due("kill", "post_save", 16, 0) is not None
+    assert plan._boundary_due("kill", "post_save", 24, 0) is None
+
+
+def test_io_error_and_delay_faults(tmp_path, data):
+    """io_error surfaces as OSError from the save; io_delay stalls it."""
+    ck = str(tmp_path / "io.npz")
+    carry = _carry()
+    faults.install({"faults": [
+        {"op": "io_error", "target": "checkpoint", "at_write": 1}]})
+    with pytest.raises(OSError, match="injected"):
+        save_checkpoint(ck, carry, _cfg(), fingerprint="f")
+    faults.install({"faults": [
+        {"op": "io_delay", "target": "checkpoint", "seconds": 0.2,
+         "at_write": 1}]})
+    t0 = time.perf_counter()
+    save_checkpoint(ck, carry, _cfg(), fingerprint="f")
+    assert time.perf_counter() - t0 >= 0.2
+    # write #2 has no fault: fast and intact
+    save_checkpoint(ck, carry, _cfg(), fingerprint="f")
+    assert verify_checkpoint(ck)["crc_verified"]
+
+
+def test_torn_write_fault_detected(tmp_path):
+    """A torn write (file truncated after the atomic rename) leaves a
+    file the loaders refuse - never a silent partial resume."""
+    ck = str(tmp_path / "torn.npz")
+    carry = _carry()
+    faults.install({"faults": [
+        {"op": "torn_write", "target": "checkpoint", "at_write": 1,
+         "keep_fraction": 0.5}]})
+    save_checkpoint(ck, carry, _cfg(), fingerprint="f")
+    faults.install(None)
+    with pytest.raises(Exception):       # truncated zip container
+        read_checkpoint_meta(ck)
+    with pytest.raises(Exception):
+        verify_checkpoint(ck)
+
+
+def test_bit_flip_fault_caught_by_crc(tmp_path):
+    """bit_flip corrupts AFTER the CRCs are computed - exactly the silent
+    corruption the integrity format exists to catch, surfaced as the
+    typed CheckpointCorruptError by both verify and load."""
+    ck = str(tmp_path / "flip.npz")
+    carry = _carry()
+    faults.install({"faults": [
+        {"op": "bit_flip", "target": "checkpoint", "at_write": 1,
+         "leaf": "leaf_0"}]})
+    save_checkpoint(ck, carry, _cfg(), fingerprint="f")
+    faults.install(None)
+    with pytest.raises(CheckpointCorruptError, match="CRC32"):
+        verify_checkpoint(ck)
+    with pytest.raises(CheckpointCorruptError, match="CRC32"):
+        load_checkpoint(ck, carry)
+
+
+# ---------------------------------------------------------------------------
+# divergence sentinel
+# ---------------------------------------------------------------------------
+
+def test_sentinel_rewind_recovers_finite_posterior(tmp_path, data):
+    """An injected mid-run divergence (poison_state) trips the sentinel:
+    the chain rewinds to the last checkpoint with a re-lineaged key and
+    escalated jitter, and the fit completes with a finite posterior and
+    a zero non-finite health count (the garbage chunks were discarded,
+    not accumulated).  Documented NON-bit-exact vs an undiverged run."""
+    ck = str(tmp_path / "sent.npz")
+    cfg = _cfg(checkpoint_path=ck, checkpoint_every_chunks=1)
+    faults.install({"faults": [{"op": "poison_state", "at_iteration": 16}]})
+    res = fit(data, cfg)
+    assert res.sentinel_rewinds == 1
+    assert np.isfinite(res.Sigma).all()
+    assert float(np.asarray(res.stats.nonfinite_count)) == 0.0
+    assert float(np.asarray(res.stats.acc_nonfinite)) == 0.0
+
+
+def test_sentinel_abort_without_checkpoint(data):
+    """No checkpoint -> nothing to rewind to: the sentinel aborts with
+    the typed error at the boundary where divergence was detected,
+    instead of completing with garbage."""
+    faults.install({"faults": [{"op": "poison_state", "at_iteration": 16}]})
+    with pytest.raises(ChainDivergedError) as ei:
+        fit(data, _cfg())
+    assert ei.value.iteration == 24          # poisoned 16, detected at 24
+    assert ei.value.rewinds == 0
+
+
+def test_sentinel_off_preserves_old_behavior(data):
+    """sentinel='off': the divergence runs to completion and poisons the
+    result (the pre-sentinel behavior, kept reachable on purpose - it is
+    what the sentinel's default protects against)."""
+    faults.install({"faults": [{"op": "poison_state", "at_iteration": 16}]})
+    res = fit(data, _cfg(sentinel="off"))
+    assert float(np.asarray(res.stats.nonfinite_count)) > 0
+
+
+def test_sentinel_rewind_budget_exhaustion(tmp_path, data):
+    """Every retry re-diverging must exhaust the budget and raise - not
+    loop forever.  poison_state faults at every post-rewind boundary."""
+    ck = str(tmp_path / "budget.npz")
+    cfg = _cfg(checkpoint_path=ck, checkpoint_every_chunks=1,
+               sentinel_max_rewinds=1)
+    faults.install({"faults": [
+        {"op": "poison_state", "at_iteration": 16},
+        {"op": "poison_state", "at_iteration": 16}]})
+    with pytest.raises(ChainDivergedError, match="budget"):
+        fit(data, cfg)
+
+
+def test_healthy_chain_bitwise_unaffected_by_sentinel(tmp_path, data):
+    """The sentinel only READS the per-chunk stats: a healthy chain's
+    result is bit-identical with the sentinel on (default) and off."""
+    res_on = fit(data, _cfg())
+    res_off = fit(data, _cfg(sentinel="off"))
+    np.testing.assert_array_equal(res_on.sigma_blocks, res_off.sigma_blocks)
+    assert res_on.sentinel_rewinds == 0
+
+
+# ---------------------------------------------------------------------------
+# supervised runs (real CLI children, real SIGKILL)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def data_file(tmp_path_factory, data):
+    d = tmp_path_factory.mktemp("chaos")
+    p = str(d / "Y.npy")
+    np.save(p, data)
+    return p
+
+
+def test_supervised_kill_resume_bit_exact(tmp_path, data_file):
+    """THE acceptance demo: DCFM_FAULT_PLAN SIGKILLs the child at a
+    mid-run iteration under `dcfm-tpu fit --supervise`; the supervisor
+    resumes it and the final Sigma is BIT-IDENTICAL to the uninterrupted
+    run's."""
+    ref = str(tmp_path / "ref.npy")
+    proc = _cli_fit(data_file, ref, [], _child_env())
+    assert proc.returncode == 0, proc.stderr
+
+    out = str(tmp_path / "sup.npy")
+    ck = str(tmp_path / "ck.npz")
+    plan = {"faults": [{"op": "kill", "at_iteration": 16,
+                        "when": "post_save"}]}
+    proc = _cli_fit(
+        data_file, out,
+        ["--checkpoint", ck, "--checkpoint-every", "1", "--keep-last", "2",
+         "--supervise", "--supervise-backoff", "0.05"],
+        _child_env(plan))
+    assert proc.returncode == 0, proc.stderr
+    report = json.loads(proc.stderr.strip().splitlines()[-1])
+    assert report["launches"] == 2           # died once, resumed once
+    assert report["deaths"][0][0] == -9      # a real SIGKILL
+    assert report["final_iteration"] == 32
+    np.testing.assert_array_equal(np.load(ref), np.load(out))
+
+
+def test_supervised_poison_iteration_aborts(tmp_path, data_file):
+    """A pre-save kill pins the checkpoint below the trigger: every
+    relaunch dies at the same iteration.  The supervisor must abort with
+    the typed PoisonedRunError after the second same-iteration death -
+    exactly 2 launches, never a crash-loop."""
+    out = str(tmp_path / "p.npy")
+    ck = str(tmp_path / "ck.npz")
+    plan = {"faults": [{"op": "kill", "at_iteration": 16,
+                        "when": "pre_save"}]}
+    proc = _cli_fit(
+        data_file, out,
+        ["--checkpoint", ck, "--checkpoint-every", "1",
+         "--supervise", "--supervise-backoff", "0.05"],
+        _child_env(plan))
+    assert proc.returncode == 3, proc.stderr
+    err = json.loads(proc.stderr.strip().splitlines()[-1])
+    assert err["error"] == "PoisonedRunError"
+    assert err["iteration"] == 8             # the save before the kill point
+    assert err["checkpoint"] == ck
+    assert proc.stderr.count("launch #") == 2
+
+
+def test_supervised_corrupt_checkpoint_falls_back(tmp_path, data_file):
+    """Acceptance criterion: a corrupted latest checkpoint is detected by
+    CRC and the supervisor resumes from the previous retained one.  The
+    plan bit-flips the save at iteration 16 and kills the child there;
+    the supervisor demotes the corrupt file, promotes .bak1 (iteration
+    8), and the run still completes bit-identically."""
+    ref = str(tmp_path / "ref.npy")
+    proc = _cli_fit(data_file, ref, [], _child_env())
+    assert proc.returncode == 0, proc.stderr
+
+    out = str(tmp_path / "c.npy")
+    ck = str(tmp_path / "ck.npz")
+    plan = {"faults": [
+        {"op": "kill", "at_iteration": 16, "when": "post_save"},
+        {"op": "bit_flip", "target": "checkpoint", "at_write": 2,
+         "path_re": "ck.npz$"}]}
+    proc = _cli_fit(
+        data_file, out,
+        ["--checkpoint", ck, "--checkpoint-every", "1", "--keep-last", "2",
+         "--supervise", "--supervise-backoff", "0.05"],
+        _child_env(plan))
+    assert proc.returncode == 0, proc.stderr
+    report = json.loads(proc.stderr.strip().splitlines()[-1])
+    # TWO fallbacks: the mid-run one (kill at the flipped write) and the
+    # exit pass - this plan's per-process write counter flips child 3's
+    # write #2, i.e. the FINAL save, and the supervisor must leave the
+    # live slot verified (newest clean generation promoted) on the way
+    # out so a future resume doesn't trip over bad bytes
+    assert report["corrupt_fallbacks"] == 2
+    assert report["final_iteration"] == 24      # newest CLEAN generation
+    assert "promoted retained checkpoint" in proc.stderr
+    np.testing.assert_array_equal(np.load(ref), np.load(out))
+
+
+@pytest.mark.slow
+def test_supervise_api_returns_full_fitresult(tmp_path, data):
+    """The API entry point: supervise(Y, cfg) runs the chain in children
+    through an injected SIGKILL and returns a real FitResult whose Sigma
+    is bit-identical to an in-process uninterrupted fit."""
+    from dcfm_tpu.resilience import supervise
+
+    res_ref = fit(data, _cfg())
+    ck = str(tmp_path / "api.npz")
+    cfg = _cfg(checkpoint_path=ck, checkpoint_every_chunks=1)
+    env_plan = json.dumps(
+        {"faults": [{"op": "kill", "at_iteration": 16,
+                     "when": "post_save"}]})
+    old = os.environ.get(faults.ENV_VAR)
+    os.environ[faults.ENV_VAR] = env_plan
+    try:
+        # the PARENT must not execute the plan (it would SIGKILL the test
+        # process at its no-op resume): neutralize it in-process while
+        # the children inherit it from the environment
+        faults.install({"faults": []})
+        res = supervise(data, cfg, backoff_base=0.05)
+    finally:
+        if old is None:
+            os.environ.pop(faults.ENV_VAR, None)
+        else:
+            os.environ[faults.ENV_VAR] = old
+    np.testing.assert_array_equal(res.sigma_blocks, res_ref.sigma_blocks)
+    np.testing.assert_array_equal(res.Sigma, res_ref.Sigma)
+
+
+def test_supervise_requires_checkpoint(data):
+    from dcfm_tpu.resilience import supervise
+
+    with pytest.raises(ValueError, match="checkpoint_path"):
+        supervise(data, _cfg())
+    with pytest.raises(ValueError, match="full"):
+        supervise(data, _cfg(checkpoint_path="x", checkpoint_mode="light"))
+
+
+def test_supervise_report_attached_to_fitresult(tmp_path, data):
+    """API callers see the supervision telemetry, not just the CLI's
+    stderr JSON: a crash-free supervise() attaches a report with one
+    launch and no deaths."""
+    from dcfm_tpu.resilience import supervise
+
+    ck = str(tmp_path / "rep.npz")
+    res = supervise(data, _cfg(checkpoint_path=ck), backoff_base=0.05)
+    rep = res.supervise_report
+    assert rep is not None and rep.launches == 1 and rep.deaths == []
+    assert rep.final_iteration == 32
+    # a plain fit has none
+    assert fit(data, _cfg()).supervise_report is None

@@ -233,3 +233,109 @@ def test_batcher_expires_stale_requests(served):
     finally:
         slow.gate.set()
         b.close()
+
+
+# ---- lazy per-panel CRC verification (serve hardening) --------------------
+
+def _corrupt_copy(art, dst):
+    """Copy an artifact directory and flip one byte of panel 0 in the
+    copy's mean panels."""
+    import os
+    import shutil
+
+    shutil.copytree(art.path, dst)
+    mm = np.memmap(os.path.join(dst, "mean_q8.bin"), dtype=np.int8,
+                   mode="r+", shape=(art.n_pairs, art.P, art.P))
+    mm[0, 0, 0] ^= 1
+    mm.flush()
+    del mm
+    from dcfm_tpu.serve.artifact import PosteriorArtifact
+    return PosteriorArtifact.open(dst)
+
+
+def _caller_index_in_shard(eng, shard, P):
+    """A caller-coordinate column whose shard position lies in ``shard``."""
+    for i in range(eng.artifact.p_original):
+        si = int(eng.shard_index([i])[0])
+        if si >= 0 and si // P == shard:
+            return i
+    raise AssertionError("no column maps to the shard")
+
+
+def test_corrupt_panel_raises_typed_on_first_touch(served, tmp_path):
+    """A flipped byte in a panel surfaces as the TYPED ArtifactCorruptError
+    lazily - on the corrupt panel's first dequant - while queries that
+    touch only healthy panels keep serving bitwise-correct answers."""
+    from dcfm_tpu.serve.artifact import ArtifactCorruptError
+
+    art, refs = served
+    bad = _corrupt_copy(art, str(tmp_path / "corrupt"))
+    eng = QueryEngine(bad)
+    P = bad.P
+    i0 = _caller_index_in_shard(eng, 0, P)     # panel (0, 0) - corrupted
+    i1 = _caller_index_in_shard(eng, 1, P)     # panel (1, 1) - healthy
+    # healthy panel first: served, and bitwise equal to the offline truth
+    assert (eng.entry(i1, i1)
+            == np.float32(refs[(True, "mean")][i1, i1]))
+    with pytest.raises(ArtifactCorruptError) as ei:
+        eng.entry(i0, i0)
+    assert ei.value.panel == 0 and ei.value.kind == "mean"
+    # the corrupt panel never entered the cache: retrying still raises
+    with pytest.raises(ArtifactCorruptError):
+        eng.entry(i0, i0)
+    # and the healthy panel is still served (now from cache)
+    assert (eng.entry(i1, i1)
+            == np.float32(refs[(True, "mean")][i1, i1]))
+
+
+def test_server_maps_corrupt_panel_to_typed_503(served, tmp_path):
+    """The HTTP layer returns a typed 503 for a corrupt panel - a JSON
+    error naming the panel, never a stack trace - while /healthz and
+    healthy-panel queries keep working."""
+    from dcfm_tpu.serve.server import PosteriorServer
+
+    art, _ = served
+    bad = _corrupt_copy(art, str(tmp_path / "corrupt503"))
+    srv = PosteriorServer(bad, port=0)
+    srv.start()   # close() joins serve_forever; never close an unstarted one
+    try:
+        eng = srv.engine
+        P = bad.P
+        i0 = _caller_index_in_shard(eng, 0, P)
+        i1 = _caller_index_in_shard(eng, 1, P)
+        status, payload, _ = srv.handle(
+            "/v1/entry", {"i": [str(i0)], "j": [str(i0)]})
+        assert status == 503
+        assert payload["corrupt_panel"] == 0 and payload["kind"] == "mean"
+        assert "CRC32" in payload["error"]
+        assert "Traceback" not in payload["error"]
+        # healthy panels and liveness are unaffected
+        status, payload, _ = srv.handle(
+            "/v1/entry", {"i": [str(i1)], "j": [str(i1)]})
+        assert status == 200
+        status, payload, _ = srv.handle("/healthz", {})
+        assert status == 200
+    finally:
+        srv.close()
+
+
+def test_artifact_without_crcs_serves_unverified(served, tmp_path):
+    """Back-compat: an artifact whose meta carries no panel_crc (pre-
+    integrity export) opens and serves - verification is skipped, not
+    demanded."""
+    import json
+    import os
+    import shutil
+
+    art, refs = served
+    dst = str(tmp_path / "nocrc")
+    shutil.copytree(art.path, dst)
+    mp = os.path.join(dst, "meta.json")
+    with open(mp) as f:
+        meta = json.load(f)
+    meta.pop("panel_crc", None)
+    with open(mp, "w") as f:
+        json.dump(meta, f)
+    from dcfm_tpu.serve.artifact import PosteriorArtifact
+    eng = QueryEngine(PosteriorArtifact.open(dst))
+    assert eng.entry(5, 7) == np.float32(refs[(True, "mean")][5, 7])
