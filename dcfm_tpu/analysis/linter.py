@@ -968,6 +968,77 @@ def _check_robustness(mod: _Module, rep: _Reporter) -> None:
 
 
 # =====================================================================
+# DCFM7xx - multi-host discipline
+# =====================================================================
+
+# Calls that mark a function as multi-host-aware: it branches on (or
+# gathers across) the process topology, so arrays flowing through it
+# can be non-fully-addressable global arrays.
+_MULTIHOST_MARKER_FULL = {"jax.process_index", "jax.process_count"}
+_MULTIHOST_MARKER_TAILS = {"process_allgather", "broadcast_one_to_all",
+                           "sync_global_devices"}
+# Referencing any of these in the same function counts as addressing
+# the shard-locality question - the guard the rule demands.
+_ADDRESSABILITY_ATTRS = {"is_fully_addressable", "is_fully_replicated",
+                         "addressable_shards", "addressable_data"}
+
+
+def _check_multihost(mod: _Module, rep: _Reporter) -> None:
+    """DCFM701: function-granular like the FFI contiguity rule, and
+    nested-def-exclusive (a nested helper is its own function with its
+    own markers): in a multi-host-aware function with no addressability
+    reference, flag ``jax.device_get`` on an array variable
+    (Name/Attribute argument - a jit output fetched inline is the
+    caller's explicit choice) and ``np.asarray`` on a bare Name (list
+    literals building collective payloads are fine)."""
+    for fdef in ast.walk(mod.tree):
+        if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        skip: set = set()
+        for nd in ast.walk(fdef):
+            if nd is not fdef and isinstance(
+                    nd, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for sub in ast.walk(nd):
+                    skip.add(id(sub))
+        own = [n for n in ast.walk(fdef) if id(n) not in skip]
+        marked = False
+        guarded = False
+        for n in own:
+            if isinstance(n, ast.Call):
+                full = mod.resolve(n.func)
+                if (full in _MULTIHOST_MARKER_FULL
+                        or _last(full) in _MULTIHOST_MARKER_TAILS):
+                    marked = True
+            if isinstance(n, ast.Attribute) \
+                    and n.attr in _ADDRESSABILITY_ATTRS:
+                guarded = True
+        if not marked or guarded:
+            continue
+        for n in own:
+            if not isinstance(n, ast.Call) or not n.args:
+                continue
+            full = mod.resolve(n.func)
+            arg = n.args[0]
+            if full == "jax.device_get" and isinstance(
+                    arg, (ast.Name, ast.Attribute)):
+                rep.emit("DCFM701", n,
+                         "jax.device_get on an array variable in a "
+                         "multi-host-aware function with no "
+                         "addressability guard - non-fully-addressable "
+                         "global arrays cannot be device_get; fetch "
+                         "addressable shards, or guard on "
+                         "is_fully_addressable")
+            elif (full in {"numpy.asarray", "numpy.array"}
+                  and isinstance(arg, ast.Name)):
+                rep.emit("DCFM701", n,
+                         f"{_last(full)} on '{arg.id}' in a multi-host-"
+                         "aware function with no addressability guard - "
+                         "materializing a non-fully-addressable global "
+                         "array on host raises; fetch addressable "
+                         "shards, or guard on is_fully_addressable")
+
+
+# =====================================================================
 # driver
 # =====================================================================
 
@@ -986,6 +1057,7 @@ def lint_source(source: str, path: str = "<string>") -> list:
     _check_threads(mod, rep)
     _check_servers(mod, rep)
     _check_robustness(mod, rep)
+    _check_multihost(mod, rep)
     rep.findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return rep.findings
 

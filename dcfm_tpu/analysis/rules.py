@@ -106,4 +106,18 @@ RULES = {r.id: r for r in [
          "verify_checkpoint) in the same function - bytes from disk "
          "must be CRC-checked before a chain resumes on them",
          library_only=True),
+    # ---- DCFM7xx: multi-host discipline ------------------------------
+    Rule("DCFM701", "multihost-unguarded-host-fetch", "multihost",
+         "jax.device_get (on an array variable) or np.asarray (on a "
+         "name) inside a multi-host-aware function (one that calls "
+         "jax.process_index/process_count or "
+         "multihost_utils.process_allgather) with no addressability "
+         "reference (is_fully_addressable / is_fully_replicated / "
+         "addressable_shards) in the same function - device_get of a "
+         "non-fully-addressable global array RAISES, and it does so in "
+         "exactly the pod regime the code targets (the "
+         "device-snapshot-OOM-fallback bug class, ADVICE r5).  Fetch "
+         "per-leaf addressable shards, or guard on "
+         "leaf.is_fully_addressable",
+         library_only=True),
 ]}

@@ -42,7 +42,7 @@ from dcfm_tpu.utils.diagnostics import ess, split_rhat
 from dcfm_tpu.parallel.mesh import make_mesh, shards_per_device
 from dcfm_tpu.parallel.multihost import place_sharded_global
 from dcfm_tpu.parallel.shard import build_mesh_chain, place_sharded
-from dcfm_tpu.resilience.faults import fault_plan
+from dcfm_tpu.resilience.faults import fault_event, fault_plan
 from dcfm_tpu.resilience.sentinel import (
     ChainDivergedError, DivergenceSentinel)
 from dcfm_tpu.utils.checkpoint import (
@@ -517,6 +517,25 @@ def _diagnose(trace_arr: np.ndarray, done: int, run: RunConfig) -> dict:
     return out
 
 
+def _sidecar_esig(elig) -> np.ndarray:
+    """Collective unanimity signature of a sidecar eligibility result
+    (``_sidecar_eligibility``'s ``(source, iteration, acc_start)``, or
+    None): ``[iteration, kind, writer_count, acc_start]`` as int64, all
+    -1 when ineligible.  ``acc_start`` is the load-bearing 4th element
+    (ADVICE r5): with per-host local disks two processes can hold
+    sidecars agreeing on iteration/kind/count whose accumulation
+    windows started at DIFFERENT iterations (mixed stale files after
+    repeated light resumes); committing those would divide each host's
+    raw-sum accumulators by a different n_saved and return inconsistent
+    Sigma with no error.  The gate must refuse the pair instead."""
+    if elig is None:
+        return np.asarray([-1, -1, -1, -1], np.int64)
+    source, it, acc0 = elig
+    return np.asarray(
+        [it, 0 if source[0] == "plain" else 1,
+         -1 if source[0] == "plain" else source[1][0], acc0], np.int64)
+
+
 def _resolve_devices(backend: BackendConfig):
     if backend.backend == "auto":
         return jax.devices()
@@ -552,7 +571,7 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
     compatible run bitwise-identically, ``resume="auto"`` is the elastic
     mode (resume if compatible, fresh start otherwise).
     """
-    Y = np.asarray(Y)
+    Y = np.asarray(Y)  # dcfm: ignore[DCFM701] - Y is the caller's host matrix, never a global array
     if Y.ndim != 2:
         raise ValueError(f"Y must be an (n, p) matrix, got shape {Y.shape}")
     n, p = Y.shape
@@ -889,7 +908,14 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
                    else int(bool(loaded[1].get("state_only"))))
         my_sig = np.asarray([my_iter, kind_code, src_count, so_code],
                             np.int64)
+        # fault_event: crash-point seams for the randomized fuzz harness
+        # (resilience/faults.py kill_event; no-ops without a plan).  A
+        # kill between two collectives on ONE host is exactly the state
+        # that leaves peers blocked inside the next allgather - the pod
+        # supervisor's coordinated stop must reap them.
+        fault_event("resume_gate")
         all_sigs = multihost_utils.process_allgather(my_sig)
+        fault_event("resume_gate_post")
         agree = my_iter >= 0 and bool(np.all(all_sigs == my_sig[None, :]))
         if agree:
             meta = loaded[1]
@@ -919,16 +945,12 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
                 # repeated light resumes) - committing those would
                 # silently divide by inconsistent n_saved divisors.
                 elig = _sidecar_eligibility(max(window, 0))
-                if elig is None:
-                    e_sig = np.asarray([-1, -1, -1, -1], np.int64)
-                else:
-                    e_sig = np.asarray(
-                        [elig[1], 0 if elig[0][0] == "plain" else 1,
-                         (-1 if elig[0][0] == "plain"
-                          else elig[0][1][0]), elig[2]], np.int64)
+                e_sig = _sidecar_esig(elig)
+                fault_event("sidecar_gate")
                 all_e = multihost_utils.process_allgather(e_sig)
                 if (e_sig[0] >= 0
                         and bool(np.all(all_e == e_sig[None, :]))):
+                    fault_event("sidecar_load")
                     s_carry = smeta2 = None
                     try:
                         s_carry, smeta2 = load_checkpoint_multiprocess(
@@ -937,8 +959,10 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
                         s_ok = 1
                     except Exception:  # dcfm: ignore[DCFM601] - failure becomes s_ok=0, surfaced via the collective gate
                         s_ok = 0
+                    fault_event("sidecar_commit")
                     all_ok = multihost_utils.process_allgather(
                         np.asarray([s_ok], np.int64))
+                    fault_event("sidecar_commit_post")
                     if bool(np.all(all_ok == 1)):
                         jax.tree.map(
                             lambda a: (a.delete()
@@ -1377,7 +1401,7 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
         src_h, src_state = ((carry.health, carry.state) if not multiproc
                             else jax.device_get(_replicate_jit(mesh)(
                                 (carry.health, carry.state))))
-        h = np.asarray(src_h)
+        h = np.asarray(src_h)  # dcfm: ignore[DCFM701] - replicated (or fetched) above, host-safe
         ranks = np.asarray(effective_ranks(src_state))
         stats = ChainStats(tau_log_max=h[..., 0].max(),
                            ps_min=h[..., 1].min(), ps_max=h[..., 2].max(),
@@ -1393,7 +1417,7 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
     else:
         # reduce the per-chain stats leaves ((C,) arrays when num_chains > 1)
         # to the scalar cross-chain summary.
-        stats = jax.device_get(stats)
+        stats = jax.device_get(stats)  # dcfm: ignore[DCFM701] - stats leaves are replicated psum reductions
         stats = ChainStats(
             tau_log_max=np.max(stats.tau_log_max),
             ps_min=np.min(stats.ps_min), ps_max=np.max(stats.ps_max),
@@ -1520,7 +1544,7 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
                                   destandardize=True)
         # observed entries are the caller's exact values; only the NaN
         # positions take the posterior-mean imputation
-        Y_imputed = np.array(Y, np.float32, copy=True)
+        Y_imputed = np.array(Y, np.float32, copy=True)  # dcfm: ignore[DCFM701] - Y is the caller's host matrix
         miss = np.isnan(Y_imputed)
         Y_imputed[miss] = rec[miss]
 

@@ -50,7 +50,9 @@ def build_parser() -> argparse.ArgumentParser:
         "supervise", add_help=False,
         help="run any dcfm-tpu command under the crash supervisor "
              "(auto-resume with backoff, checkpoint integrity fallback, "
-             "poison-iteration abort); see `dcfm-tpu supervise --help`")
+             "poison-iteration abort; --pod N coordinates an N-process "
+             "SPMD fit with stop-and-relaunch-all on any host death); "
+             "see `dcfm-tpu supervise --help`")
 
     # Posterior-serving subsystem (dcfm_tpu/serve; README "Serving the
     # posterior"): export a completed fit to a memory-mapped artifact,
@@ -240,6 +242,18 @@ def build_parser() -> argparse.ArgumentParser:
                    metavar="S",
                    help="base of the exponential relaunch backoff "
                         "(seconds) under --supervise")
+    f.add_argument("--supervise-poison-deaths", type=int, default=2,
+                   metavar="N",
+                   help="consecutive same-iteration no-progress deaths "
+                        "that count as a poisoned run under --supervise "
+                        "(raise on heavily-preempted fleets, or for "
+                        "chaos plans that kill more than one launch)")
+    f.add_argument("--supervise-watchdog", type=float, default=0.0,
+                   metavar="S",
+                   help="deadlock watchdog under --supervise: abort "
+                        "with a typed PodHangError if the child "
+                        "neither finishes nor dies within S seconds "
+                        "of its launch (0 = off)")
     return p
 
 
@@ -269,17 +283,18 @@ def main(argv=None) -> int:
                              "resume substrate)")
         from dcfm_tpu.resilience.supervisor import run_supervised_cli
         child, skip = [], 0
+        sup_flags = ("--supervise-max-retries", "--supervise-backoff",
+                     "--supervise-poison-deaths", "--supervise-watchdog")
         for tok in raw:
             if skip:
                 skip -= 1
                 continue
             if tok == "--supervise":
                 continue
-            if tok in ("--supervise-max-retries", "--supervise-backoff"):
+            if tok in sup_flags:
                 skip = 1
                 continue
-            if tok.startswith(("--supervise-max-retries=",
-                               "--supervise-backoff=")):
+            if tok.startswith(tuple(f + "=" for f in sup_flags)):
                 continue
             child.append(tok)
         if "--resume" not in child:
@@ -290,7 +305,9 @@ def main(argv=None) -> int:
         return run_supervised_cli(
             child, checkpoint=args.checkpoint,
             max_retries=args.supervise_max_retries,
-            backoff_base=args.supervise_backoff)
+            backoff_base=args.supervise_backoff,
+            poison_deaths=args.supervise_poison_deaths,
+            launch_timeout=args.supervise_watchdog or None)
     # serve/export dispatch before the jax-heavy fit imports: serving an
     # existing artifact needs no accelerator stack at all, and export's
     # jax use (checkpoint template) is loaded lazily inside it.

@@ -37,6 +37,16 @@ from dcfm_tpu.ops.gamma import (
 from dcfm_tpu.ops.gig import gig, inverse_gaussian
 
 
+# Unroll ceiling of the MGP delta_h recursion (mirrors the Lambda
+# kernel's ops/gaussian._UNROLL_MAX_K).  Each unrolled step re-derives
+# tau via a K-length cumsum, so the straight-line graph grows O(K^2)
+# ops and XLA's compile time with it - fine for the reference-scale
+# K <= 16, pathological at factors_per_shard=64.  Above the ceiling the
+# same per-step math runs as a lax.scan over h: one compiled step,
+# K trips, identical update sequence.
+_MGP_UNROLL_MAX_K = 16
+
+
 class Prior(NamedTuple):
     """Triple of pure per-shard functions (see module docstring).
 
@@ -137,13 +147,20 @@ def make_mgp(cfg: ModelConfig) -> Prior:
         rates0 = jnp.where(hs == 0, c.bd1, c.bd2)
         g_std = jax.random.gamma(k_delta, shapes)         # (K,) Gamma(.,1)
 
-        for h in range(K):
-            tauh = _mgp_tauh(delta)
+        def _delta_step(d, h):
+            tauh_d = _mgp_tauh(d)
             # tau_l^{(-h)} = tau_l / delta_h for l >= h
-            tau_minus = tauh / delta[h]
+            tau_minus = tauh_d / d[h]
             mask = (hs >= h).astype(lam2.dtype)
             rate = rates0[h] + 0.5 * jnp.sum(mask * tau_minus * s)
-            delta = delta.at[h].set(g_std[h] / rate)
+            return d.at[h].set(g_std[h] / rate), None
+
+        if K <= _MGP_UNROLL_MAX_K:
+            for h in range(K):
+                delta, _ = _delta_step(delta, h)
+        else:
+            # large-K fallback (see _MGP_UNROLL_MAX_K): same step, scanned
+            delta, _ = jax.lax.scan(_delta_step, delta, hs)
         return {"psijh": psijh, "delta": delta}
 
     def row_precision(state):

@@ -55,6 +55,44 @@ def initialize_multihost(coordinator_address=None, num_processes=None,
     """
     if num_processes is not None and num_processes > 1 or (
             coordinator_address is not None):
+        # The CPU backend builds its client WITHOUT any collectives
+        # implementation by default (jax_cpu_collectives_implementation
+        # = "none"), and a collectives-free CPU client refuses every
+        # multi-process computation outright ("Multiprocess computations
+        # aren't implemented on the CPU backend").  Select Gloo before
+        # the distributed init so CPU pods (the dev/demo/fuzz lane) just
+        # work; an explicit non-"none" user setting is respected.  An
+        # explicit "none" is indistinguishable from the unset default
+        # and is upgraded too - inside initialize_multihost "none" can
+        # only mean every CPU collective fails, never a working config.
+        # On TPU slices the TPU client's ICI/DCN collectives are
+        # untouched by this.
+        impl = None
+        try:
+            # public attribute on jax versions that expose it
+            impl = jax.config.jax_cpu_collectives_implementation
+        except AttributeError:
+            try:
+                from jax._src import xla_bridge as _xb
+                impl = _xb.CPU_COLLECTIVES_IMPLEMENTATION.value
+            except Exception:  # dcfm: ignore[DCFM601] - unknown jax layout; treated as "unset" and the guarded update below decides
+                impl = None
+        if impl in (None, "none"):
+            try:
+                jax.config.update(
+                    "jax_cpu_collectives_implementation", "gloo")
+            except Exception as e:
+                # do NOT fail init - on a TPU slice the CPU client is
+                # not what computes - but never regress SILENTLY either:
+                # without Gloo, every CPU multi-process computation dies
+                # with the cryptic upstream error above.
+                import warnings
+                warnings.warn(
+                    "could not select Gloo CPU collectives "
+                    f"({e!r}); multi-process computations on the CPU "
+                    "backend will fail - set "
+                    "jax_cpu_collectives_implementation='gloo' "
+                    "explicitly", RuntimeWarning)
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
             num_processes=num_processes, process_id=process_id)

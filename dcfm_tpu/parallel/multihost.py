@@ -57,17 +57,27 @@ def initialize(coordinator_address: str, num_processes: int,
         process_id=process_id)
 
 
+# The environment rendezvous contract (initialize_from_env).  The pod
+# supervisor (resilience/supervisor.run_supervised_cli with pod=N,
+# `dcfm-tpu supervise --pod N`) exports exactly these per child process
+# - with a FRESH coordinator port per relaunch attempt, so a restarted
+# pod never races the dead coordinator's socket.
+COORDINATOR_ENV = "DCFM_COORDINATOR"
+NUM_PROCESSES_ENV = "DCFM_NUM_PROCESSES"
+PROCESS_ID_ENV = "DCFM_PROCESS_ID"
+
+
 def initialize_from_env() -> Optional[int]:
     """Initialize from DCFM_COORDINATOR / DCFM_NUM_PROCESSES / DCFM_PROCESS_ID.
 
     Returns the process id, or None (no-op) when the variables are unset -
     so single-host runs need no configuration at all.
     """
-    coord = os.environ.get("DCFM_COORDINATOR")
+    coord = os.environ.get(COORDINATOR_ENV)
     if not coord:
         return None
-    num = int(os.environ["DCFM_NUM_PROCESSES"])
-    pid = int(os.environ["DCFM_PROCESS_ID"])
+    num = int(os.environ[NUM_PROCESSES_ENV])
+    pid = int(os.environ[PROCESS_ID_ENV])
     initialize(coord, num, pid)
     return pid
 

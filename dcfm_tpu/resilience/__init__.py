@@ -12,11 +12,22 @@ first-class, *tested* subsystem:
   exponential backoff, a max-retry budget, and poison-iteration
   detection (the same iteration killing the child twice aborts with a
   typed :class:`PoisonedRunError` instead of crash-looping forever).
+  ``supervise_pod()`` / ``dcfm-tpu supervise --pod N`` extend the
+  contract to an N-process SPMD fit: any host death triggers a
+  coordinated stop (survivors blocked in collectives are reaped, not
+  left hung), the relaunch resumes from the newest *unanimously-held*
+  CRC-clean checkpoint generation, and a deadlock is bounded by a
+  watchdog (typed :class:`PodHangError`).
 * :mod:`dcfm_tpu.resilience.faults` - a deterministic fault-injection
   harness driven by the ``DCFM_FAULT_PLAN`` environment variable
-  (kill-at-iteration, torn checkpoint write, bit-flip corruption,
-  failing/delayed I/O), threaded through ``utils/checkpoint.py`` and
-  ``serve/artifact.py`` so chaos tests replay exact failure sequences.
+  (kill-at-iteration, kill-inside-a-named-resume-window, torn
+  checkpoint write, bit-flip corruption, failing/delayed I/O, all with
+  per-process / per-launch gates), threaded through
+  ``utils/checkpoint.py``, ``serve/artifact.py`` and the multi-host
+  resume gates in ``api.py`` so chaos tests replay exact failure
+  sequences - plus the seeded randomized crash-point scheduler
+  (``DCFM_FAULT_FUZZ=seed:N``, :func:`fuzz_spec`) the fuzz harness
+  sweeps.
 * :mod:`dcfm_tpu.resilience.sentinel` - the divergence sentinel api.fit
   folds into the chunk loop: on NaN/Inf in the chain it rewinds to the
   last checkpoint with a re-lineaged RNG key and an escalated ridge
@@ -27,21 +38,26 @@ retention so a fallback always exists) lives with the checkpoint format
 itself in :mod:`dcfm_tpu.utils.checkpoint`.
 """
 
-from dcfm_tpu.resilience.faults import FaultPlan, fault_plan
+from dcfm_tpu.resilience.faults import (
+    FaultPlan, fault_event, fault_plan, fuzz_spec)
 from dcfm_tpu.resilience.sentinel import (
     ChainDivergedError, DivergenceSentinel)
 from dcfm_tpu.resilience.supervisor import (
-    PoisonedRunError, RetriesExhaustedError, SuperviseReport, supervise,
-    supervise_command)
+    PodHangError, PoisonedRunError, RetriesExhaustedError,
+    SuperviseReport, supervise, supervise_command, supervise_pod)
 
 __all__ = [
     "ChainDivergedError",
     "DivergenceSentinel",
     "FaultPlan",
+    "fault_event",
     "fault_plan",
+    "fuzz_spec",
+    "PodHangError",
     "PoisonedRunError",
     "RetriesExhaustedError",
     "SuperviseReport",
     "supervise",
     "supervise_command",
+    "supervise_pod",
 ]

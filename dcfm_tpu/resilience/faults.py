@@ -12,6 +12,7 @@ plan.json``.  Schema::
 
     {"faults": [
       {"op": "kill",        "at_iteration": 16, "when": "post_save"},
+      {"op": "kill_event",  "event": "sidecar_gate", "at_occurrence": 1},
       {"op": "poison_state","at_iteration": 16},
       {"op": "torn_write",  "target": "checkpoint", "at_write": 2,
                             "keep_fraction": 0.5},
@@ -21,6 +22,19 @@ plan.json``.  Schema::
       {"op": "io_delay",    "target": "artifact",   "at_write": 1,
                             "seconds": 0.25}
     ]}
+
+Every fault additionally accepts two GATES, both optional:
+
+* ``"process": k`` - the fault fires only in the process whose
+  ``DCFM_FAULT_PROCESS`` environment variable equals ``k`` (the pod
+  supervisor / multihost demo exports one per host).  Absent the env
+  var, a process-gated fault never fires - so a shared plan can SIGKILL
+  exactly one host of a pod while its peers run it untouched.
+* ``"at_launch": n`` - the fault fires only in the n-th (1-based)
+  supervised launch (``DCFM_FAULT_LAUNCH``, exported by the
+  supervisor before every (re)launch; defaults to 1).  This is what
+  lets a crash-point plan kill launch 1 at a boundary, kill launch 2
+  inside the RESUME path, and still let launch 3 finish clean.
 
 Ops:
 
@@ -35,6 +49,17 @@ Ops:
   re-die - which is exactly what makes the post-save drill terminate
   and the pre-save drill loop (until the supervisor's poison detector
   aborts it).
+* ``kill_event`` - SIGKILL this process at the ``at_occurrence``-th
+  (1-based, default 1) firing of a NAMED code-path event.  Events are
+  emitted by :func:`fault_event` calls threaded through the multi-host
+  resume path (api._resume_state_multiproc): ``resume_gate`` /
+  ``resume_gate_post`` bracket the source-signature allgather,
+  ``sidecar_gate`` precedes the sidecar-eligibility allgather (gate 1),
+  ``sidecar_load`` lands between gate 1 passing and the payload load,
+  and ``sidecar_commit`` / ``sidecar_commit_post`` bracket the
+  payload-success allgather (gate 2).  A kill BETWEEN two collectives
+  on one host leaves its peers blocked inside the next one - exactly
+  the state the pod supervisor's coordinated stop must reap.
 * ``poison_state`` - at the matching boundary the caller (api.fit)
   multiplies the carried sampler state by NaN, simulating an on-device
   divergence; the next chunk's health reduction trips the sentinel.
@@ -55,6 +80,12 @@ saves) and ``"artifact"`` (``serve/artifact`` exports); an optional
 ``"path_re"`` regex narrows a fault to matching paths (e.g. exclude the
 ``.full`` sidecar).
 
+Randomized crash-point fuzzing: ``DCFM_FAULT_FUZZ=seed:N`` expands the
+N-th crash point of a seeded deterministic stream into a concrete plan
+(:func:`fuzz_spec`) - the fuzz harness sweeps N while the seed pins the
+whole campaign, so any failing point replays exactly.
+``DCFM_FAULT_PLAN`` wins when both are set.
+
 Everything is stdlib + numpy; with no plan installed every hook is a
 cheap no-op (one truthiness check).
 """
@@ -63,6 +94,7 @@ from __future__ import annotations
 
 import json
 import os
+import random
 import re
 import signal
 import time
@@ -71,9 +103,17 @@ from typing import Optional
 import numpy as np
 
 ENV_VAR = "DCFM_FAULT_PLAN"
+FUZZ_ENV_VAR = "DCFM_FAULT_FUZZ"
+PROCESS_ENV_VAR = "DCFM_FAULT_PROCESS"
+LAUNCH_ENV_VAR = "DCFM_FAULT_LAUNCH"
 
-_VALID_OPS = {"kill", "poison_state", "torn_write", "bit_flip", "io_error",
-              "io_delay"}
+_VALID_OPS = {"kill", "kill_event", "poison_state", "torn_write",
+              "bit_flip", "io_error", "io_delay"}
+
+# Resume-path events the multi-host fuzz targets (api.fit emits them via
+# fault_event; see the kill_event op above).
+FUZZ_EVENTS = ("resume_gate", "resume_gate_post", "sidecar_gate",
+               "sidecar_load", "sidecar_commit", "sidecar_commit_post")
 
 
 class FaultPlanError(ValueError):
@@ -98,19 +138,46 @@ class FaultPlan:
                     f"(expected one of {sorted(_VALID_OPS)})")
             if op in ("kill", "poison_state") and "at_iteration" not in f:
                 raise FaultPlanError(f"fault #{i}: {op} needs at_iteration")
+            if op == "kill_event" and "event" not in f:
+                raise FaultPlanError(f"fault #{i}: kill_event needs event")
             if op in ("torn_write", "bit_flip", "io_error", "io_delay") \
                     and "at_write" not in f:
                 raise FaultPlanError(f"fault #{i}: {op} needs at_write")
             self.faults.append(dict(f))
         # 1-based write counters, keyed per target
         self._writes: dict = {}
+        # 1-based event-occurrence counters, keyed per event name
+        self._events: dict = {}
         self._fired: set = set()
+
+    @staticmethod
+    def _gates_open(f: dict) -> bool:
+        """Process / launch gates (see module doc).  A process-gated
+        fault without DCFM_FAULT_PROCESS in the environment never fires
+        - the safe default for a shared pod plan."""
+        p = f.get("process")
+        if p is not None:
+            mine = os.environ.get(PROCESS_ENV_VAR)
+            if mine is None or int(mine) != int(p):
+                return False
+        n = f.get("at_launch")
+        if n is not None:
+            if int(os.environ.get(LAUNCH_ENV_VAR, "1")) != int(n):
+                return False
+        return True
 
     @classmethod
     def from_env(cls) -> Optional["FaultPlan"]:
         raw = os.environ.get(ENV_VAR)
         if not raw:
-            return None
+            fuzz = os.environ.get(FUZZ_ENV_VAR)
+            if not fuzz:
+                return None
+            m = re.match(r"^(-?\d+):(\d+)$", fuzz.strip())
+            if not m:
+                raise FaultPlanError(
+                    f"{FUZZ_ENV_VAR} must be 'seed:index', got {fuzz!r}")
+            return cls(fuzz_spec(int(m.group(1)), int(m.group(2))))
         if raw.startswith("@"):
             with open(raw[1:], "r", encoding="utf-8") as f:
                 raw = f.read()
@@ -127,6 +194,8 @@ class FaultPlan:
             if f["op"] != op or (i, op) in self._fired:
                 continue
             if op == "kill" and f.get("when", "post_save") != phase:
+                continue
+            if not self._gates_open(f):
                 continue
             at = int(f["at_iteration"])
             # only runs that STARTED below the trigger fire it: a resumed
@@ -150,10 +219,27 @@ class FaultPlan:
             "poison_state", "post_save", iteration, start_iteration
         ) is not None
 
+    # -- code-path events (the resume-window crash points) -------------
+    def maybe_kill_event(self, event: str) -> None:
+        """Count an occurrence of ``event`` and SIGKILL this process if a
+        kill_event fault matches it (occurrence counters are per-process
+        and per-launch, like the write counters)."""
+        count = self._events.get(event, 0) + 1
+        self._events[event] = count
+        for i, f in enumerate(self.faults):
+            if f["op"] != "kill_event" or (i, "kill_event") in self._fired:
+                continue
+            if f["event"] != event or int(f.get("at_occurrence", 1)) != count:
+                continue
+            if not self._gates_open(f):
+                continue
+            self._fired.add((i, "kill_event"))
+            os.kill(os.getpid(), signal.SIGKILL)
+
     # -- write faults --------------------------------------------------
     def _write_faults(self, target: str, path: str, count: int):
         for f in self.faults:
-            if f["op"] in ("kill", "poison_state"):
+            if f["op"] in ("kill", "kill_event", "poison_state"):
                 continue
             if f.get("target", "checkpoint") != target:
                 continue
@@ -161,6 +247,8 @@ class FaultPlan:
                 continue
             pr = f.get("path_re")
             if pr and not re.search(pr, path):
+                continue
+            if not self._gates_open(f):
                 continue
             yield f
 
@@ -244,3 +332,98 @@ def clear() -> None:
     environment)."""
     global _ACTIVE, _LOADED
     _ACTIVE, _LOADED = None, False
+
+
+def fault_event(name: str) -> None:
+    """Emit a named code-path event into the fault harness (a cheap
+    no-op without a plan).  api.fit threads these through the multi-host
+    resume path so kill_event faults can land INSIDE the collective
+    gate windows - see :data:`FUZZ_EVENTS`."""
+    plan = fault_plan()
+    if plan is not None:
+        plan.maybe_kill_event(name)
+
+
+# ---------------------------------------------------------------------------
+# randomized crash-point fuzzing (DCFM_FAULT_FUZZ=seed:N)
+# ---------------------------------------------------------------------------
+
+def fuzz_spec(seed: int, index: int, *,
+              boundaries=(2, 4, 6, 8),
+              max_writes: int = 4,
+              nproc: int = 2,
+              events=FUZZ_EVENTS) -> dict:
+    """The ``index``-th crash point of a seeded deterministic stream, as
+    a concrete fault-plan spec.  Same (seed, index, knobs) -> same plan,
+    always - a failing fuzz point is replayed by its coordinates alone.
+
+    The defaults describe the 2-process multihost demo workload
+    (boundaries every 2 iterations to 8, one checkpoint write per
+    boundary per process); harnesses with other schedules pass their
+    own.  ``events=()`` drops the resume-window kill points (the
+    single-process smoke: there is no collective gate to kill inside).
+
+    Every injected fault is gated to a specific launch (``at_launch``),
+    so it models an ENVIRONMENTAL failure - a preemption does not
+    re-fire deterministically on the relaunch.  (Without the gate, a
+    boundary kill re-arms whenever a later launch legitimately resumes
+    from a sidecar BEHIND the kill iteration - the ``start_iteration <
+    at`` rule sees a fresh crossing - and the run correctly but
+    uninterestingly ends in the poison abort; deterministic-failure
+    containment has its own dedicated drills.)
+
+    Four crash-point shapes, chosen per index:
+
+    * a boundary ``kill`` (pre- or post-save) of one random process in
+      launch 1;
+    * a ``torn_write``/``bit_flip`` of a random checkpoint write
+      (sometimes narrowed to the ``.full`` sidecar, sometimes applied
+      on every host) followed by a post-save kill at-or-after the
+      boundary that wrote it, so the resume must recover OVER the
+      corruption;
+    * an ``io_error`` on a random save in launch 1 (the child dies on
+      the raised save; the relaunch must proceed);
+    * a resume-window ``kill_event``: launch 1 dies at a boundary,
+      launch 2 is killed inside a random collective-gate event, and
+      launch 3 must still finish clean.
+    """
+    rng = random.Random(f"dcfm-fuzz:{int(seed)}:{int(index)}")
+    boundaries = tuple(int(b) for b in boundaries)
+    kinds = ["boundary_kill", "write_then_kill", "io_error"]
+    if events:
+        kinds.append("resume_event_kill")
+    kind = rng.choice(kinds)
+    faults = []
+    if kind == "boundary_kill":
+        faults.append({"op": "kill", "at_iteration": rng.choice(boundaries),
+                       "when": rng.choice(["pre_save", "post_save"]),
+                       "process": rng.randrange(nproc), "at_launch": 1})
+    elif kind == "write_then_kill":
+        w = rng.randint(1, max_writes)
+        f = {"op": rng.choice(["torn_write", "bit_flip"]),
+             "target": "checkpoint", "at_write": w, "at_launch": 1}
+        if rng.random() < 0.5:
+            f["process"] = rng.randrange(nproc)
+        if rng.random() < 0.3:
+            f["path_re"] = r"\.full"
+        faults.append(f)
+        # the kill lands at the boundary of write w or later, so the
+        # relaunch resumes over (or around) the corrupted generation
+        b = rng.choice(boundaries[min(w, len(boundaries)) - 1:])
+        faults.append({"op": "kill", "at_iteration": b,
+                       "when": "post_save",
+                       "process": rng.randrange(nproc), "at_launch": 1})
+    elif kind == "io_error":
+        f = {"op": "io_error", "target": "checkpoint",
+             "at_write": rng.randint(1, max_writes), "at_launch": 1}
+        if rng.random() < 0.5:
+            f["process"] = rng.randrange(nproc)
+        faults.append(f)
+    else:
+        faults.append({"op": "kill", "when": "post_save",
+                       "at_iteration": rng.choice(boundaries[:-1]),
+                       "process": rng.randrange(nproc), "at_launch": 1})
+        faults.append({"op": "kill_event", "event": rng.choice(list(events)),
+                       "at_occurrence": 1, "at_launch": 2,
+                       "process": rng.randrange(nproc)})
+    return {"faults": faults}

@@ -18,10 +18,36 @@ a bad shard of data) and relaunching forever would burn the cluster.
 The supervisor aborts with a typed :class:`PoisonedRunError` carrying
 the offending checkpoint path for offline triage.
 
-Scope: single-host children (the CLI command or a config+data fit).
-On pods, each host's launcher wraps its own process with
-``supervise_command``; the collective resume agreement inside fit()
-(api._resume_state_multiproc) already handles mixed per-host states.
+Pod-grade supervision (:func:`supervise_pod`, ``dcfm-tpu supervise
+--pod N``): the same crash-only contract for an N-process SPMD fit.
+Three things change at pod scale, and all three live here:
+
+* **Coordinated stop** - SPMD collectives cannot complete with a dead
+  peer, so when ANY process dies the survivors are blocked inside a
+  psum/allgather, not failing.  The supervisor detects the first death
+  and REAPS the remaining processes (SIGTERM, a grace period, SIGKILL)
+  instead of waiting on a hang that would never resolve.
+* **Unanimous-generation resume** - each process checkpoints its own
+  ``.procK-of-N`` shard file with its own ``.bakN`` retention chain, so
+  after a crash the newest generation may exist on only SOME hosts (a
+  kill between two processes' saves) or be CRC-corrupt on one.  The
+  relaunch pre-pass (:func:`_ensure_unanimous_checkpoint`) demotes
+  corrupt generations per slot, then promotes the newest generation
+  held CRC-clean by ALL processes - the only state the collective
+  resume gate inside fit() will accept.  When no generation is
+  unanimously held, the live files are set aside (``.orphan``) so every
+  host deterministically starts fresh rather than refusing forever.
+* **Hang watchdog** - a launch in which no process dies but none
+  progresses (the deadlock class the crash-point fuzz hunts) is bounded
+  by ``launch_timeout``: the pod is killed and the typed
+  :class:`PodHangError` raised.  A hang is a bug, not a scheduling
+  event - it is never retried.
+
+Because per-iteration RNG keys derive from the global iteration, a
+supervised pod run is BIT-IDENTICAL to an uninterrupted one whenever
+the resume preserved every accumulated draw (always true in
+checkpoint_mode="full"; in "light" mode a resume that falls back past a
+light save re-runs the lost window - documented in README).
 """
 
 from __future__ import annotations
@@ -57,6 +83,15 @@ class PoisonedRunError(RuntimeError):
 class RetriesExhaustedError(RuntimeError):
     """The child kept dying (with progress between deaths, so not
     poison) past the retry budget."""
+
+
+class PodHangError(RuntimeError):
+    """No process died, none finished, and the watchdog
+    (``launch_timeout``) expired: the pod is deadlocked - e.g. hosts
+    stuck in collectives that can never complete because a peer took a
+    different resume branch.  A hang is a BUG (the unanimity gates
+    exist to make it impossible), so it is raised typed, never
+    retried."""
 
 
 @dataclasses.dataclass
@@ -133,31 +168,132 @@ def _progress_iteration(path: str) -> int:
     return best
 
 
+def _unanimous_iteration(per_slot_holdings) -> int:
+    """THE one encoding of the unanimously-held-generation rule: the
+    newest iteration present in EVERY slot's holdings (any iterable of
+    iterations per slot; -1 when none).  Both the relaunch pre-pass and
+    the death-accounting measure derive from this, so they can never
+    disagree about what the pod can resume."""
+    common: Optional[set] = None
+    for held in per_slot_holdings:
+        s = set(held)
+        common = s if common is None else (common & s)
+        if not common:
+            return -1
+    return max(common) if common else -1
+
+
+def _pod_progress(path: str, num_processes: int) -> int:
+    """Read-only pod progress: the best of :func:`_progress_iteration`
+    (plain file / complete agreeing LIVE set) and the newest iteration
+    held CRC-clean by ALL proc slots across their retention chains.
+    The death-accounting measure for pods: a kill between two
+    processes' saves routinely leaves MIXED live files (no complete
+    agreeing set, so _progress_iteration alone says -1), and -1 deaths
+    in a row would satisfy the poison check's same-iteration rule even
+    while the pod makes real progress between crashes.  Progress is
+    what the next launch can actually resume - the unanimous
+    generation.  NOTE this measures RESUMABLE progress on purpose: a
+    pod repeatedly preempted before its first unanimous save past a
+    stale plain checkpoint genuinely makes none, and poison_deaths
+    consecutive such deaths abort exactly like the documented
+    single-host preemptions-inside-one-save-window caveat
+    (supervise_command) - raise ``poison_deaths`` on fleets where that
+    timing is routine."""
+    from dcfm_tpu.utils.checkpoint import proc_path, scan_generations
+    per_slot = []
+    for i in range(num_processes):
+        slot = proc_path(path, i, num_processes)
+        per_slot.append({it for _, it, err in scan_generations(slot)
+                         if err is None})
+    return max(_progress_iteration(path), _unanimous_iteration(per_slot))
+
+
+def _watchdog_progress(path: str, num_processes: int) -> int:
+    """The hang watchdog's liveness SCORE: the sum of the iterations
+    every slot's live file reports (meta-only - cheap enough to poll).
+    A sum, not a max, and deliberately NOT the resumability measure:
+    one slow host saving its own ``.procK-of-N`` file every boundary
+    while a finished peer's file is parked at a HIGHER iteration must
+    still move the score (a max would sit at the parked value, and
+    _progress_iteration reads the disagreeing live set as -1 outright)
+    - any single slot's advance proves the pod is alive, which is all
+    the watchdog needs to reset its deadline."""
+    from dcfm_tpu.utils.checkpoint import proc_path, read_checkpoint_meta
+    candidates = [path] + [proc_path(path, i, num_processes)
+                           for i in range(num_processes)]
+    score = -1
+    for p in candidates:
+        try:
+            it = int(read_checkpoint_meta(p)["iteration"])
+        except Exception:  # dcfm: ignore[DCFM601] - absent/mid-write file is simply not liveness evidence
+            continue
+        score = it if score < 0 else score + it
+    return score
+
+
+def _demote(p: str, err, report: SuperviseReport,
+            log: Callable[[str], None]) -> None:
+    log(f"checkpoint {p} unusable ({err}); demoting")
+    report.corrupt_fallbacks += 1
+    try:
+        os.replace(p, p + ".corrupt")
+    except OSError:
+        pass  # dcfm: ignore[DCFM601] - a vanished file is already demoted
+
+
+def _promote(src: str, slot: str) -> None:
+    """Install retained generation ``src`` into the live ``slot``
+    WITHOUT removing it from its ``.bakK`` position: a plain
+    ``os.replace`` would take the generation OUT of the retention
+    chain, and the cross-slot unanimity intersection must still find
+    it at its ``.bakK`` position after a second failure (a promoted
+    generation that exists only in the live slot of the host that
+    promoted it is no longer unanimously held).  Hardlink into place
+    like the keep_last rotation does
+    (utils.checkpoint._rotate_retained); copy on link-less
+    filesystems."""
+    tmp = slot + ".promote.tmp"
+    try:
+        os.link(src, tmp)
+    except OSError:
+        import shutil
+        shutil.copy2(src, tmp)
+    os.replace(tmp, slot)
+
+
+def _clean_generations(slot: str, report: SuperviseReport,
+                       log: Callable[[str], None]) -> dict:
+    """Integrity-scan one slot's retention chain, demoting corrupt
+    generations; returns {iteration: path} of the clean ones (the
+    newest file wins when two generations hold the same iteration)."""
+    from dcfm_tpu.utils.checkpoint import scan_generations
+    out: dict = {}
+    for p, it, err in scan_generations(slot):
+        if err is not None:
+            _demote(p, err, report, log)
+        else:
+            out.setdefault(it, p)
+    return out
+
+
 def _ensure_slot(slot: str, report: SuperviseReport,
                  log: Callable[[str], None]) -> int:
     """Walk ONE slot's retention chain newest-first, demoting corrupt
     generations and promoting the first verified one into the live
     position.  Returns its iteration (-1 = nothing survived)."""
-    from dcfm_tpu.utils.checkpoint import (
-        retained_checkpoints, verify_checkpoint)
-    for p in retained_checkpoints(slot):
-        try:
-            meta = verify_checkpoint(p)
-        except Exception as e:  # CRC mismatch, torn npz, old format, ...
-            log(f"checkpoint {p} unusable ({e}); demoting")
-            report.corrupt_fallbacks += 1
-            try:
-                os.replace(p, p + ".corrupt")
-            except OSError:
-                pass  # dcfm: ignore[DCFM601] - a vanished file is already demoted
+    from dcfm_tpu.utils.checkpoint import scan_generations
+    for p, it, err in scan_generations(slot):
+        if err is not None:
+            _demote(p, err, report, log)
             continue
         if p != slot:
             # promote the retained generation into the live slot; the
             # child resumes it exactly as if it were the newest save
-            os.replace(p, slot)
+            _promote(p, slot)
             log(f"promoted retained checkpoint {p} -> {slot} "
-                f"(iteration {meta['iteration']})")
-        return int(meta["iteration"])
+                f"(iteration {it})")
+        return it
     return -1
 
 
@@ -175,63 +311,208 @@ def _ensure_good_checkpoint(path: str, report: SuperviseReport,
     return _progress_iteration(path)
 
 
-def supervise_command(
-    argv: list,
+def _ensure_unanimous_checkpoint(path: str, num_processes: int,
+                                 report: SuperviseReport,
+                                 log: Callable[[str], None]) -> int:
+    """Pod integrity pre-pass: promote, into every ``.procK-of-N`` live
+    slot, the newest generation held CRC-CLEAN BY ALL ``num_processes``
+    slots.  Per-slot newest-clean promotion (the single-host rule) is
+    wrong on a pod: a kill between two processes' saves leaves the
+    newest generation on only some hosts, and promoting it there hands
+    the children a mixed state the collective resume gate refuses on
+    every relaunch, forever.  Unanimity is the resumability criterion
+    the gate itself applies, so the pre-pass applies it too.
+
+    Generations newer than the unanimous one are discarded by the
+    promotion (they could never be resumed); when NO generation is
+    unanimously held, the remaining live files are set aside as
+    ``.orphan`` so each host's discovery deterministically starts
+    fresh.  Corrupt ``.full`` sidecar generations are demoted as well -
+    the sidecar's own collective gates handle partial or mismatched
+    sidecar sets at resume time.  Returns the resulting pod progress
+    (:func:`_progress_iteration`)."""
+    from dcfm_tpu.utils.checkpoint import proc_path, scan_generations
+    slots = [proc_path(path, i, num_processes)
+             for i in range(num_processes)]
+    # Slots OUTSIDE the current-N set keep the single-slot treatment:
+    # the plain path (an earlier single-process run of the same chain)
+    # and any stale ``.procK-of-M`` set from a different process count
+    # - discovery's most-progress rule can still select those for a
+    # topology-flexible resume, so a corrupt one must be demoted here
+    # exactly as the single-host pre-pass would, or it wins discovery
+    # and fails the load on every relaunch.
+    current = set(slots)
+    for slot in _checkpoint_slots(path):
+        if slot not in current:
+            _ensure_slot(slot, report, log)
+    gens = [_clean_generations(s, report, log) for s in slots]
+    it_star = _unanimous_iteration(gens)
+    if it_star >= 0:
+        for slot, g in zip(slots, gens):
+            src = g[it_star]
+            if src != slot:
+                _promote(src, slot)
+                log(f"promoted retained checkpoint {src} -> {slot} "
+                    f"(iteration {it_star}, unanimous over "
+                    f"{num_processes} processes)")
+    else:
+        for slot in slots:
+            if os.path.exists(slot):
+                log(f"no unanimously-held generation; setting aside "
+                    f"{slot}")
+                try:
+                    os.replace(slot, slot + ".orphan")
+                except OSError:
+                    pass  # dcfm: ignore[DCFM601] - a vanished file needs no setting aside
+    for i in range(num_processes):
+        side = proc_path(path + ".full", i, num_processes)
+        for p, _, err in scan_generations(side):
+            if err is not None:
+                _demote(p, err, report, log)
+    return _progress_iteration(path)
+
+
+def _await_pod(procs: list, launch_timeout: Optional[float], grace: float,
+               log: Callable[[str], None],
+               progress_fn: Optional[Callable[[], int]] = None) -> int:
+    """Wait for a launch's processes.  Returns 0 when ALL exited 0; on
+    the first non-zero exit the survivors are REAPED (coordinated stop:
+    SIGTERM, ``grace`` seconds, SIGKILL - a dead peer leaves them
+    blocked inside a collective that can never complete) and that exit
+    code is returned.
+
+    Raises :class:`PodHangError` when the launch makes NO OBSERVABLE
+    PROGRESS for ``launch_timeout`` seconds (None = wait forever).
+    Progress that resets the deadline: a clean process exit (a pod
+    where one host finished its no-op resume while a slower sibling
+    legitimately re-runs a lost window is not hanging), and an advance
+    of the checkpoint iteration reported by ``progress_fn`` (polled at
+    a coarse cadence; a healthy fit checkpoints at every boundary, so
+    a long chain is never mistaken for a deadlock as long as the
+    watchdog exceeds one boundary-to-boundary interval)."""
+    deadline = (time.perf_counter() + launch_timeout
+                if launch_timeout else None)
+    finished = 0
+    last_progress = None
+    next_probe = 0.0
+    try:
+        while True:
+            codes = [p.poll() for p in procs]
+            dead = [c for c in codes if c is not None and c != 0]
+            if dead:
+                alive = sum(c is None for c in codes)
+                if alive:
+                    log(f"process died (exit {dead[0]}); coordinated stop "
+                        f"of {alive} surviving process(es)")
+                _reap(procs, grace)
+                return dead[0]
+            if all(c == 0 for c in codes):
+                return 0
+            now = time.perf_counter()
+            done_now = sum(c == 0 for c in codes)
+            if done_now > finished:
+                finished = done_now
+                if launch_timeout:
+                    deadline = now + launch_timeout
+            if (launch_timeout and progress_fn is not None
+                    and now >= next_probe):
+                next_probe = now + max(1.0, launch_timeout / 10.0)
+                try:
+                    p_now = progress_fn()
+                except Exception:  # dcfm: ignore[DCFM601] - a torn mid-save meta is not a hang verdict; the next probe retries
+                    p_now = None
+                if p_now is not None and (last_progress is None
+                                          or p_now > last_progress):
+                    if last_progress is not None:
+                        deadline = now + launch_timeout
+                    last_progress = p_now
+            if deadline is not None and now > deadline:
+                _reap(procs, grace)
+                raise PodHangError(
+                    f"no process finished or died, and the checkpoint "
+                    f"iteration did not advance, within the "
+                    f"{launch_timeout:.0f}s watchdog - the pod is "
+                    "deadlocked (processes blocked in collectives that "
+                    "cannot complete); this is a bug, not a scheduling "
+                    "event, and is not retried")
+            time.sleep(0.05)
+    finally:
+        # never leak a child, whatever raised above
+        if any(p.poll() is None for p in procs):
+            _reap(procs, grace)
+
+
+def _reap(procs: list, grace: float) -> None:
+    for p in procs:
+        if p.poll() is None:
+            p.terminate()
+    deadline = time.perf_counter() + grace
+    for p in procs:
+        while p.poll() is None and time.perf_counter() < deadline:
+            time.sleep(0.02)
+        if p.poll() is None:
+            p.kill()
+        p.wait()
+
+
+def _run_supervision(
+    spawn: Callable[[int], list],
     *,
     checkpoint_path: str,
+    num_processes: int = 1,
     max_retries: int = 5,
     backoff_base: float = 1.0,
     backoff_max: float = 60.0,
     poison_deaths: int = 2,
-    env: Optional[dict] = None,
+    launch_timeout: Optional[float] = None,
+    grace: float = 5.0,
     log: Callable[[str], None] = _log,
 ) -> SuperviseReport:
-    """Run ``argv`` as a child process until it exits 0, resuming it
-    through crashes.  The generic core both CLI modes and
-    :func:`supervise` build on.
-
-    Contract for ``argv``: it must checkpoint to ``checkpoint_path`` and
-    resume from it when relaunched unchanged (the ``dcfm-tpu fit
-    --checkpoint ... --resume`` CLI and the internal ``_child`` runner
-    both satisfy it).
-
-    Raises :class:`PoisonedRunError` when ``poison_deaths`` consecutive
-    deaths show the same checkpoint iteration with no progress (default
-    2: the same iteration killed the child twice),
-    :class:`RetriesExhaustedError` past ``max_retries``
-    relaunches-after-death.  CAVEAT: on heavily-preempted fleets whose
-    checkpoint cadence is long, two RANDOM preemptions can land inside
-    one save window and mimic poison; raise ``poison_deaths`` there (the
-    budget trades crash-loop protection against false aborts).
-    """
+    """The one supervision loop under every mode.  ``spawn(attempt)``
+    (1-based) starts the attempt's process(es) and returns their
+    ``subprocess.Popen`` handles; everything else - integrity pre-pass,
+    death accounting, poison detection, backoff, watchdog - is shared
+    between the single-host and pod paths."""
     report = SuperviseReport()
     t0 = time.perf_counter()
-    full_env = dict(os.environ)
-    if env:
-        full_env.update(env)
     prev_death_iter: Optional[int] = None
     same_iter_deaths = 0
+
+    def _pre_pass():
+        if num_processes > 1:
+            return _ensure_unanimous_checkpoint(
+                checkpoint_path, num_processes, report, log)
+        return _ensure_good_checkpoint(checkpoint_path, report, log)
+
     while True:
-        it_before = _ensure_good_checkpoint(checkpoint_path, report, log)
+        it_before = _pre_pass()
         report.launches += 1
         log(f"launch #{report.launches} (checkpoint at iteration "
             f"{it_before})")
-        proc = subprocess.run(argv, env=full_env)
-        if proc.returncode == 0:
+        procs = spawn(report.launches)
+        # the watchdog's liveness probe: cheap meta-only reads (no CRC
+        # scan - that is the relaunch pre-pass's job), so polling it at
+        # the coarse _await_pod cadence costs nothing
+        rc = _await_pod(
+            procs, launch_timeout, grace, log,
+            progress_fn=lambda: _watchdog_progress(checkpoint_path,
+                                                   num_processes))
+        if rc == 0:
             # leave the live slot VERIFIED on the way out too: the final
             # save itself can be the corrupt one (observed under chaos
             # plans whose write counters hit the last boundary), and a
             # future resume should find the newest CLEAN generation
             # promoted, not trip over bad bytes
-            report.final_iteration = _ensure_good_checkpoint(
-                checkpoint_path, report, log)
+            report.final_iteration = _pre_pass()
             report.elapsed_s = time.perf_counter() - t0
             log(f"child finished after {report.launches} launch(es), "
                 f"{report.corrupt_fallbacks} corrupt fallback(s)")
             return report
-        it_died = _progress_iteration(checkpoint_path)
-        report.deaths.append((proc.returncode, it_died))
-        log(f"child died (exit {proc.returncode}) at checkpoint "
+        it_died = (_pod_progress(checkpoint_path, num_processes)
+                   if num_processes > 1
+                   else _progress_iteration(checkpoint_path))
+        report.deaths.append((rc, it_died))
+        log(f"child died (exit {rc}) at checkpoint "
             f"iteration {it_died}")
         # Poison = the same iteration killed the child ``poison_deaths``
         # times in a row: each counted death shows NO progress over the
@@ -249,7 +530,7 @@ def supervise_command(
             report.elapsed_s = time.perf_counter() - t0
             raise PoisonedRunError(
                 f"iteration {it_died} killed the child {same_iter_deaths} "
-                f"times in a row (exit {proc.returncode}) - the failure "
+                f"times in a row (exit {rc}) - the failure "
                 "is deterministic, not environmental; inspect the run at "
                 f"the offending checkpoint: {checkpoint_path}",
                 checkpoint_path=checkpoint_path, iteration=it_died)
@@ -259,10 +540,106 @@ def supervise_command(
             report.elapsed_s = time.perf_counter() - t0
             raise RetriesExhaustedError(
                 f"child died {retries} times (retry budget {max_retries}); "
-                f"last exit {proc.returncode} at iteration {it_died}")
+                f"last exit {rc} at iteration {it_died}")
         delay = min(backoff_max, backoff_base * (2.0 ** (retries - 1)))
         log(f"backing off {delay:.2f}s before relaunch")
         time.sleep(delay)
+
+
+def supervise_command(
+    argv: list,
+    *,
+    checkpoint_path: str,
+    max_retries: int = 5,
+    backoff_base: float = 1.0,
+    backoff_max: float = 60.0,
+    poison_deaths: int = 2,
+    launch_timeout: Optional[float] = None,
+    env: Optional[dict] = None,
+    log: Callable[[str], None] = _log,
+) -> SuperviseReport:
+    """Run ``argv`` as a child process until it exits 0, resuming it
+    through crashes.  The single-host core both CLI modes and
+    :func:`supervise` build on (:func:`supervise_pod` is its N-process
+    sibling).
+
+    Contract for ``argv``: it must checkpoint to ``checkpoint_path`` and
+    resume from it when relaunched unchanged (the ``dcfm-tpu fit
+    --checkpoint ... --resume`` CLI and the internal ``_child`` runner
+    both satisfy it).
+
+    Raises :class:`PoisonedRunError` when ``poison_deaths`` consecutive
+    deaths show the same checkpoint iteration with no progress (default
+    2: the same iteration killed the child twice),
+    :class:`RetriesExhaustedError` past ``max_retries``
+    relaunches-after-death, and :class:`PodHangError` when a launch
+    makes no observable progress within ``launch_timeout`` seconds
+    (None, the default, disables the watchdog).  CAVEAT: on
+    heavily-preempted fleets whose checkpoint cadence is long, two
+    RANDOM preemptions can land inside one save window and mimic
+    poison; raise ``poison_deaths`` there (the budget trades crash-loop
+    protection against false aborts).
+
+    Every launch exports ``DCFM_FAULT_LAUNCH`` (the 1-based attempt
+    number) to the child so launch-gated chaos faults
+    (resilience/faults.py) stay deterministic across relaunches.
+    """
+    full_env = dict(os.environ)
+    if env:
+        full_env.update(env)
+
+    def spawn(attempt: int) -> list:
+        child_env = dict(full_env)
+        child_env["DCFM_FAULT_LAUNCH"] = str(attempt)
+        return [subprocess.Popen(argv, env=child_env)]
+
+    return _run_supervision(
+        spawn, checkpoint_path=checkpoint_path, num_processes=1,
+        max_retries=max_retries, backoff_base=backoff_base,
+        backoff_max=backoff_max, poison_deaths=poison_deaths,
+        launch_timeout=launch_timeout, log=log)
+
+
+def supervise_pod(
+    spawn: Callable[[int], list],
+    *,
+    checkpoint_path: str,
+    num_processes: int,
+    max_retries: int = 5,
+    backoff_base: float = 1.0,
+    backoff_max: float = 60.0,
+    poison_deaths: int = 2,
+    launch_timeout: Optional[float] = None,
+    grace: float = 5.0,
+    log: Callable[[str], None] = _log,
+) -> SuperviseReport:
+    """Coordinated multi-host supervision: run an N-process SPMD fit
+    until every process exits 0, surviving the death of any subset.
+
+    ``spawn(attempt)`` (1-based) must start all ``num_processes``
+    processes of one launch and return their ``Popen`` handles - it
+    owns the per-process environment (coordinator address/port,
+    ``DCFM_PROCESS_ID``, ``DCFM_FAULT_PROCESS``/``DCFM_FAULT_LAUNCH``
+    for chaos runs); spawning a FRESH coordinator port per attempt
+    avoids racing the dead coordinator's socket.  The children must
+    checkpoint to ``checkpoint_path`` (per-process ``.procK-of-N``
+    files) and resume from it when relaunched.
+
+    On any process death the survivors are reaped (they are blocked
+    inside collectives a dead peer can never join - see
+    :func:`_await_pod`), the per-slot retention chains are demoted /
+    promoted to the newest *unanimously-held* CRC-clean generation
+    (:func:`_ensure_unanimous_checkpoint`), and the WHOLE pod is
+    relaunched - processes that had already finished re-run as no-op
+    resumes.  Poison detection, retry budget, backoff and the
+    ``launch_timeout`` deadlock watchdog are exactly the single-host
+    semantics (:func:`supervise_command`)."""
+    return _run_supervision(
+        spawn, checkpoint_path=checkpoint_path,
+        num_processes=num_processes, max_retries=max_retries,
+        backoff_base=backoff_base, backoff_max=backoff_max,
+        poison_deaths=poison_deaths, launch_timeout=launch_timeout,
+        grace=grace, log=log)
 
 
 def supervise(Y, cfg, *, max_retries: int = 5, backoff_base: float = 1.0,
@@ -333,19 +710,49 @@ def supervise(Y, cfg, *, max_retries: int = 5, backoff_base: float = 1.0,
 def run_supervised_cli(child_argv: list, *, checkpoint: str,
                        max_retries: int = 5, backoff_base: float = 1.0,
                        backoff_max: float = 60.0,
-                       poison_deaths: int = 2) -> int:
+                       poison_deaths: int = 2,
+                       launch_timeout: Optional[float] = None,
+                       pod: int = 0, port_base: int = 29900) -> int:
     """The ONE home of the CLI supervision protocol, shared by
     ``dcfm-tpu fit --supervise`` and ``dcfm-tpu supervise``: run the
-    dcfm-tpu subcommand ``child_argv`` under :func:`supervise_command`,
-    print the JSON report (or the typed failure) to stderr, and return
-    the process exit code (0 success, 3 poisoned/exhausted)."""
+    dcfm-tpu subcommand ``child_argv`` under :func:`supervise_command`
+    - or, with ``pod=N > 1``, N copies of it under
+    :func:`supervise_pod`, one per process, rendezvousing through the
+    JAX distributed runtime via the ``DCFM_COORDINATOR`` /
+    ``DCFM_NUM_PROCESSES`` / ``DCFM_PROCESS_ID`` environment variables
+    the CLI already honors (parallel/multihost.initialize_from_env);
+    each attempt uses the fresh coordinator port ``port_base +
+    attempt``.  Prints the JSON report (or the typed failure) to
+    stderr; returns the process exit code (0 success, 3
+    poisoned/exhausted/hung)."""
+    argv = [sys.executable, "-m", "dcfm_tpu.cli"] + list(child_argv)
     try:
-        report = supervise_command(
-            [sys.executable, "-m", "dcfm_tpu.cli"] + list(child_argv),
-            checkpoint_path=checkpoint, max_retries=max_retries,
-            backoff_base=backoff_base, backoff_max=backoff_max,
-            poison_deaths=poison_deaths)
-    except (PoisonedRunError, RetriesExhaustedError) as e:
+        if pod > 1:
+            def spawn(attempt: int) -> list:
+                procs = []
+                for i in range(pod):
+                    env = dict(os.environ)
+                    env["DCFM_COORDINATOR"] = (
+                        f"127.0.0.1:{port_base + attempt}")
+                    env["DCFM_NUM_PROCESSES"] = str(pod)
+                    env["DCFM_PROCESS_ID"] = str(i)
+                    env["DCFM_FAULT_PROCESS"] = str(i)
+                    env["DCFM_FAULT_LAUNCH"] = str(attempt)
+                    procs.append(subprocess.Popen(argv, env=env))
+                return procs
+
+            report = supervise_pod(
+                spawn, checkpoint_path=checkpoint, num_processes=pod,
+                max_retries=max_retries, backoff_base=backoff_base,
+                backoff_max=backoff_max, poison_deaths=poison_deaths,
+                launch_timeout=launch_timeout)
+        else:
+            report = supervise_command(
+                argv, checkpoint_path=checkpoint, max_retries=max_retries,
+                backoff_base=backoff_base, backoff_max=backoff_max,
+                poison_deaths=poison_deaths,
+                launch_timeout=launch_timeout)
+    except (PoisonedRunError, RetriesExhaustedError, PodHangError) as e:
         print(json.dumps({
             "error": type(e).__name__, "message": str(e),
             "checkpoint": getattr(e, "checkpoint_path", None),
@@ -382,6 +789,23 @@ def supervise_cli(argv: list) -> int:
                    help="consecutive same-iteration no-progress deaths "
                         "that count as a poisoned run (raise on heavily-"
                         "preempted fleets with long save cadences)")
+    p.add_argument("--pod", type=int, default=0, metavar="N",
+                   help="run N coordinated processes of the child "
+                        "command (one per host of a pod, rendezvousing "
+                        "through the JAX distributed runtime); any "
+                        "process death stops and relaunches the whole "
+                        "pod from the newest unanimously-held clean "
+                        "checkpoint generation")
+    p.add_argument("--watchdog", type=float, default=0.0, metavar="S",
+                   help="deadlock watchdog: if no process finishes or "
+                        "dies within S seconds of the launch (or of "
+                        "the last clean process exit), kill the pod "
+                        "and abort with a typed PodHangError "
+                        "(0 = disabled)")
+    p.add_argument("--port-base", type=int, default=29900,
+                   help="pod mode: coordinator port for attempt k is "
+                        "port-base + k (a fresh port per relaunch never "
+                        "races the dead coordinator's socket)")
     p.add_argument("command", nargs=argparse.REMAINDER,
                    help="the dcfm-tpu command to supervise (a leading "
                         "'--' separator is accepted)")
@@ -407,4 +831,6 @@ def supervise_cli(argv: list) -> int:
     return run_supervised_cli(
         cmd, checkpoint=ck, max_retries=args.max_retries,
         backoff_base=args.backoff, backoff_max=args.backoff_max,
-        poison_deaths=args.poison_deaths)
+        poison_deaths=args.poison_deaths,
+        launch_timeout=args.watchdog or None,
+        pod=args.pod, port_base=args.port_base)

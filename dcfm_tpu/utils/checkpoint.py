@@ -258,12 +258,20 @@ def retained_checkpoints(path: str) -> list:
     """Existing fallback chain for ``path``, newest first: the live file
     (if present) followed by every ``.bakK`` the keep_last rotation has
     produced.  The supervisor walks this list when the newest file fails
-    its CRC."""
+    its CRC.
+
+    The walk TOLERATES HOLES (a directory listing, not sequential
+    probing): the supervisor's corruption demotion renames a ``.bakK``
+    out of the chain, and stopping at the first missing K would hide
+    every older generation from all later scans - exactly the fallback
+    a second failure then needs."""
     out = [path] if os.path.exists(path) else []
-    k = 1
-    while os.path.exists(retained_path(path, k)):
-        out.append(retained_path(path, k))
-        k += 1
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    if os.path.isdir(d):
+        pat = re.compile(re.escape(os.path.basename(path)) + r"\.bak(\d+)$")
+        ks = sorted(int(m.group(1)) for f in os.listdir(d)
+                    for m in [pat.match(f)] if m)
+        out.extend(retained_path(path, k) for k in ks)
     return out
 
 
@@ -360,6 +368,29 @@ def verify_checkpoint(path: str) -> dict:
                 _verify_crc(meta, name, z[name], path)
     meta["crc_verified"] = bool(meta.get("leaf_crc"))
     return meta
+
+
+def scan_generations(path: str) -> list:
+    """Integrity-scan one checkpoint slot's retention chain (the live
+    file plus every ``.bakK``), newest first, as ``(path, iteration,
+    error)`` triples - ``error`` is None for a CRC-clean generation and
+    the verification failure otherwise (``iteration`` is then -1).
+
+    This is the ONE shared walk under both supervision modes: the
+    single-host supervisor promotes the newest clean generation per
+    slot, while the pod supervisor intersects the clean iterations
+    across all ``.procK-of-N`` slots and promotes the newest
+    *unanimously-held* generation (a generation only some hosts still
+    hold cannot be resumed - the collective resume gate would refuse
+    the mixed state on every host)."""
+    out = []
+    for p in retained_checkpoints(path):
+        try:
+            meta = verify_checkpoint(p)
+            out.append((p, int(meta["iteration"]), None))
+        except Exception as e:  # CRC mismatch, torn npz, old format, ...
+            out.append((p, -1, e))
+    return out
 
 
 def save_checkpoint(
@@ -826,9 +857,10 @@ def load_checkpoint_multiprocess(path: str, carry_like: Any,
             if sh is not None:
                 arr = jax.make_array_from_callback(
                     tuple(np.shape(tpl)), sh,
-                    lambda idx, _a=np.asarray(arr): _a[idx])
+                    lambda idx, _a=np.asarray(arr): _a[idx])  # dcfm: ignore[DCFM701] - arr is a host leaf from the reshard assembly
             out.append(arr)
-        return jax.tree.unflatten(treedef, out), meta
+        # _copy_tree while `host` is alive - see the fast-path comment
+        return _copy_tree(jax.tree.unflatten(treedef, out)), meta
 
     target = proc_path(path, jax.process_index(), jax.process_count())
     with np.load(target) as z:
@@ -893,7 +925,20 @@ def load_checkpoint_multiprocess(path: str, carry_like: Any,
                     if sh is not None else zfull)
             if fill:
                 carry = carry._replace(**fill)
-        return carry, meta
+        # Commit the callback-built global arrays into XLA-OWNED buffers
+        # BEFORE the host sources (`blocks`, `zfull`, the npz pages) go
+        # out of scope: on the CPU backend, array ingestion can zero-copy
+        # ALIAS a suitably-aligned host numpy buffer WITHOUT keeping it
+        # alive - the same use-after-free class as the PR-1 single-
+        # process resume crash (api._owned_copy_jit), reproduced here as
+        # an INTERMITTENT NaN/garbage Sigma on multi-host supervised
+        # resumes (caught by the crash-point fuzz harness, maxdiff=nan
+        # roughly 1 run in 4).  The jitted copy allocates fresh buffers
+        # while the sources are provably still referenced; output
+        # shardings follow the inputs, so the SPMD layout is unchanged.
+        # Costs one transient extra carry - same class as the snapshot
+        # transient documented on AsyncCheckpointWriter.
+        return _copy_tree(carry), meta
 
 
 @jax.jit

@@ -33,7 +33,11 @@ python -m dcfm_tpu.analysis dcfm_tpu/resilience/ || exit 1
 # The chaos lane ALSO runs crash-isolated: its tests SIGKILL real child
 # processes and inject torn/corrupt writes on purpose; a runaway child
 # must fail one file with its signal named, not take down the suite.
-echo "== serve + chaos tests (crash-isolated lane) =="
+# test_resilience.py includes the seeded crash-fuzz SMOKE (8 randomized
+# crash points through the real supervised CLI, fixed seed - the fuzz
+# harness itself is exercised on every CI run); the full >= 50-point
+# 2-process pod sweep is slow-marked in test_multihost.py.
+echo "== serve + chaos tests incl. crash-fuzz smoke (crash-isolated lane) =="
 for f in tests/test_serve_artifact.py tests/test_serve_engine.py \
          tests/test_serve_server.py tests/test_resilience.py; do
     JAX_PLATFORMS=cpu python -m dcfm_tpu.analysis.isolate "$f" \

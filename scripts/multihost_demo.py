@@ -13,6 +13,14 @@ result.
 
 Run:  python scripts/multihost_demo.py            (~1-2 min, CPU only)
 Child mode (internal): invoked with --child <pid> by the parent.
+
+Further modes: --ck (elastic crash recovery), --ext (chain extension),
+--light (light checkpoints + .full sidecar preference), --resh
+(topology-flexible resume both directions), --supervise (coordinated
+pod supervision: a real SIGKILL of one host under `dcfm-tpu supervise
+--pod 2`, bit-identical recovery), --esig (sidecar unanimity refuses
+acc_start disagreement on per-host disks), --fuzz SEED N0 N1
+(randomized crash-point fuzz of the supervised pod, DCFM_FAULT_FUZZ).
 """
 
 import json
@@ -194,27 +202,9 @@ def child_light(process_id: int) -> None:
     ref = api.fit(Y, FitConfig(model=model, run=run,
                                backend=BackendConfig(mesh_devices=0)))
 
-    # Synchronous writer so the kill lands at a deterministic boundary.
-    # Deliberately NOT tests/test_checkpoint._SyncWriter: that one
-    # jax.device_get()s the carry (fine for single-device carries), but
-    # save_checkpoint_multiprocess must receive the LIVE global arrays -
-    # it reads their addressable_shards.
-    class SyncWriter:
-        last_save_seconds = None
-
-        def submit(self, save_fn, path, carry, c, **kw):
-            save_fn(path, carry, c, **kw)
-
-        def poll_error(self):
-            return None
-
-        def busy(self):
-            return False
-
-        def wait(self):
-            pass
-
-    api.AsyncCheckpointWriter = SyncWriter
+    # Synchronous writer so the kill lands at a deterministic boundary
+    # (_SupSyncWriter; shared with the esig children).
+    api.AsyncCheckpointWriter = _SupSyncWriter
     # light@2, FULL@4 (sidecar), light@6, then the simulated kill
     restore = _crash_after_nth_save("save_checkpoint_multiprocess", nth=3)
     try:
@@ -237,6 +227,43 @@ def child_light(process_id: int) -> None:
         "resumed_vs_uninterrupted_maxdiff": diff,
         "ran_tail": res.iters_per_sec > 0,
     }), flush=True)
+
+
+def child_sup(process_id: int) -> None:
+    """Supervised-pod child (one 'host' of the 2-process pod): a LIGHT-
+    checkpointing fit with the .full sidecar, elastic resume, retention
+    - the config whose resume path has the most machinery for the
+    crash-point fuzz to break.  The pod supervisor (parent_fuzz /
+    resilience.supervise_pod) relaunches the whole pod through whatever
+    DCFM_FAULT_FUZZ / DCFM_FAULT_PLAN injects; each process writes its
+    own Sigma so the parent can assert NO cross-host skew."""
+    os.environ["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={DEVS_PER_PROC}")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from dcfm_tpu.parallel import multihost
+    port = int(os.environ["MULTIHOST_DEMO_PORT"])
+    multihost.initialize(f"127.0.0.1:{port}", NPROC, process_id)
+
+    import numpy as np
+    import dcfm_tpu.api as api
+    from dcfm_tpu import BackendConfig, FitConfig, ModelConfig, RunConfig
+    rng = np.random.default_rng(SEED)
+    p = G * P_SHARD
+    Y = rng.standard_normal((N, p)).astype(np.float32)
+    model = ModelConfig(num_shards=G, factors_per_shard=K, rho=0.9)
+    # boundaries at 2,4,6,8; light@2, FULL@4 (sidecar), light@6, full@8
+    run = RunConfig(burnin=4, mcmc=4, thin=1, seed=SEED, chunk_size=2)
+    ckpath = os.path.join(os.environ["MULTIHOST_DEMO_DIR"], "sup.ck")
+    cfg = FitConfig(model=model, run=run,
+                    backend=BackendConfig(mesh_devices=0),
+                    checkpoint_path=ckpath, resume="auto",
+                    checkpoint_mode="light", checkpoint_every_chunks=1,
+                    checkpoint_full_every=2, checkpoint_keep_last=2)
+    res = api.fit(Y, cfg)
+    np.save(os.path.join(os.environ["MULTIHOST_DEMO_DIR"],
+                         f"sigma_sup_{process_id}.npy"), res.Sigma)
+    print("CHILD_SUP " + json.dumps({"pid": process_id}), flush=True)
 
 
 def _crash_after_nth_save(attr: str, nth: int = 1):
@@ -545,6 +572,378 @@ def parent_light() -> int:
     return 0 if ok else 1
 
 
+def _write_sup_data(tmp):
+    import numpy as np
+    rng = np.random.default_rng(SEED)
+    Y = rng.standard_normal((N, G * P_SHARD)).astype(np.float32)
+    path = os.path.join(tmp, "Y.npy")
+    np.save(path, Y)
+    return path
+
+
+def parent_supervised() -> int:
+    """Acceptance demo for coordinated multi-host supervision: a REAL
+    SIGKILL of one host mid-run under ``dcfm-tpu supervise --pod 2``,
+    and the supervised pod's Sigma must be BIT-IDENTICAL to the same
+    pod run uninterrupted (full checkpoint mode: every resume preserves
+    every accumulated draw)."""
+    import numpy as np
+    t0 = time.perf_counter()
+    env = _child_env()
+
+    def run_pod(tmp, out, port_base, plan):
+        e = dict(env)
+        e["MULTIHOST_DEMO_DIR"] = tmp
+        # CPU multi-process collectives (Gloo) engage only when the cpu
+        # platform is selected EXPLICITLY (the in-script children do the
+        # same via jax.config); on a real pod this variable is absent
+        # and the TPU backend's ICI/DCN collectives take over
+        e["JAX_PLATFORMS"] = "cpu"
+        e.pop("DCFM_FAULT_PLAN", None)
+        if plan is not None:
+            e["DCFM_FAULT_PLAN"] = json.dumps(plan)
+        ck = os.path.join(tmp, "chain.ck")
+        data = _write_sup_data(tmp)
+        return subprocess.run(
+            [sys.executable, "-m", "dcfm_tpu.cli", "supervise",
+             "--pod", str(NPROC), "--port-base", str(port_base),
+             "--watchdog", "420", "--backoff", "0.05", "--",
+             "fit", data, "--shards", str(G), "--factors", str(G * K),
+             "--burnin", "4", "--mcmc", "2", "--thin", "1",
+             "--chunk-size", "2", "--checkpoint", ck,
+             "--checkpoint-every", "1", "--keep-last", "2",
+             "--out", out],
+            env=e, cwd=_REPO, capture_output=True, text=True, timeout=900)
+
+    with tempfile.TemporaryDirectory() as tmp:
+        ref = os.path.join(tmp, "ref.npy")
+        proc = run_pod(tmp, ref, PORT + 40, None)
+        if proc.returncode != 0:
+            print("uninterrupted pod run failed\n" + proc.stdout[-1500:]
+                  + proc.stderr[-1500:], file=sys.stderr)
+            return 1
+        ref_sigma = np.load(ref)
+        rep0 = json.loads(proc.stderr.strip().splitlines()[-1])
+
+    with tempfile.TemporaryDirectory() as tmp:
+        out = os.path.join(tmp, "sup.npy")
+        # kill host 0 with a real SIGKILL right after the boundary-4
+        # save; host 1 is left blocked in the next collective - the
+        # coordinated stop must reap it, and the relaunch must resume
+        # from the unanimously-held generation
+        plan = {"faults": [{"op": "kill", "at_iteration": 4,
+                            "when": "post_save", "process": 0}]}
+        proc = run_pod(tmp, out, PORT + 48, plan)
+        if proc.returncode != 0:
+            print("supervised pod run failed\n" + proc.stdout[-1500:]
+                  + proc.stderr[-1500:], file=sys.stderr)
+            return 1
+        report = json.loads(proc.stderr.strip().splitlines()[-1])
+        sup_sigma = np.load(out)
+
+    killed = report["deaths"] and report["deaths"][0][0] == -9
+    bit_identical = bool(np.array_equal(ref_sigma, sup_sigma))
+    if not bit_identical:
+        print(f"maxdiff {np.abs(ref_sigma - sup_sigma).max()}",
+              file=sys.stderr)
+    ok = (rep0["launches"] == 1 and report["launches"] == 2
+          and killed and bit_identical)
+    print(json.dumps({
+        "demo": "coordinated pod supervision: SIGKILL one host mid-run, "
+                "2 procs",
+        "seconds": round(time.perf_counter() - t0, 1),
+        "launches": report["launches"],
+        "first_death_exit": report["deaths"][0][0] if report["deaths"]
+        else None,
+        "sigma_bit_identical": bit_identical,
+        "ok": ok,
+    }))
+    return 0 if ok else 1
+
+
+def parent_fuzz(seed: int, n0: int, n1: int) -> int:
+    """Randomized crash-point fuzz of the supervised pod: for each index
+    in [n0, n1) expand the seeded crash point (resilience.faults.
+    fuzz_spec via DCFM_FAULT_FUZZ) and run the 2-process light+sidecar
+    demo under supervise_pod.  Every outcome must be a clean resume
+    (both hosts' Sigma finite and BITWISE EQUAL - no silent skew; bit-
+    identical to the fault-free reference whenever no draw-losing light
+    fallback occurred) or a clean typed refusal (PoisonedRunError /
+    RetriesExhaustedError).  A deadlock is bounded by the watchdog and
+    is a FAILURE (PodHangError), as is divergence or skew."""
+    import numpy as np
+    from dcfm_tpu.resilience.supervisor import (
+        PodHangError, PoisonedRunError, RetriesExhaustedError,
+        supervise_pod)
+    t0 = time.perf_counter()
+    base_env = _child_env()
+    watchdog = float(os.environ.get("MULTIHOST_FUZZ_WATCHDOG", "420"))
+
+    def run_point(tag, fault_env, port_base):
+        """-> ("ok", sigmas) | ("refused", error name) | ("fail", why)"""
+        with tempfile.TemporaryDirectory() as tmp:
+            env = dict(base_env)
+            env["MULTIHOST_DEMO_DIR"] = tmp
+            env.pop("DCFM_FAULT_PLAN", None)
+            env.pop("DCFM_FAULT_FUZZ", None)
+            env.update(fault_env)
+            logdir = os.path.join(tmp, "logs")
+            os.makedirs(logdir, exist_ok=True)
+
+            def spawn(attempt):
+                procs = []
+                for i in range(NPROC):
+                    e = dict(env)
+                    e["MULTIHOST_DEMO_PORT"] = str(port_base + attempt)
+                    e["DCFM_FAULT_PROCESS"] = str(i)
+                    e["DCFM_FAULT_LAUNCH"] = str(attempt)
+                    logf = open(os.path.join(
+                        logdir, f"{tag}_a{attempt}_p{i}.log"), "w")
+                    procs.append(subprocess.Popen(
+                        [sys.executable, os.path.abspath(__file__),
+                         "--child-sup", str(i)],
+                        env=e, cwd=_REPO, stdout=logf,
+                        stderr=subprocess.STDOUT))
+                    logf.close()
+                return procs
+
+            ck = os.path.join(tmp, "sup.ck")
+            try:
+                supervise_pod(
+                    spawn, checkpoint_path=ck, num_processes=NPROC,
+                    max_retries=4, poison_deaths=3, backoff_base=0.05,
+                    launch_timeout=watchdog, grace=5.0,
+                    log=lambda m: None)
+            except (PoisonedRunError, RetriesExhaustedError) as e:
+                return "refused", type(e).__name__
+            except PodHangError as e:
+                return "fail", f"DEADLOCK (watchdog): {e}"
+            sigmas = []
+            for i in range(NPROC):
+                f = os.path.join(tmp, f"sigma_sup_{i}.npy")
+                if not os.path.exists(f):
+                    return "fail", f"process {i} exited 0 without Sigma"
+                sigmas.append(np.load(f))
+            return "ok", sigmas
+
+    # fault-free reference: also pins the happy path of supervise_pod
+    status, ref = run_point("ref", {}, PORT + 1000)
+    if status != "ok" or not np.array_equal(ref[0], ref[1]):
+        print(f"fuzz reference run failed: {status}", file=sys.stderr)
+        return 1
+    outcomes: dict = {}
+    failures = []
+    for idx in range(n0, n1):
+        port_base = PORT + 1100 + (idx % 400) * 8
+        status, detail = run_point(
+            f"pt{idx}", {"DCFM_FAULT_FUZZ": f"{seed}:{idx}"}, port_base)
+        if status == "fail":
+            failures.append((idx, detail))
+            outcome = "FAIL"
+        elif status == "refused":
+            outcome = f"refused:{detail}"
+        else:
+            s0, s1 = detail
+            if not (np.isfinite(s0).all() and np.isfinite(s1).all()):
+                failures.append((idx, "non-finite Sigma"))
+                outcome = "FAIL"
+            elif not np.array_equal(s0, s1):
+                failures.append((idx, "cross-host Sigma skew "
+                                 f"(max {np.abs(s0 - s1).max()})"))
+                outcome = "FAIL"
+            elif np.array_equal(s0, ref[0]):
+                outcome = "clean:bit_identical"
+            else:
+                # a draw-losing light fallback (documented): consistent
+                # across hosts, finite, re-windowed accumulators
+                outcome = "clean:rewindowed"
+        outcomes[outcome] = outcomes.get(outcome, 0) + 1
+        print(f"FUZZ_POINT {json.dumps({'index': idx, 'outcome': outcome})}",
+              flush=True)
+    ok = not failures
+    print(json.dumps({
+        "demo": "randomized crash-point fuzz of the supervised pod",
+        "seed": seed, "points": n1 - n0,
+        "outcomes": outcomes,
+        "failures": failures,
+        "seconds": round(time.perf_counter() - t0, 1),
+        "ok": ok,
+    }))
+    return 0 if ok else 1
+
+
+def _esig_ckpath(process_id: int) -> str:
+    """PER-HOST checkpoint directories: each process sees only its OWN
+    files, so resume takes the local-set fallback (_local_set_source)
+    and every host reads its OWN sidecar meta for the eligibility
+    signature - the per-host-local-disk regime where a mismatched
+    acc_start is only caught by the signature's 4th element (on a
+    shared filesystem every host reads process 0's meta and the
+    mismatch never reaches the gate)."""
+    d = os.path.join(os.environ["MULTIHOST_DEMO_DIR"], f"host{process_id}")
+    os.makedirs(d, exist_ok=True)
+    return os.path.join(d, "esig.ck")
+
+
+def child_esig(process_id: int) -> None:
+    """Phase 1 of the e_sig regression (--esig): the child_light crash
+    scenario - light@2, FULL@4 (sidecar), light@6, then a simulated
+    crash - leaving per-host sidecar files for the parent to tamper."""
+    os.environ["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={DEVS_PER_PROC}")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from dcfm_tpu.parallel import multihost
+    multihost.initialize(f"127.0.0.1:{PORT}", NPROC, process_id)
+
+    import numpy as np
+    import dcfm_tpu.api as api
+    from dcfm_tpu import BackendConfig, FitConfig, ModelConfig, RunConfig
+    rng = np.random.default_rng(SEED)
+    Y = rng.standard_normal((N, G * P_SHARD)).astype(np.float32)
+    model = ModelConfig(num_shards=G, factors_per_shard=K, rho=0.9)
+    run = RunConfig(burnin=4, mcmc=4, thin=1, seed=SEED, chunk_size=2)
+    cfg = FitConfig(model=model, run=run,
+                    backend=BackendConfig(mesh_devices=0),
+                    checkpoint_path=_esig_ckpath(process_id),
+                    checkpoint_mode="light",
+                    checkpoint_every_chunks=1, checkpoint_full_every=2)
+    api.AsyncCheckpointWriter = _SupSyncWriter
+    ref = api.fit(Y, FitConfig(model=model, run=run,
+                               backend=BackendConfig(mesh_devices=0)))
+    np.save(os.path.join(os.environ["MULTIHOST_DEMO_DIR"],
+                         f"esig_ref_{process_id}.npy"), ref.Sigma)
+    restore = _crash_after_nth_save("save_checkpoint_multiprocess", nth=3)
+    try:
+        api.fit(Y, cfg)
+        raise SystemExit("simulated crash did not fire")
+    except RuntimeError:
+        pass
+    restore()
+    print("CHILD_ESIG " + json.dumps({"pid": process_id}), flush=True)
+
+
+def child_esig_resume(process_id: int) -> None:
+    """Phase 2 of --esig: resume after the parent tampered ONE host's
+    sidecar ``acc_start``.  The unanimity gate must REFUSE the
+    mismatched sidecar pair (its 4-element signature differs in
+    acc_start alone) and fall back to the agreed light resume on every
+    host - consistent Sigma, never per-host divisors."""
+    os.environ["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={DEVS_PER_PROC}")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from dcfm_tpu.parallel import multihost
+    multihost.initialize(f"127.0.0.1:{PORT + 2}", NPROC, process_id)
+
+    import numpy as np
+    import dcfm_tpu.api as api
+    from dcfm_tpu import BackendConfig, FitConfig, ModelConfig, RunConfig
+    rng = np.random.default_rng(SEED)
+    Y = rng.standard_normal((N, G * P_SHARD)).astype(np.float32)
+    model = ModelConfig(num_shards=G, factors_per_shard=K, rho=0.9)
+    run = RunConfig(burnin=4, mcmc=4, thin=1, seed=SEED, chunk_size=2)
+    cfg = FitConfig(model=model, run=run,
+                    backend=BackendConfig(mesh_devices=0),
+                    checkpoint_path=_esig_ckpath(process_id),
+                    resume="auto",
+                    checkpoint_mode="light", checkpoint_every_chunks=1,
+                    checkpoint_full_every=2)
+    res = api.fit(Y, cfg)
+    np.save(os.path.join(os.environ["MULTIHOST_DEMO_DIR"],
+                         f"esig_sigma_{process_id}.npy"), res.Sigma)
+    print("CHILD_ESIGR " + json.dumps({"pid": process_id}), flush=True)
+
+
+class _SupSyncWriter:
+    """Synchronous stand-in for AsyncCheckpointWriter (child_light and
+    the esig children): simulated kills/crashes must land at
+    deterministic saves.  Deliberately NOT tests/test_checkpoint.
+    _SyncWriter: that one jax.device_get()s the carry (fine for
+    single-device carries), but save_checkpoint_multiprocess must
+    receive the LIVE global arrays - it reads their
+    addressable_shards."""
+
+    last_save_seconds = None
+
+    def submit(self, save_fn, path, carry, c, **kw):
+        save_fn(path, carry, c, **kw)
+
+    def poll_error(self):
+        return None
+
+    def busy(self):
+        return False
+
+    def wait(self):
+        pass
+
+
+def _tamper_acc_start(path: str, new_acc_start: int) -> None:
+    """Rewrite one checkpoint file's meta acc_start in place (payload
+    bytes preserved exactly - the per-leaf CRCs still verify), faking
+    the mixed-stale-sidecar state ADVICE r5 describes."""
+    import numpy as np
+    with np.load(path) as z:
+        meta = json.loads(bytes(z["__meta__"]).decode())
+        payload = {k: z[k] for k in z.files if k != "__meta__"}
+    meta["acc_start"] = int(new_acc_start)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        np.savez(f, __meta__=np.frombuffer(
+            json.dumps(meta).encode(), dtype=np.uint8), **payload)
+    os.replace(tmp, path)
+
+
+def parent_esig() -> int:
+    """Regression for the sidecar unanimity signature carrying
+    acc_start (ADVICE r5): after tampering host 1's sidecar acc_start,
+    the resumed pod must REFUSE the sidecar pair - both hosts fall back
+    to the light resume, so their Sigmas are bitwise EQUAL to each
+    other but (draws re-windowed) NOT equal to the uninterrupted
+    reference.  Pre-fix, each host committed its own sidecar and
+    returned a DIFFERENT Sigma with no error."""
+    import numpy as np
+    t0 = time.perf_counter()
+    env = _child_env()
+    with tempfile.TemporaryDirectory() as tmp:
+        env["MULTIHOST_DEMO_DIR"] = tmp
+        env["MULTIHOST_DEMO_PORT"] = str(PORT)
+        if _spawn_children("--child-esig", "CHILD_ESIG", env) is None:
+            return 1
+        side1 = os.path.join(tmp, "host1",
+                             f"esig.ck.full.proc1-of-{NPROC}")
+        if not os.path.exists(side1):
+            print("sidecar set missing", file=sys.stderr)
+            return 1
+        # Host 1's sidecar claims a later accumulation-window start that
+        # still preserves MORE draws than the light restart window (so
+        # it stays ELIGIBLE): same iteration/kind/count as host 0's -
+        # only the signature's 4th element can refuse the pair.
+        # acc_start=5 keeps 3 of 4 saved draws (> the light window's 2)
+        # but a different n_saved divisor than host 0's acc_start=0;
+        # committing the pair would return skewed Sigmas silently.
+        _tamper_acc_start(side1, 5)
+        results = _spawn_children("--child-esig-resume", "CHILD_ESIGR", env)
+        if results is None:
+            return 1
+        ref = np.load(os.path.join(tmp, "esig_ref_0.npy"))
+        sig = [np.load(os.path.join(tmp, f"esig_sigma_{i}.npy"))
+               for i in range(NPROC)]
+    consistent = bool(np.array_equal(sig[0], sig[1]))
+    refused_sidecar = not np.array_equal(sig[0], ref)
+    ok = consistent and refused_sidecar
+    print(json.dumps({
+        "demo": "sidecar unanimity signature refuses acc_start "
+                "disagreement, 2 procs",
+        "seconds": round(time.perf_counter() - t0, 1),
+        "cross_host_consistent": consistent,
+        "mismatched_sidecar_refused": refused_sidecar,
+        "ok": ok,
+    }))
+    return 0 if ok else 1
+
+
 def parent() -> int:
     t0 = time.perf_counter()
     env = _child_env()
@@ -615,6 +1014,12 @@ if __name__ == "__main__":
         child_resh(int(sys.argv[2]))
     elif len(sys.argv) > 2 and sys.argv[1] == "--child-resh-resume":
         child_resh_resume(int(sys.argv[2]))
+    elif len(sys.argv) > 2 and sys.argv[1] == "--child-sup":
+        child_sup(int(sys.argv[2]))
+    elif len(sys.argv) > 2 and sys.argv[1] == "--child-esig":
+        child_esig(int(sys.argv[2]))
+    elif len(sys.argv) > 2 and sys.argv[1] == "--child-esig-resume":
+        child_esig_resume(int(sys.argv[2]))
     elif len(sys.argv) > 2 and sys.argv[1] == "--resh-single":
         os.environ["XLA_FLAGS"] = ("--xla_force_host_platform_device_count="
                                    f"{NPROC * DEVS_PER_PROC}")
@@ -629,5 +1034,13 @@ if __name__ == "__main__":
         sys.exit(parent_ext())
     elif len(sys.argv) > 1 and sys.argv[1] == "--resh":
         sys.exit(parent_resh())
+    elif len(sys.argv) > 1 and sys.argv[1] == "--supervise":
+        sys.exit(parent_supervised())
+    elif len(sys.argv) > 1 and sys.argv[1] == "--esig":
+        sys.exit(parent_esig())
+    elif len(sys.argv) > 1 and sys.argv[1] == "--fuzz":
+        # --fuzz SEED N0 N1: run fuzz points [N0, N1)
+        sys.exit(parent_fuzz(int(sys.argv[2]), int(sys.argv[3]),
+                             int(sys.argv[4])))
     else:
         sys.exit(parent())

@@ -1,0 +1,22 @@
+# Known-bad fixture for DCFM701 (multihost-unguarded-host-fetch):
+# multi-host-aware functions that materialize arrays on host with no
+# addressability guard - the device-snapshot-OOM-fallback bug class
+# (ADVICE r5): jax.device_get of a non-fully-addressable global array
+# raises in exactly the pod regime the code targets.
+import numpy as np
+
+import jax
+from jax.experimental import multihost_utils
+
+
+def unguarded_device_get(carry):
+    if jax.process_count() > 1:
+        snap = jax.device_get(carry)          # DCFM701
+        return snap
+    return carry
+
+
+def unguarded_asarray_after_gather(arr):
+    sig = multihost_utils.process_allgather(np.asarray([1], np.int64))
+    host = np.asarray(arr)                    # DCFM701
+    return sig, host

@@ -1,0 +1,32 @@
+# Known-good twin of bad_multihost.py: the sanctioned shapes stay
+# silent.
+import numpy as np
+
+import jax
+from jax.experimental import multihost_utils
+
+
+def guarded_device_get(carry):
+    # referencing the addressability question IS the guard the rule
+    # wants: the function demonstrably chose a path per locality
+    if jax.process_count() > 1:
+        for leaf in jax.tree.leaves(carry):
+            if isinstance(leaf, jax.Array) and leaf.is_fully_addressable:
+                leaf.copy_to_host_async()
+        return [np.asarray(s.data)
+                for leaf in jax.tree.leaves(carry)
+                for s in leaf.addressable_shards]
+    return jax.device_get(carry)
+
+
+def collective_payloads_are_fine(my_iter):
+    # np.asarray of a LIST literal builds the collective payload - not
+    # a host materialization of a possibly-sharded array
+    sig = np.asarray([my_iter, 1], np.int64)
+    return multihost_utils.process_allgather(sig)
+
+
+def single_host_function_unmarked(carry):
+    # no process-topology call in sight: device_get on a variable is
+    # ordinary single-host code, outside the rule's scope
+    return jax.device_get(carry)

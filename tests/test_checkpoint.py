@@ -12,6 +12,8 @@ from typing import NamedTuple
 import numpy as np
 import pytest
 
+import jax
+
 from tests.conftest import make_synthetic
 
 from dcfm_tpu import BackendConfig, FitConfig, ModelConfig, RunConfig, fit
@@ -1046,3 +1048,118 @@ def test_corrupt_latest_resumes_from_retained_inprocess(
     res = fit(data, dataclasses.replace(cfg, resume=True))
     assert res.iters_per_sec > 0                # re-ran 24..32
     np.testing.assert_array_equal(res.sigma_blocks, res_full.sigma_blocks)
+
+
+class _FakeShard:
+    def __init__(self, data):
+        self.data = data
+
+
+class _FakeGlobalArray:
+    """Mimics a multi-host global jax.Array whose shards live on several
+    processes: NOT fully addressable (jax.device_get of it raises on a
+    real pod), with a local addressable_shards view.  Registered as a
+    virtual jax.Array subclass so isinstance checks treat it as one."""
+
+    is_fully_addressable = False
+    is_fully_replicated = False
+
+    def __init__(self, arr):
+        self._arr = np.asarray(arr)
+        self.shape = self._arr.shape
+        self.dtype = self._arr.dtype
+
+    @property
+    def addressable_shards(self):
+        half = self._arr.shape[0] // 2
+        return [_FakeShard(self._arr[:half])]
+
+
+jax.Array.register(_FakeGlobalArray)
+
+
+def test_snapshot_oom_fallback_never_device_gets_multihost_carry(
+        tmp_path, monkeypatch):
+    """ADVICE r5 regression: when the on-device snapshot fails to
+    allocate near HBM capacity, the fallback on a MULTI-HOST carry must
+    hand the LIVE arrays to the per-process save_fn synchronously -
+    never jax.device_get the carry, which raises on non-fully-
+    addressable global arrays in exactly the pod regime the docstring
+    cites."""
+    from dcfm_tpu.utils import checkpoint as ck_mod
+
+    def failing_snapshot(carry):
+        raise RuntimeError("RESOURCE_EXHAUSTED: out of memory allocating "
+                           "snapshot copy")
+
+    monkeypatch.setattr(ck_mod, "device_snapshot", failing_snapshot)
+
+    def forbidden_device_get(x):
+        raise AssertionError(
+            "jax.device_get on a non-fully-addressable multi-host carry "
+            "- the crash this fallback exists to avoid (ADVICE r5)")
+
+    monkeypatch.setattr(ck_mod.jax, "device_get", forbidden_device_get)
+
+    leaf = _FakeGlobalArray(np.arange(16.0))
+    carry = _CarryLike(a=leaf, b=np.ones(3), iteration=np.int32(4))
+    seen = {}
+
+    def save_fn(path, c, cfg, *, fingerprint, **kw):
+        seen["live"] = c.a is leaf       # the live carry, not a copy
+        seen["shards"] = [np.asarray(s.data)
+                          for s in c.a.addressable_shards]
+
+    writer = ck_mod.AsyncCheckpointWriter()
+    writer.submit(save_fn, str(tmp_path / "mh.npz"), carry, None,
+                  fingerprint="f")
+    # the multi-host fallback is synchronous: done before submit returns
+    assert seen["live"]
+    np.testing.assert_array_equal(seen["shards"][0], np.arange(8.0))
+    assert writer.last_save_seconds is not None
+    writer.wait()                        # no background thread pending
+
+
+def test_snapshot_oom_fallback_fully_addressable_uses_host_fetch(
+        tmp_path, monkeypatch):
+    """The cheaper single-host fallback is preserved: a fully
+    addressable carry takes one synchronous host fetch and the write
+    still happens in the background."""
+    import jax.numpy as jnp
+
+    from dcfm_tpu.utils import checkpoint as ck_mod
+
+    monkeypatch.setattr(
+        ck_mod, "device_snapshot",
+        lambda c: (_ for _ in ()).throw(RuntimeError("RESOURCE_EXHAUSTED")))
+    carry = _CarryLike(a=jnp.arange(4.0), b=np.ones(2),
+                       iteration=np.int32(1))
+    seen = {}
+
+    def save_fn(path, c, cfg, *, fingerprint, **kw):
+        # the background thread receives the HOST snapshot, not device
+        # arrays: device_get already ran synchronously in submit
+        seen["host"] = all(isinstance(leaf, np.ndarray) or np.isscalar(leaf)
+                           for leaf in jax.tree.leaves(c))
+
+    writer = ck_mod.AsyncCheckpointWriter()
+    writer.submit(save_fn, str(tmp_path / "sh.npz"), carry, None,
+                  fingerprint="f")
+    writer.wait()
+    assert seen["host"]
+
+
+def test_retained_checkpoints_tolerates_holes(tmp_path):
+    """The retention walk must not stop at a missing .bakK: the
+    supervisor's corruption demotion renames one out of the chain, and
+    a sequential probe would hide every older generation from all later
+    scans (the fallback a second failure needs)."""
+    from dcfm_tpu.utils.checkpoint import retained_checkpoints, retained_path
+
+    p = str(tmp_path / "ck.npz")
+    for f in (p, retained_path(p, 2), retained_path(p, 3)):
+        with open(f, "wb") as fh:
+            fh.write(b"x")
+    # .bak1 missing (demoted): 2 and 3 must still be walked, in order
+    assert retained_checkpoints(p) == [
+        p, retained_path(p, 2), retained_path(p, 3)]

@@ -70,6 +70,17 @@ def test_bad_robust_fires_601_602():
     assert _rules_fired("bad_robust.py") == {"DCFM601", "DCFM602"}
 
 
+def test_bad_multihost_fires_701():
+    assert _rules_fired("bad_multihost.py") == {"DCFM701"}
+
+
+def test_bad_multihost_flags_both_fetch_shapes():
+    findings = lint_file(os.path.join(FIXTURES, "bad_multihost.py"))
+    msgs = [f.message for f in findings if f.rule == "DCFM701"]
+    assert any("device_get" in m for m in msgs)
+    assert any("asarray" in m for m in msgs)
+
+
 def test_bad_robust_flags_every_swallow_shape():
     findings = lint_file(os.path.join(FIXTURES, "bad_robust.py"))
     lines = {f.line for f in findings if f.rule == "DCFM601"}
@@ -105,7 +116,8 @@ def test_every_rule_family_has_a_firing_fixture():
 
 @pytest.mark.parametrize("name", [
     "good_rng.py", "good_jit.py", "good_dtype.py", "good_ffi.py",
-    "good_thread.py", "good_server.py", "good_robust.py"])
+    "good_thread.py", "good_server.py", "good_robust.py",
+    "good_multihost.py"])
 def test_good_fixture_is_clean(name):
     findings = lint_file(os.path.join(FIXTURES, name))
     assert findings == [], [str(f) for f in findings]

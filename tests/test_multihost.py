@@ -113,6 +113,70 @@ def test_multihost_topology_flexible_resume():
     assert '"ok": true' in proc.stdout
 
 
+def _demo_env(port):
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [_REPO] + [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+                   if p])
+    env["MULTIHOST_DEMO_PORT"] = str(port)
+    return env
+
+
+@pytest.mark.slow
+def test_multihost_supervised_sigkill_bit_exact():
+    # THE pod acceptance criterion: `dcfm-tpu supervise --pod 2` runs
+    # the SPMD fit across 2 processes; a fault plan lands a REAL SIGKILL
+    # on host 0 right after the boundary-4 save, host 1 (blocked in the
+    # next collective) is reaped by the coordinated stop, and the
+    # relaunched pod resumes from the unanimously-held generation to a
+    # Sigma BIT-IDENTICAL to the uninterrupted pod run.
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "scripts", "multihost_demo.py"),
+         "--supervise"],
+        env=_demo_env(29885), cwd=_REPO, capture_output=True, text=True,
+        timeout=1200)
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    assert '"sigma_bit_identical": true' in proc.stdout
+    assert '"ok": true' in proc.stdout
+
+
+@pytest.mark.slow
+def test_multihost_sidecar_acc_start_unanimity():
+    # ADVICE r5 regression (2-process half; the signature unit test is
+    # in test_resilience.py): after one host's sidecar acc_start is
+    # tampered, the 4-element unanimity signature must REFUSE the pair -
+    # both hosts fall back to the light resume (Sigmas equal to each
+    # other, not to the sidecar-resumed reference).  Pre-fix, each host
+    # committed its own sidecar and returned a different Sigma silently.
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "scripts", "multihost_demo.py"),
+         "--esig"],
+        env=_demo_env(29891), cwd=_REPO, capture_output=True, text=True,
+        timeout=900)
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    assert '"cross_host_consistent": true' in proc.stdout
+    assert '"mismatched_sidecar_refused": true' in proc.stdout
+
+
+@pytest.mark.slow
+def test_multihost_crash_fuzz_sweep_50_points():
+    # The acceptance sweep: >= 50 seeded randomized crash points
+    # (DCFM_FAULT_FUZZ) through the supervised 2-process pod - kills
+    # around the light-save and sidecar writes, kills INSIDE the
+    # collective gate windows, torn/corrupt/failing writes.  Every
+    # outcome must be a clean resume (no cross-host Sigma skew, no
+    # divergence) or a clean typed refusal; deadlocks are bounded by
+    # the watchdog and fail the point.
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "scripts", "multihost_demo.py"),
+         "--fuzz", "20260804", "0", "50"],
+        env=_demo_env(29901), cwd=_REPO, capture_output=True, text=True,
+        timeout=5400)
+    assert proc.returncode == 0, proc.stdout[-3000:] + proc.stderr[-2000:]
+    assert '"ok": true' in proc.stdout
+
+
 def test_initialize_from_env_noop_without_vars():
     # in-process check of the no-op contract (no coordinator set)
     env_backup = {k: os.environ.pop(k, None)

@@ -135,6 +135,87 @@ def test_kill_fires_only_for_runs_that_started_below_trigger():
     assert plan._boundary_due("kill", "post_save", 24, 0) is None
 
 
+def test_kill_event_validation_and_gates(monkeypatch):
+    """kill_event needs an event name; the process and launch gates keep
+    a shared pod plan from firing in the wrong process or launch (the
+    calls below would SIGKILL the test process if the gates leaked)."""
+    with pytest.raises(FaultPlanError, match="event"):
+        FaultPlan({"faults": [{"op": "kill_event"}]})
+    plan = FaultPlan({"faults": [
+        {"op": "kill_event", "event": "sidecar_gate", "process": 1,
+         "at_launch": 2}]})
+    # no DCFM_FAULT_PROCESS at all: a process-gated fault never fires
+    monkeypatch.delenv(faults.PROCESS_ENV_VAR, raising=False)
+    monkeypatch.setenv(faults.LAUNCH_ENV_VAR, "2")
+    plan.maybe_kill_event("sidecar_gate")
+    # right process, wrong launch
+    monkeypatch.setenv(faults.PROCESS_ENV_VAR, "1")
+    monkeypatch.setenv(faults.LAUNCH_ENV_VAR, "1")
+    plan.maybe_kill_event("sidecar_gate")
+    # right process and launch but a different event / occurrence
+    monkeypatch.setenv(faults.LAUNCH_ENV_VAR, "2")
+    plan.maybe_kill_event("resume_gate")
+    # boundary kills honor the same gates
+    bplan = FaultPlan({"faults": [
+        {"op": "kill", "at_iteration": 8, "process": 0}]})
+    assert bplan._boundary_due("kill", "post_save", 8, 0) is None
+    monkeypatch.setenv(faults.PROCESS_ENV_VAR, "0")
+    assert bplan._boundary_due("kill", "post_save", 8, 0) is not None
+
+
+def test_write_faults_honor_launch_gate(tmp_path, monkeypatch):
+    """An at_launch-gated io_error fires in launch 1 and is silent in
+    launch 2 - the shape the fuzz scheduler leans on so relaunches can
+    finish clean."""
+    ck = str(tmp_path / "gate.npz")
+    carry = _carry()
+    monkeypatch.setenv(faults.LAUNCH_ENV_VAR, "1")
+    faults.install({"faults": [
+        {"op": "io_error", "target": "checkpoint", "at_write": 1,
+         "at_launch": 1}]})
+    with pytest.raises(OSError, match="injected"):
+        save_checkpoint(ck, carry, _cfg(), fingerprint="f")
+    monkeypatch.setenv(faults.LAUNCH_ENV_VAR, "2")
+    faults.install({"faults": [
+        {"op": "io_error", "target": "checkpoint", "at_write": 1,
+         "at_launch": 1}]})
+    save_checkpoint(ck, carry, _cfg(), fingerprint="f")
+    assert verify_checkpoint(ck)["crc_verified"]
+
+
+def test_fuzz_spec_deterministic_and_valid():
+    """Same (seed, index) -> same plan, every plan validates, and the
+    stream covers all four crash-point shapes within a modest sweep."""
+    kinds = set()
+    for i in range(64):
+        spec = faults.fuzz_spec(20260804, i)
+        assert spec == faults.fuzz_spec(20260804, i)
+        FaultPlan(spec)                    # validates
+        ops = tuple(sorted(f["op"] for f in spec["faults"]))
+        kinds.add(ops)
+    flat = {op for ops in kinds for op in ops}
+    assert {"kill", "kill_event", "io_error"} <= flat
+    assert flat & {"torn_write", "bit_flip"}
+    # a different seed reshuffles the stream
+    assert any(faults.fuzz_spec(1, i) != faults.fuzz_spec(2, i)
+               for i in range(8))
+
+
+def test_fuzz_env_var_parses_seed_and_index(monkeypatch):
+    faults.clear()
+    monkeypatch.delenv(faults.ENV_VAR, raising=False)
+    monkeypatch.setenv(faults.FUZZ_ENV_VAR, "77:3")
+    plan = faults.fault_plan()
+    assert plan is not None
+    assert [f["op"] for f in plan.faults] == [
+        f["op"] for f in faults.fuzz_spec(77, 3)["faults"]]
+    faults.clear()
+    monkeypatch.setenv(faults.FUZZ_ENV_VAR, "not-a-spec")
+    with pytest.raises(FaultPlanError, match="seed:index"):
+        faults.fault_plan()
+    faults.clear()
+
+
 def test_io_error_and_delay_faults(tmp_path, data):
     """io_error surfaces as OSError from the save; io_delay stalls it."""
     ck = str(tmp_path / "io.npz")
@@ -373,6 +454,352 @@ def test_supervise_api_returns_full_fitresult(tmp_path, data):
     np.testing.assert_array_equal(res.Sigma, res_ref.Sigma)
 
 
+def test_esig_includes_acc_start():
+    """ADVICE r5 regression (unit half; the 2-process half is the --esig
+    multihost demo): two sidecar eligibility results agreeing on
+    iteration/kind/writer-count but starting their accumulation windows
+    at different iterations must produce DIFFERENT unanimity
+    signatures, so the collective gate refuses the pair instead of
+    letting each host divide by its own n_saved."""
+    from dcfm_tpu.api import _sidecar_esig
+
+    src = ("set", (2, ["a.proc0-of-2", "a.proc1-of-2"], 4))
+    e0 = _sidecar_esig((src, 4, 0))
+    e1 = _sidecar_esig((src, 4, 2))
+    assert e0.shape == (4,) and e0[3] == 0 and e1[3] == 2
+    assert not np.array_equal(e0, e1)        # the gate refuses the pair
+    assert np.array_equal(e0, _sidecar_esig((src, 4, 0)))
+    assert (_sidecar_esig(None) == -1).all()
+
+
+# ---------------------------------------------------------------------------
+# pod supervision (coordinated stop, unanimity pre-pass, watchdog)
+# ---------------------------------------------------------------------------
+
+def test_supervise_pod_coordinated_stop_and_poison(tmp_path):
+    """When one 'host' dies, its sibling - parked like a process blocked
+    in a collective - must be REAPED promptly (coordinated stop), and
+    two consecutive no-progress pod deaths must abort with the typed
+    poison error, not crash-loop."""
+    from dcfm_tpu.resilience.supervisor import (
+        PoisonedRunError, supervise_pod)
+
+    def spawn(attempt):
+        return [
+            subprocess.Popen([sys.executable, "-c",
+                              "import sys; sys.exit(7)"]),
+            subprocess.Popen([sys.executable, "-c",
+                              "import time; time.sleep(120)"]),
+        ]
+
+    t0 = time.perf_counter()
+    with pytest.raises(PoisonedRunError):
+        supervise_pod(spawn, checkpoint_path=str(tmp_path / "pod.ck"),
+                      num_processes=2, backoff_base=0.01,
+                      poison_deaths=2, grace=2.0, log=lambda m: None)
+    # 2 launches, each reaped within ~grace - nowhere near the sleeps a
+    # hung wait-for-everyone would cost
+    assert time.perf_counter() - t0 < 40
+
+
+def test_supervise_pod_watchdog_raises_typed_hang(tmp_path):
+    """A launch where nothing dies and nothing finishes is a deadlock:
+    the watchdog must kill the pod and raise the typed error instead of
+    waiting forever (the bound the fuzz harness relies on)."""
+    from dcfm_tpu.resilience.supervisor import PodHangError, supervise_pod
+
+    def spawn(attempt):
+        return [subprocess.Popen([sys.executable, "-c",
+                                  "import time; time.sleep(120)"])
+                for _ in range(2)]
+
+    t0 = time.perf_counter()
+    with pytest.raises(PodHangError, match="watchdog"):
+        supervise_pod(spawn, checkpoint_path=str(tmp_path / "pod.ck"),
+                      num_processes=2, launch_timeout=1.5, grace=1.0,
+                      log=lambda m: None)
+    assert time.perf_counter() - t0 < 30
+
+
+def _save_iter(slot, iteration, keep_last=2):
+    c = _CarryLike(a=np.arange(64.0), b=np.ones((8, 8)),
+                   iteration=np.int32(iteration))
+    save_checkpoint(slot, c, _cfg(), fingerprint="f", keep_last=keep_last)
+
+
+def test_unanimous_pre_pass_promotes_common_generation(tmp_path):
+    """A kill between two processes' saves leaves the newest generation
+    on only one host.  The pod pre-pass must promote the newest
+    generation held by BOTH (here 16), discarding host 0's lone 24 -
+    per-slot newest-clean promotion would hand the children a mixed
+    state the collective gate refuses forever."""
+    from dcfm_tpu.resilience.supervisor import (
+        SuperviseReport, _ensure_unanimous_checkpoint)
+    from dcfm_tpu.utils.checkpoint import proc_path
+
+    base = str(tmp_path / "pod.ck")
+    s0, s1 = proc_path(base, 0, 2), proc_path(base, 1, 2)
+    _save_iter(s0, 16)
+    _save_iter(s0, 24)            # slot0: live 24, bak1 16
+    _save_iter(s1, 16)            # slot1: the 24 save never landed
+    rep = SuperviseReport()
+    it = _ensure_unanimous_checkpoint(base, 2, rep, lambda m: None)
+    assert it == 16
+    assert read_checkpoint_meta(s0)["iteration"] == 16
+    assert read_checkpoint_meta(s1)["iteration"] == 16
+
+
+def test_unanimous_pre_pass_demotes_corrupt_then_promotes(tmp_path):
+    """CRC corruption on ONE host's newest file demotes that generation
+    there, which breaks its unanimity - both hosts land on the previous
+    generation."""
+    from dcfm_tpu.resilience.supervisor import (
+        SuperviseReport, _ensure_unanimous_checkpoint)
+    from dcfm_tpu.utils.checkpoint import proc_path
+
+    base = str(tmp_path / "pod.ck")
+    s0, s1 = proc_path(base, 0, 2), proc_path(base, 1, 2)
+    for s in (s0, s1):
+        _save_iter(s, 16)
+        _save_iter(s, 24)
+    with open(s1, "r+b") as f:       # silent media corruption on host 1
+        f.seek(os.path.getsize(s1) // 2)
+        f.write(b"\xff" * 8)
+    rep = SuperviseReport()
+    it = _ensure_unanimous_checkpoint(base, 2, rep, lambda m: None)
+    assert it == 16
+    assert rep.corrupt_fallbacks == 1
+    assert os.path.exists(s1 + ".corrupt")
+    assert read_checkpoint_meta(s0)["iteration"] == 16
+    assert read_checkpoint_meta(s1)["iteration"] == 16
+
+
+def test_pod_progress_sees_through_mixed_live_files(tmp_path):
+    """Death accounting must not read -1 from the MIXED live state a
+    between-saves kill routinely leaves: two such deaths in a row would
+    satisfy the poison check's same-iteration rule (-1 == -1) and abort
+    a pod that makes real progress between crashes.  _pod_progress
+    intersects the retention CHAINS, so the unanimously-held generation
+    (what the next launch actually resumes) is the measure."""
+    from dcfm_tpu.resilience.supervisor import _pod_progress
+    from dcfm_tpu.utils.checkpoint import proc_path
+
+    base = str(tmp_path / "pod.ck")
+    s0, s1 = proc_path(base, 0, 2), proc_path(base, 1, 2)
+    _save_iter(s0, 16)
+    _save_iter(s0, 24)            # slot0 live 24, bak1 16
+    _save_iter(s1, 16)            # slot1 live 16: mixed live set
+    assert _pod_progress(base, 2) == 16
+    # nothing at all -> genuinely no progress
+    assert _pod_progress(str(tmp_path / "none.ck"), 2) == -1
+
+
+class _FakeProc:
+    """poll()-compatible stand-in: exits 0 once ``done_after`` seconds
+    have passed since construction."""
+
+    def __init__(self, done_after):
+        self._t0 = time.perf_counter()
+        self._done_after = done_after
+
+    def poll(self):
+        return 0 if time.perf_counter() - self._t0 >= self._done_after \
+            else None
+
+    def terminate(self):
+        self._done_after = 0.0
+
+    def kill(self):
+        self._done_after = 0.0
+
+    def wait(self):
+        return 0
+
+
+def test_await_pod_watchdog_resets_on_checkpoint_progress():
+    """A healthy launch LONGER than the watchdog must not be reaped as
+    a hang while its checkpoint iteration keeps advancing: the probe's
+    advances reset the deadline, so the watchdog only needs to exceed
+    one boundary-to-boundary interval, not the whole run."""
+    from dcfm_tpu.resilience.supervisor import _await_pod
+
+    t0 = time.perf_counter()
+
+    def progress():
+        # "checkpoint" advances every ~0.4s, like boundary saves
+        return int((time.perf_counter() - t0) / 0.4)
+
+    rc = _await_pod([_FakeProc(2.5)], launch_timeout=1.2, grace=0.1,
+                    log=lambda m: None, progress_fn=progress)
+    assert rc == 0
+
+
+def test_watchdog_probe_counts_single_slot_advance(tmp_path):
+    """The liveness score must MOVE when one slow host's own file
+    advances, even while a finished peer's file is parked at a higher
+    iteration: _progress_iteration reads that mixed live set as -1, and
+    a max would sit at the parked value - either way a healthy re-run
+    window longer than the watchdog would be reaped as a 'hang'."""
+    from dcfm_tpu.resilience.supervisor import (
+        _progress_iteration, _watchdog_progress)
+    from dcfm_tpu.utils.checkpoint import proc_path
+
+    base = str(tmp_path / "pod.ck")
+    _save_iter(proc_path(base, 0, 2), 40)   # finished host, parked
+    _save_iter(proc_path(base, 1, 2), 20)   # slow host, still advancing
+    assert _progress_iteration(base) == -1  # no agreeing set
+    s0 = _watchdog_progress(base, 2)
+    _save_iter(proc_path(base, 1, 2), 24)   # the advance the probe needs
+    s1 = _watchdog_progress(base, 2)
+    assert s1 > s0                          # the deadline resets
+    assert _watchdog_progress(str(tmp_path / "none.ck"), 2) == -1
+
+
+def test_unanimity_pre_pass_demotes_stale_other_count_sets(tmp_path):
+    """A corrupt ``.procK-of-M`` file from an EARLIER process count must
+    be demoted by the pod pre-pass exactly as the single-host pass
+    would: discovery's most-progress rule can select the stale set for
+    a topology-flexible resume, and leaving the corrupt member in place
+    would make that resume fail on every relaunch."""
+    from dcfm_tpu.resilience.supervisor import (
+        SuperviseReport, _ensure_unanimous_checkpoint)
+    from dcfm_tpu.utils.checkpoint import proc_path
+
+    base = str(tmp_path / "pod.ck")
+    # current topology: 2 processes at iteration 8
+    for i in range(2):
+        _save_iter(proc_path(base, i, 2), 8)
+    # stale, more-progressed 3-process set with one corrupt member
+    for i in range(3):
+        _save_iter(proc_path(base, i, 3), 24)
+    stale = proc_path(base, 1, 3)
+    with open(stale, "r+b") as f:
+        f.seek(os.path.getsize(stale) // 2)
+        f.write(b"\xff" * 8)
+    rep = SuperviseReport()
+    _ensure_unanimous_checkpoint(base, 2, rep, lambda m: None)
+    assert rep.corrupt_fallbacks == 1
+    assert os.path.exists(stale + ".corrupt")
+    assert read_checkpoint_meta(proc_path(base, 0, 2))["iteration"] == 8
+
+
+def test_promotion_keeps_retention_chain_gapless(tmp_path):
+    """Promoting a .bakK generation into the live slot must keep it at
+    its .bakK position (hardlink, not os.replace): after a promotion, a
+    SECOND failure that corrupts the promoted live file must still find
+    the promoted generation (and everything older) in the chain - and
+    the cross-slot unanimity intersection must still see it at its
+    retained position - instead of orphaning a resumable pod to a
+    fresh start."""
+    from dcfm_tpu.resilience.supervisor import (
+        SuperviseReport, _ensure_unanimous_checkpoint)
+    from dcfm_tpu.utils.checkpoint import proc_path
+
+    base = str(tmp_path / "pod.ck")
+    s0, s1 = proc_path(base, 0, 2), proc_path(base, 1, 2)
+    for it in (8, 16, 24):
+        _save_iter(s0, it, keep_last=3)   # live 24, bak1 16, bak2 8
+    for it in (8, 16):
+        _save_iter(s1, it, keep_last=3)   # live 16, bak1 8
+    rep = SuperviseReport()
+    assert _ensure_unanimous_checkpoint(base, 2, rep, lambda m: None) == 16
+    # the promotion left the chain gapless: bak1 still holds gen 16
+    assert os.path.exists(s0 + ".bak1")
+    # Second failure: host 0's gen-16 bytes rot (in-place corruption -
+    # live and .bak1 share the inode, exactly like the keep_last
+    # rotation's hardlinks, so BOTH copies of 16 die).  Pre-fix the
+    # bak1 HOLE hid gen 8 behind it and the pod was orphaned to a
+    # fresh start; with the gapless chain it falls back to 8.
+    with open(s0, "r+b") as f:
+        f.seek(os.path.getsize(s0) // 2)
+        f.write(b"\xff" * 8)
+    rep2 = SuperviseReport()
+    it = _ensure_unanimous_checkpoint(base, 2, rep2, lambda m: None)
+    assert it == 8                        # recovered, not orphaned
+    assert not os.path.exists(s0 + ".orphan")
+    assert read_checkpoint_meta(s0)["iteration"] == 8
+    assert read_checkpoint_meta(s1)["iteration"] == 8
+
+
+def test_await_pod_watchdog_resets_on_clean_exit():
+    """A process exiting 0 is progress: the watchdog deadline must reset
+    so a slower sibling legitimately re-running a lost window is not
+    reaped as a 'hang' - the deadline bounds time since the last
+    observable event (launch or a clean exit), not the whole launch.
+    Here the sibling needs 2.2s against a 1.5s watchdog; only the reset
+    at the fast process's 1.0s exit lets the launch succeed."""
+    from dcfm_tpu.resilience.supervisor import _await_pod
+
+    rc = _await_pod([_FakeProc(1.0), _FakeProc(2.2)],
+                    launch_timeout=1.5, grace=0.1, log=lambda m: None)
+    assert rc == 0
+
+
+def test_unanimous_pre_pass_orphans_disjoint_state(tmp_path):
+    """No generation held by all hosts: the live files are set aside so
+    every host's discovery starts FRESH deterministically (a mixed live
+    set would make a strict resume refuse on every relaunch)."""
+    from dcfm_tpu.resilience.supervisor import (
+        SuperviseReport, _ensure_unanimous_checkpoint)
+    from dcfm_tpu.utils.checkpoint import proc_path
+
+    base = str(tmp_path / "pod.ck")
+    s0, s1 = proc_path(base, 0, 2), proc_path(base, 1, 2)
+    _save_iter(s0, 24, keep_last=1)
+    _save_iter(s1, 16, keep_last=1)
+    rep = SuperviseReport()
+    it = _ensure_unanimous_checkpoint(base, 2, rep, lambda m: None)
+    assert it == -1
+    assert not os.path.exists(s0) and not os.path.exists(s1)
+    assert os.path.exists(s0 + ".orphan") and os.path.exists(s1 + ".orphan")
+
+
+def test_crash_fuzz_smoke_single_process(tmp_path, data_file):
+    """CI smoke of the randomized crash-point harness (the full >= 50
+    point 2-process sweep is slow-marked in test_multihost.py): 8
+    seeded fuzz points through the REAL supervised CLI - kills pre/post
+    save, torn/bit-flipped/failing checkpoint writes.  Every outcome
+    must be a clean BIT-EXACT resume or a clean typed refusal; any
+    other exit is a harness failure."""
+    seed = 20260804
+    ref = str(tmp_path / "ref.npy")
+    proc = _cli_fit(data_file, ref, [], _child_env())
+    assert proc.returncode == 0, proc.stderr
+    ref_sigma = np.load(ref)
+
+    outcomes = []
+    for i in range(8):
+        spec = faults.fuzz_spec(seed, i, boundaries=(8, 16, 24, 32),
+                                max_writes=4, nproc=1, events=())
+        out = str(tmp_path / f"fz{i}.npy")
+        ck = str(tmp_path / f"fz{i}.ck.npz")
+        env = _child_env(spec)
+        env["DCFM_FAULT_PROCESS"] = "0"
+        proc = _cli_fit(
+            data_file, out,
+            ["--checkpoint", ck, "--checkpoint-every", "1",
+             "--keep-last", "2", "--supervise",
+             "--supervise-backoff", "0.05",
+             "--supervise-max-retries", "4",
+             "--supervise-poison-deaths", "3",
+             "--supervise-watchdog", "420"],
+            env)
+        if proc.returncode == 0:
+            np.testing.assert_array_equal(
+                np.load(out), ref_sigma,
+                err_msg=f"fuzz point {i}: resumed Sigma diverged")
+            outcomes.append("clean")
+        elif proc.returncode == 3:
+            err = json.loads(proc.stderr.strip().splitlines()[-1])
+            assert err["error"] in ("PoisonedRunError",
+                                    "RetriesExhaustedError"), (i, err)
+            outcomes.append(err["error"])
+        else:
+            pytest.fail(f"fuzz point {i}: unclean exit "
+                        f"{proc.returncode}\n{proc.stderr[-2000:]}")
+    assert "clean" in outcomes       # the sweep exercises real resumes
+
+
 def test_supervise_requires_checkpoint(data):
     from dcfm_tpu.resilience import supervise
 
@@ -395,3 +822,39 @@ def test_supervise_report_attached_to_fitresult(tmp_path, data):
     assert rep.final_iteration == 32
     # a plain fit has none
     assert fit(data, _cfg()).supervise_report is None
+
+
+def test_demotion_hole_does_not_hide_older_generations(tmp_path):
+    """Demoting a corrupt MIDDLE .bakK must not hide the generations
+    behind it: after .bak1 is demoted and the live file later rots too,
+    the pre-pass must still find the clean .bak2 generation instead of
+    orphaning a resumable pod to a fresh start."""
+    from dcfm_tpu.resilience.supervisor import (
+        SuperviseReport, _ensure_unanimous_checkpoint)
+    from dcfm_tpu.utils.checkpoint import proc_path
+
+    base = str(tmp_path / "pod.ck")
+    s0, s1 = proc_path(base, 0, 2), proc_path(base, 1, 2)
+    for s in (s0, s1):
+        for it in (8, 16, 24):
+            _save_iter(s, it, keep_last=3)   # live 24, bak1 16, bak2 8
+
+    def _rot(p):
+        with open(p, "r+b") as f:
+            f.seek(os.path.getsize(p) // 2)
+            f.write(b"\xff" * 8)
+
+    _rot(s0 + ".bak1")                       # middle generation rots
+    rep = SuperviseReport()
+    assert _ensure_unanimous_checkpoint(base, 2, rep, lambda m: None) == 24
+    assert os.path.exists(s0 + ".bak1.corrupt")   # demoted: chain has a hole
+    # second failure: host 0's live file rots as well (bak1@16 on host 0
+    # is gone, so 16 is not unanimous; 8 must still be reachable PAST
+    # the .bak1 hole)
+    _rot(s0)
+    rep2 = SuperviseReport()
+    it = _ensure_unanimous_checkpoint(base, 2, rep2, lambda m: None)
+    assert it == 8
+    assert not os.path.exists(s0 + ".orphan")
+    assert read_checkpoint_meta(s0)["iteration"] == 8
+    assert read_checkpoint_meta(s1)["iteration"] == 8
