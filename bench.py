@@ -154,19 +154,25 @@ def main():
                                   "BENCH_UPLOAD", "float16")),
     )
 
-    # Link-bandwidth probe: the axon tunnel's host<->device bandwidth
-    # fluctuates 2-25 MB/s day to day, and the panel fetch (~49 MB int8 at
-    # the north-star shape) rides it.  Measuring the raw link up front is
-    # what lets a reader of the JSON line attribute a seconds swing to the
-    # tunnel rather than to code (the phase split below does the rest).
+    # Link-bandwidth probe, 3 SAMPLES: the axon tunnel's host<->device
+    # bandwidth fluctuates 2-25 MB/s day to day (the recorded headline
+    # degraded 7.06 -> 1.6 MB/s across rounds with no code change), and
+    # the panel fetch (~49 MB int8 at the north-star shape) rides it.
+    # Recording every sample plus the median is what lets a reader of
+    # the JSON attribute a seconds swing to the tunnel rather than to
+    # code - one probe hitting a congested instant looked exactly like
+    # a regression (the phase split below does the rest).
     probe_mb = 16.0
-    probe = jax.device_put(
-        np.zeros(int(probe_mb * 1e6 // 4), np.float32))
-    jax.block_until_ready(probe)
-    t = time.perf_counter()
-    np.asarray(probe)
-    tunnel_mbps = probe_mb / max(time.perf_counter() - t, 1e-9)
-    del probe
+    tunnel_samples = []
+    for _ in range(3):
+        probe = jax.device_put(
+            np.zeros(int(probe_mb * 1e6 // 4), np.float32))
+        jax.block_until_ready(probe)
+        t = time.perf_counter()
+        np.asarray(probe)
+        tunnel_samples.append(probe_mb / max(time.perf_counter() - t, 1e-9))
+        del probe
+    tunnel_mbps = float(np.median(tunnel_samples))
 
     # Warm-up: one fit with the IDENTICAL config, so every jit signature
     # the timed run will hit - including the first-chunk-call layout
@@ -176,9 +182,27 @@ def main():
     # chain_s, tripping the gate as a false regression.)
     fit(Y, cfg)
 
-    t0 = time.perf_counter()
-    res = fit(Y, cfg)
-    seconds = time.perf_counter() - t0
+    # Headline `seconds` is gated on MEDIAN-of-3 exactly like chain_s
+    # (ADVICE r5: best-of-3 hides bimodal regressions; one contended run
+    # must not decide either way).  All three timed runs happen at the
+    # gated default shape; env-overridden quick runs take one sample.
+    default_shape = (P_TOTAL, G, N, K_TOTAL, ITERS, CHAINS) == (
+        10_000, 64, 500, 512, 1000, 1)
+    # Keep only the FIRST full FitResult alive: each one holds a ~400 MB
+    # Sigma at the gated shape, and retaining three would add ~1 GB of
+    # host RSS right when the medians are being measured - the repeats
+    # contribute only their timing dicts.
+    runs = []
+    res = None
+    for _ in range(3 if default_shape else 1):
+        t0 = time.perf_counter()
+        r = fit(Y, cfg)
+        runs.append((time.perf_counter() - t0, r.phase_seconds))
+        if res is None:
+            res = r
+        del r
+    seconds_samples = [s for s, _ in runs]
+    seconds = float(np.median(seconds_samples))
 
     err = float(np.linalg.norm(res.Sigma - Sigma_true)
                 / np.linalg.norm(Sigma_true))
@@ -194,14 +218,20 @@ def main():
     # tunnel is intermittently TIMESHARED, inflating chain_s several-fold
     # on identical binaries - README "Performance" - which is what the
     # median absorbs from the other side.)
-    default_shape = (P_TOTAL, G, N, K_TOTAL, ITERS, CHAINS) == (
-        10_000, 64, 500, 512, 1000, 1)
     chain_budget_s = 2.5
-    chain_samples = [res.phase_seconds["chain_s"]]
-    if default_shape:
-        for _ in range(2):
-            chain_samples.append(fit(Y, cfg).phase_seconds["chain_s"])
+    chain_samples = [ph["chain_s"] for _, ph in runs]
     chain_s_med = float(np.median(chain_samples))
+
+    # Streamed-fetch overlap accounting (FitResult.stream_stats /
+    # phase_seconds["exposed_fetch_s"]): fetch_s is the TOTAL drain
+    # wall-clock (most of it hidden behind chain compute under the
+    # streamed fetch), exposed_fetch_s is the part the e2e clock
+    # actually saw - the number the ROADMAP fetch-wall item gates on.
+    # Per-chunk drain samples make a degrading link visible per
+    # boundary, not just in aggregate.
+    exposed_samples = [ph.get("exposed_fetch_s", ph["fetch_s"])
+                       for _, ph in runs]
+    stream = res.stream_stats or {}
 
     # Serve-phase probe: the READ path gets a perf trajectory like the
     # fit path has.  Export the timed run's posterior to a fresh memmap
@@ -247,21 +277,33 @@ def main():
         # regressions should be judged on chain_s (gated below) and
         # assemble_s; fetch_s/upload_s swings track tunnel_MBps.
         "chain_s": round(res.phase_seconds["chain_s"], 2),
-        # every gate sample (timed run first; repeats only taken when the
-        # first sample tripped the budget) - bimodal regressions show up
-        # here even when the median squeaks under
+        # every gate sample (all three timed runs) - bimodal regressions
+        # show up here even when the median squeaks under
         "chain_s_samples": [round(s, 2) for s in chain_samples],
+        "seconds_samples": [round(s, 2) for s in seconds_samples],
         "num_chains": CHAINS,
         # effective samples per second of chain compute, per trace summary
         # (models/sampler.TRACE_SUMMARIES) - the mixing-aware throughput
         "ess_per_sec": ess_per_sec,
         "upload_s": round(res.phase_seconds["upload_s"], 2),
         "fetch_s": round(res.phase_seconds["fetch_s"], 2),
+        # fetch time NOT hidden behind compute (the streamed double
+        # buffer's join wall; == fetch_s for an unstreamed run), median
+        # over the timed runs with every sample recorded
+        "exposed_fetch_s": round(float(np.median(exposed_samples)), 3),
+        "exposed_fetch_s_samples": [round(s, 3) for s in exposed_samples],
+        # per-boundary snapshot drain seconds of the first timed run +
+        # double-buffer telemetry (snapshots dispatched / skipped-busy)
+        "fetch_chunk_s": [round(s, 3)
+                          for s in stream.get("chunk_fetch_s", [])],
+        "stream_snapshots": stream.get("snapshots", 0),
+        "stream_skipped": stream.get("skipped", 0),
         "assemble_s": round(res.phase_seconds["assemble_s"], 2),
         "checkpoint_s": round(res.phase_seconds["checkpoint_s"], 2),
         "preprocess_s": round(res.phase_seconds["preprocess_s"], 2),
         "init_s": round(res.phase_seconds["init_s"], 2),
         "tunnel_MBps": round(tunnel_mbps, 2),
+        "tunnel_MBps_samples": [round(s, 2) for s in tunnel_samples],
         # Serve-phase (read-path) trajectory: entry queries/sec and
         # client-side latency against a freshly exported artifact via
         # the real HTTP server, median of 3 rounds (all samples below).

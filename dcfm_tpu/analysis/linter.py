@@ -104,6 +104,16 @@ class _Module:
         self.lines = source.splitlines()
         base = os.path.basename(path)
         self.is_test = base.startswith("test_") or base == "conftest.py"
+        # Runtime pipeline module (DCFM801 scope): a file living under a
+        # directory named "runtime" (dcfm_tpu/runtime/), or whose stem
+        # is "runtime" / ends in "_runtime" (the lint-fixture naming
+        # convention).  Deliberately NOT a substring match: a module
+        # like runtime_flags.py is ordinary library code and must not
+        # be held to the pipeline's async-fetch discipline.
+        parts = path.replace("\\", "/").split("/")
+        stem = base[:-3] if base.endswith(".py") else base
+        self.is_runtime = ("runtime" in parts[:-1] or stem == "runtime"
+                           or stem.endswith("_runtime"))
         self.ignores = self._collect_ignores()
         self.aliases: dict = {}
         self._collect_aliases()
@@ -1039,6 +1049,69 @@ def _check_multihost(mod: _Module, rep: _Reporter) -> None:
 
 
 # =====================================================================
+# DCFM8xx - runtime pipeline discipline
+# =====================================================================
+
+def _check_pipeline(mod: _Module, rep: _Reporter) -> None:
+    """DCFM801: blocking host fetch in a runtime pipeline module with no
+    preceding ``copy_to_host_async`` in the same function.
+
+    Scope is the runtime package only (``mod.is_runtime`` - path-gated,
+    so api/serve code is untouched), function-granular and nested-def-
+    exclusive like DCFM701, and PRECEDENCE-aware: a fetch on a line at
+    or after the function's first ``copy_to_host_async`` dispatch is the
+    sanctioned drain half of an async pair; one before any dispatch is
+    the serializing sync fetch the rule hunts.  Argument shapes mirror
+    DCFM701 (``jax.device_get`` on Name/Attribute, ``np.asarray`` /
+    ``np.array`` on a bare Name) so jit-output fetches chosen inline and
+    list-literal payloads stay quiet."""
+    if not mod.is_runtime:
+        return
+    for fdef in ast.walk(mod.tree):
+        if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        skip: set = set()
+        for nd in ast.walk(fdef):
+            if nd is not fdef and isinstance(
+                    nd, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for sub in ast.walk(nd):
+                    skip.add(id(sub))
+        own = [n for n in ast.walk(fdef) if id(n) not in skip]
+        async_lines = [
+            n.lineno for n in own
+            if isinstance(n, ast.Call)
+            and isinstance(n.func, ast.Attribute)
+            and n.func.attr == "copy_to_host_async"]
+        first_async = min(async_lines, default=None)
+        for n in own:
+            if not isinstance(n, ast.Call) or not n.args:
+                continue
+            if first_async is not None and n.lineno >= first_async:
+                continue
+            full = mod.resolve(n.func)
+            arg = n.args[0]
+            if full == "jax.device_get" and isinstance(
+                    arg, (ast.Name, ast.Attribute)):
+                rep.emit("DCFM801", n,
+                         "jax.device_get in a runtime pipeline function "
+                         "with no preceding copy_to_host_async - a "
+                         "blocking fetch here serializes the chain "
+                         "behind the device->host link; dispatch the "
+                         "async copy at the chunk boundary and drain "
+                         "off-thread (StreamingFetcher), or annotate "
+                         "the deliberate sync fetch")
+            elif (full in {"numpy.asarray", "numpy.array"}
+                  and isinstance(arg, ast.Name)):
+                rep.emit("DCFM801", n,
+                         f"{_last(full)} on '{arg.id}' in a runtime "
+                         "pipeline function with no preceding "
+                         "copy_to_host_async - a blocking fetch here "
+                         "serializes the chain behind the device->host "
+                         "link; dispatch the async copy first, or "
+                         "annotate the deliberate sync fetch")
+
+
+# =====================================================================
 # driver
 # =====================================================================
 
@@ -1058,6 +1131,7 @@ def lint_source(source: str, path: str = "<string>") -> list:
     _check_servers(mod, rep)
     _check_robustness(mod, rep)
     _check_multihost(mod, rep)
+    _check_pipeline(mod, rep)
     rep.findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return rep.findings
 

@@ -120,4 +120,19 @@ RULES = {r.id: r for r in [
          "per-leaf addressable shards, or guard on "
          "leaf.is_fully_addressable",
          library_only=True),
+    # ---- DCFM8xx: runtime pipeline discipline ------------------------
+    Rule("DCFM801", "pipeline-blocking-host-fetch", "pipeline",
+         "blocking host fetch (jax.device_get on an array variable, or "
+         "np.asarray/np.array on a name) inside a function of a runtime "
+         "pipeline module (any module under - or named - 'runtime', i.e. "
+         "dcfm_tpu/runtime/) with no PRECEDING copy_to_host_async "
+         "dispatch in the same function.  The chunk pipeline's contract "
+         "is async-first: dispatch the device->host copy at the chunk "
+         "boundary and drain off-thread "
+         "(runtime/pipeline.StreamingFetcher), so a synchronous fetch "
+         "silently serializes the chain behind the link.  Deliberate "
+         "sync fetches (KB-sized trace rows, the drain half of an "
+         "already-dispatched async) must carry an inline "
+         "`# dcfm: ignore[DCFM801] - <why>`",
+         library_only=True),
 ]}

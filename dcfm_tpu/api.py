@@ -16,6 +16,11 @@ Execution layouts:
   (backend "auto" single-device, or mesh_devices == 0).
 * g shards over an N-device mesh: ``shard_map`` with psum/all_gather over
   ICI (parallel/shard.py); g/N shards per device via the inner vmap.
+
+The machinery that drives a chain - the chunk loop, the fetch/assemble
+jits, the streamed double-buffered accumulator fetch, and the
+checkpoint-resume gates - lives in the :mod:`dcfm_tpu.runtime` package;
+this module is the thin coordination layer that wires a config to it.
 """
 
 from __future__ import annotations
@@ -36,23 +41,22 @@ from dcfm_tpu.config import (
 from dcfm_tpu.models.priors import make_prior
 from dcfm_tpu.models.sampler import (
     TRACE_SUMMARIES, ChainStats, chain_keys, effective_ranks, init_chain,
-    num_saved_draws, run_chunk, schedule_array)
+    run_chunk, schedule_array)
 from dcfm_tpu.models.state import num_upper_pairs, packed_pair_indices
-from dcfm_tpu.utils.diagnostics import ess, split_rhat
 from dcfm_tpu.parallel.mesh import make_mesh, shards_per_device
 from dcfm_tpu.parallel.multihost import place_sharded_global
 from dcfm_tpu.parallel.shard import build_mesh_chain, place_sharded
-from dcfm_tpu.resilience.faults import fault_event, fault_plan
-from dcfm_tpu.resilience.sentinel import (
-    ChainDivergedError, DivergenceSentinel)
-from dcfm_tpu.utils.checkpoint import (
-    AsyncCheckpointWriter, checkpoint_compatible, data_fingerprint,
-    discover_checkpoint, load_checkpoint, load_checkpoint_multiprocess,
-    load_checkpoint_resharded, proc_path, read_checkpoint_meta,
-    retained_checkpoints, save_checkpoint, save_checkpoint_multiprocess)
+from dcfm_tpu.runtime.fetch import (
+    accumulator_window, assemble_q8_sigma, cast_f32_jit, cast_for_link,
+    fetch_jit, fetch_sd_jit, owned_copy_jit, quant8_drain,
+    quant8_fetch_assemble, quant8_start, replicate_jit, upload_host_array)
+from dcfm_tpu.runtime.pipeline import StreamingFetcher, run_chain
+from dcfm_tpu.runtime.resume import sidecar_esig
+from dcfm_tpu.utils.checkpoint import data_fingerprint
+from dcfm_tpu.utils.diagnostics import ess, split_rhat
 from dcfm_tpu.utils.estimate import (
-    assemble_from_q8, assemble_from_upper, dequantize_panels,
-    draw_covariance_entries, full_blocks_from_upper)
+    assemble_from_upper, dequantize_panels, draw_covariance_entries,
+    full_blocks_from_upper)
 from dcfm_tpu.utils.preprocess import (
     PreprocessResult, caller_to_shard_index, preprocess,
     restore_data_matrix)
@@ -67,6 +71,9 @@ class FitResult:
     serving subsystem (``dcfm_tpu/serve``, ``dcfm-tpu serve``) opens in
     milliseconds and answers entry/block/interval queries over without
     re-assembling the dense matrix - see README "Serving the posterior".
+    With ``FitConfig.stream_artifact`` the fit streams the panels into
+    that artifact as the chain runs, and the export is already done by
+    the time this object exists (:attr:`artifact_path`).
     """
 
     Sigma: np.ndarray              # (p, p) posterior-mean covariance in the
@@ -99,17 +106,23 @@ class FitResult:
     # chunk_seconds[0] includes compilation.
     chunk_seconds: Optional[list] = None
     # Phase-resolved wall-clock: {"preprocess_s", "upload_s", "init_s",
-    # "chain_s", "fetch_s", "assemble_s", "checkpoint_s"}.  On a tunneled
-    # device the fetch
-    # is usually the dominant term and fluctuates with link bandwidth;
-    # separating it from chain_s is what distinguishes a code regression
-    # from link weather.  assemble_s is host CPU wall-clock after the
-    # fetch (the output-row-major native assembler, ~0.3 s at p=10k in
-    # quant8 mode - dequant folded in, so no separate dequant pass).
-    # init_s covers state init or checkpoint load (incl. the init
-    # executable load on a tunneled device).  checkpoint_s is the
-    # chain-visible cost of write-behind saves (snapshot dispatch + joins);
-    # the background fetch/write itself overlaps the next chunk's compute
+    # "chain_s", "fetch_s", "exposed_fetch_s", "assemble_s",
+    # "checkpoint_s"}.  On a tunneled device the fetch is usually the
+    # dominant term and fluctuates with link bandwidth; separating it
+    # from chain_s is what distinguishes a code regression from link
+    # weather.  fetch_s is the TOTAL device->host drain wall-clock
+    # (under the streamed fetch most of it overlaps chain compute);
+    # exposed_fetch_s is the part that did NOT hide behind other work -
+    # the time fit() sat blocked on the link after the chain and the
+    # rest of the epilogue were done.  For the post-hoc (unstreamed)
+    # fetch the two are equal by definition.  assemble_s is host CPU
+    # wall-clock after the fetch (the output-row-major native
+    # assembler, ~0.3 s at p=10k in quant8 mode - dequant folded in, so
+    # no separate dequant pass).  init_s covers state init or
+    # checkpoint load (incl. the init executable load on a tunneled
+    # device).  checkpoint_s is the chain-visible cost of write-behind
+    # saves (snapshot dispatch + joins); the background fetch/write
+    # itself overlaps the next chunk's compute
     # (utils/checkpoint.AsyncCheckpointWriter).
     phase_seconds: Optional[dict] = None
     # (p, p) entrywise posterior standard deviation of the covariance, in
@@ -148,6 +161,17 @@ class FitResult:
     # launches, deaths, corrupt fallbacks) when this result came from
     # resilience.supervise(); None for a direct fit().
     supervise_report: Optional[Any] = None
+    # Streamed-fetch telemetry (runtime/pipeline.StreamingFetcher), or
+    # None when the post-hoc fetch served this run: {"streamed": True,
+    # "snapshots": boundary snapshots dispatched, "skipped": boundaries
+    # skipped because both double-buffer slots were busy,
+    # "exposed_fetch_s": the drain wall-clock NOT hidden behind other
+    # work, "chunk_fetch_s": per-snapshot drain seconds}.
+    stream_stats: Optional[dict] = None
+    # Directory of the serve artifact this fit streamed its panels into
+    # (FitConfig.stream_artifact), already finalized and openable; None
+    # otherwise.  export_artifact() to the same path just opens it.
+    artifact_path: Optional[str] = None
     # Backing storage for the lazy .upper_panels property: exactly one of
     # _upper_f32 (full-precision fetch paths) or the (_q8_panels,
     # _q8_scales) pair (default quant8 fetch) is set.  Keeping the int8
@@ -247,7 +271,16 @@ class FitResult:
         int8 posterior panels (+ SD panels when accumulated), per-panel
         scales, and the preprocess maps, memmap-loadable by
         ``dcfm-tpu serve`` with no refit and no dense Sigma.  Returns
-        the opened :class:`~dcfm_tpu.serve.artifact.PosteriorArtifact`."""
+        the opened :class:`~dcfm_tpu.serve.artifact.PosteriorArtifact`.
+
+        When the fit already streamed its panels into ``path``
+        (``FitConfig.stream_artifact``), the artifact is finalized and
+        on disk - this just opens it (the free fit->export path)."""
+        if (self.artifact_path is not None
+                and os.path.abspath(path)
+                == os.path.abspath(self.artifact_path)):
+            from dcfm_tpu.serve.artifact import PosteriorArtifact
+            return PosteriorArtifact.open(path)
         from dcfm_tpu.serve.artifact import export_fit_result
         return export_fit_result(self, path)
 
@@ -318,186 +351,6 @@ def _mesh_fns(mesh, model: ModelConfig, num_iters: int, num_chains: int = 1,
                             unroll=unroll)
 
 
-def _cast_for_link(u, mode: str):
-    """Down-cast upper panels for the device->host link - the single
-    device-side home for the quantization convention that
-    utils/estimate.dequantize_panels and the native q8 assembler mirror.
-
-    quant8 is max-abs int8 per panel: one float32 scale per P x P block,
-    entry error <= scale/254, ~4e-3 of the panel max - far below Monte
-    Carlo error; accumulation stayed float32 on device."""
-    if mode == "quant8":
-        scale = jnp.max(jnp.abs(u), axis=(1, 2))            # (n_pairs,)
-        safe = jnp.where(scale > 0, scale, 1.0)[:, None, None]
-        q = jnp.round(u * (127.0 / safe)).astype(jnp.int8)
-        return q, scale
-    return u.astype(jnp.dtype(mode))
-
-
-@functools.lru_cache(maxsize=64)
-def _fetch_jit(g: int, num_chains: int, mode: str, mesh=None):
-    """Jitted device-side fetch prep: chain-average, padding trim, and the
-    down-cast/quantization for the link.  The carry already stores the
-    packed upper-triangle panels in canonical triu order
-    (models.state.packed_pair_indices), so the fetch reads them NATIVELY -
-    no on-device re-packing materialization; only the few padding panels
-    past g(g+1)/2 are sliced off.  Cached on (g, chains, mode, mesh) so
-    repeated fit() calls reuse the compilation (a fresh
-    ``jax.jit(lambda ...)`` per call would re-trace every time); single-
-    and multi-process fits therefore compile separately, and the cached
-    entry keeps its Mesh alive.
-
-    ``mesh`` (multi-process runs only): replicate the output over the mesh
-    so every process can materialize it on host - XLA inserts the
-    cross-host all-gather inside the jit.
-
-    ``inv_count`` (traced): 1/saved-draw-count - the accumulators are raw
-    sums over saved draws (models.sampler.ChainCarry), so the posterior
-    mean is formed here, on device, before any down-cast/quantization."""
-    n_pairs = num_upper_pairs(g)
-
-    def prep(acc, inv_count):
-        u = (acc.mean(axis=0) if num_chains > 1 else acc)
-        u = u[:n_pairs] * inv_count
-        return _cast_for_link(u, mode)
-    if mesh is None:
-        return jax.jit(prep)
-    from jax.sharding import NamedSharding, PartitionSpec
-    return jax.jit(prep, out_shardings=NamedSharding(mesh, PartitionSpec()))
-
-
-@functools.lru_cache(maxsize=64)
-def _fetch_sd_jit(g: int, num_chains: int, mode: str, mesh=None):
-    """Jitted device-side posterior-SD fetch prep: the entrywise SD is
-    formed ON DEVICE in float32 from the raw first/second-moment sums
-    (Bessel-corrected over the pooled draw count), and only then
-    down-cast/quantized for the link.  Variance-by-differences cancels
-    catastrophically in reduced precision, so the subtraction must happen
-    at full precision - but an SD VALUE, like a covariance value, rounds
-    benignly; computing it on device is what lets posterior_sd runs use
-    the same quant8/f16 link optimizations as the mean (the old design
-    forced a full-f32 fetch of both moment panels instead, 4x the
-    bytes)."""
-    n_pairs = num_upper_pairs(g)
-
-    def prep(acc, acc_sq, inv_count, bessel):
-        if num_chains > 1:
-            acc, acc_sq = acc.mean(axis=0), acc_sq.mean(axis=0)
-        # the carry is already packed upper panels; trim the padding and
-        # run the variance/sqrt math on g(g+1)/2 panels
-        mean = acc[:n_pairs] * inv_count
-        m2 = acc_sq[:n_pairs] * inv_count
-        sd = jnp.sqrt(jnp.maximum(m2 - mean * mean, 0.0) * bessel)
-        return _cast_for_link(sd, mode)
-    if mesh is None:
-        return jax.jit(prep)
-    from jax.sharding import NamedSharding, PartitionSpec
-    return jax.jit(prep, out_shardings=NamedSharding(mesh, PartitionSpec()))
-
-
-@functools.lru_cache(maxsize=8)
-def _replicate_jit(mesh):
-    """Identity jit that replicates a (sharded) pytree over the mesh -
-    the multi-process path uses it to make small outputs host-fetchable."""
-    from jax.sharding import NamedSharding, PartitionSpec
-    return jax.jit(lambda x: x,
-                   out_shardings=NamedSharding(mesh, PartitionSpec()))
-
-
-@functools.lru_cache(maxsize=4)
-def _cast_f32_jit():
-    return jax.jit(lambda x: x.astype(jnp.float32))
-
-
-@functools.lru_cache(maxsize=4)
-def _owned_copy_jit():
-    """Identity-copy jit: every output leaf is a freshly allocated,
-    XLA-owned buffer.  The safe ingestion seam for host numpy pytrees
-    (checkpoint loads) that will outlive their numpy sources - the CPU
-    backend's zero-copy device_put can alias a numpy buffer WITHOUT
-    keeping it alive, and computing on it after the source is dropped
-    reads freed heap (garbage results / glibc abort).  Re-traces per
-    pytree structure, cached thereafter."""
-    return jax.jit(lambda t: jax.tree.map(jnp.copy, t))
-
-
-def _upload_host_array(data: np.ndarray, upload_dtype: str) -> np.ndarray:
-    """Down-cast the standardized data on the host so fewer bytes cross the
-    host->device link; the device casts back to float32 on arrival."""
-    if upload_dtype == "float32":
-        return data
-    if upload_dtype == "float16":
-        return data.astype(np.float16)
-    import ml_dtypes  # jax dependency, always present
-    return data.astype(ml_dtypes.bfloat16)
-
-
-def _quant8_start(q_dev, scale_dev, n_slices: int = 8):
-    """Issue the pipelined device->host drain of an int8 panel set: the
-    scales' and every slice's ``copy_to_host_async`` are dispatched up
-    front, so the link stays saturated while arrived slices are memcpy'd
-    into place - and so a SECOND panel set (the posterior-SD panels) can
-    queue its transfers behind the first before the first is even
-    drained.  The tiny scales transfer is queued FIRST: the link is FIFO,
-    so anything requested after the panel asyncs would arrive (and block)
-    behind them.  Returns the (slices, scale_dev) pair to hand to
-    :func:`_quant8_fetch_assemble`."""
-    scale_dev.copy_to_host_async()
-    n_pairs = q_dev.shape[0]
-    bounds = np.linspace(0, n_pairs, min(n_slices, n_pairs) + 1).astype(int)
-    slices = [q_dev[a:b] for a, b in zip(bounds[:-1], bounds[1:]) if b > a]
-    for s in slices:
-        s.copy_to_host_async()
-    return slices, scale_dev
-
-
-def _quant8_drain(slices, shape):
-    """Wait out a started drain; returns the assembled int8 host array.
-
-    The device->host transfer is the wall-clock bottleneck of a real fit
-    (the panels are ~p^2/2 entries); assembly of the posterior MEAN is
-    overlapped with the posterior-SD panel drain (both sets' asyncs are
-    issued before either is drained), but not with its own - the
-    output-row-major native assembler needs the full canonical panel set
-    and is fast enough (~0.3 s at p=10k) that slicing it finer buys
-    nothing.  The caller times the drain (it starts the clock before the
-    already-issued scales fetch)."""
-    q_host = np.empty(shape, np.int8)
-    pos = 0
-    for s in slices:
-        qh = np.asarray(s)                           # waits for this slice
-        q_host[pos:pos + qh.shape[0]] = qh
-        pos += qh.shape[0]
-    return q_host
-
-
-def _quant8_fetch_assemble(started, shape, pre: PreprocessResult, phase):
-    """Drain a started quant8 fetch + native one-pass assembly to the
-    final caller-coordinate matrix - the shared path for the posterior-
-    mean and posterior-SD panels.  ``started`` is a :func:`_quant8_start`
-    result.  Returns ``(out, q8_panels, q8_scales, upper)`` with exactly
-    one of the (int8 panels+scales, float32 upper) backings set for the
-    FitResult's lazy panel storage; updates ``phase`` fetch/assemble
-    entries in place."""
-    slices, scale_dev = started
-    t_f = time.perf_counter()
-    scales = np.asarray(scale_dev)      # async already issued; arrives first
-    q8 = _quant8_drain(slices, shape)
-    phase["fetch_s"] += time.perf_counter() - t_f
-    t_as = time.perf_counter()
-    out = assemble_from_q8(q8, scales, pre,
-                           destandardize=True, reinsert_zero_cols=True)
-    upper = None
-    if out is None:
-        # no native library: dequantize once and keep the f32 panels as
-        # the FitResult backing store (they exist anyway)
-        upper = dequantize_panels(q8, scales)
-        q8 = scales = None
-        out = assemble_from_upper(upper, pre, reinsert_zero_cols=True)
-    phase["assemble_s"] += time.perf_counter() - t_as
-    return out, q8, scales, upper
-
-
 def _diagnose(trace_arr: np.ndarray, done: int, run: RunConfig) -> dict:
     """Split-R-hat/ESS on the post-burn-in slice of the chain traces.
 
@@ -515,25 +368,6 @@ def _diagnose(trace_arr: np.ndarray, done: int, run: RunConfig) -> dict:
             out["rhat"][name] = split_rhat(post[:, :, i])
         out["ess"][name] = ess(post[:, :, i])
     return out
-
-
-def _sidecar_esig(elig) -> np.ndarray:
-    """Collective unanimity signature of a sidecar eligibility result
-    (``_sidecar_eligibility``'s ``(source, iteration, acc_start)``, or
-    None): ``[iteration, kind, writer_count, acc_start]`` as int64, all
-    -1 when ineligible.  ``acc_start`` is the load-bearing 4th element
-    (ADVICE r5): with per-host local disks two processes can hold
-    sidecars agreeing on iteration/kind/count whose accumulation
-    windows started at DIFFERENT iterations (mixed stale files after
-    repeated light resumes); committing those would divide each host's
-    raw-sum accumulators by a different n_saved and return inconsistent
-    Sigma with no error.  The gate must refuse the pair instead."""
-    if elig is None:
-        return np.asarray([-1, -1, -1, -1], np.int64)
-    source, it, acc0 = elig
-    return np.asarray(
-        [it, 0 if source[0] == "plain" else 1,
-         -1 if source[0] == "plain" else source[1][0], acc0], np.int64)
 
 
 def _resolve_devices(backend: BackendConfig):
@@ -558,7 +392,11 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
     ``BackendConfig.mesh_devices``, or multi-host SPMD when the JAX
     distributed runtime is up - see parallel/multihost.py), on-device
     covariance-panel accumulation, and a bandwidth-optimized fetch +
-    native host assembly.
+    native host assembly.  Under the default quant8 fetch the accumulator
+    panels are STREAMED off the device at every chunk boundary
+    (runtime/pipeline.StreamingFetcher), overlapping the device->host
+    transfer with chain compute; the result is bitwise-identical to the
+    post-hoc fetch (``BackendConfig.fetch_stream``).
 
     Returns a :class:`FitResult`: the (p, p) posterior-mean covariance in
     the CALLER's coordinates, plus state, health stats, per-iteration chain
@@ -642,682 +480,6 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
     fingerprint = (data_fingerprint(pre.data)
                    if cfg.checkpoint_path else None)
 
-    def _chunks(num_iters: int) -> list:
-        out = [chunk] * (num_iters // chunk)
-        if num_iters % chunk:
-            out.append(num_iters % chunk)
-        return out
-
-    def _local_set_source(path):
-        """Per-host local-disk fallback, shared by the main multi-process
-        resume and the sidecar eligibility check: fabricate a "local-set"
-        source from THIS process's own ``.procK-of-N`` file.  "local-set",
-        not "set": the peer files were never verified to exist on this
-        host - the loader's fast path treats it like a set (it only reads
-        the local file) while the reshard branch rejects the kind rather
-        than crashing on missing peers; callers additionally gate on
-        collective agreement.  -> (source, this process's file path), or
-        (None, None) when no local file exists."""
-        n = jax.process_count()
-        mine = proc_path(path, jax.process_index(), n)
-        if not os.path.exists(mine):
-            return None, None
-        it = int(read_checkpoint_meta(mine)["iteration"])
-        return ("local-set",
-                (n, [proc_path(path, i, n) for i in range(n)], it)), mine
-
-    def _sidecar_eligibility(light_kept):
-        """The ONE home of the "does the .full sidecar beat the light
-        resume" rule (checkpoint_full_every): discover the sidecar - a
-        plain file or a ``.procK-of-N`` set at ``checkpoint_path +
-        ".full"``, falling back to this process's own set file when peers
-        live on per-host local disks - and return ``(source, iteration,
-        acc_start)`` iff it is full, compatible, and preserves MORE saved
-        draws than ``light_kept`` (the light restart window; 0 for a
-        finished run).  None otherwise; never raises.  Resuming the
-        sidecar re-runs the tail from its earlier iteration - more
-        compute - but keeps every draw its accumulators already hold,
-        which is the point of maintaining it."""
-        side = cfg.checkpoint_path + ".full"
-        try:
-            source = discover_checkpoint(side, prefer_plain=not multiproc)
-            meta_path = None
-            if source is not None:
-                meta_path = side if source[0] == "plain" else source[1][1][0]
-            elif multiproc:
-                # per-host local disks: the shared local-set fallback; the
-                # unanimity gate in the caller keeps a partially present
-                # set from ever being acted on
-                source, meta_path = _local_set_source(side)
-            if source is None:
-                return None
-            smeta = read_checkpoint_meta(meta_path)
-            if (smeta.get("state_only")
-                    or checkpoint_compatible(smeta, cfg, fingerprint)
-                    is not None):
-                return None
-            s_acc0 = int(smeta.get("acc_start", 0))
-            s_kept = (num_saved_draws(run.total_iters, run.burnin, run.thin)
-                      - num_saved_draws(s_acc0, run.burnin, run.thin))
-            if s_kept <= light_kept:
-                return None
-            return source, int(smeta["iteration"]), s_acc0
-        except Exception:  # dcfm: ignore[DCFM601] - eligibility probe: any failure = sidecar not usable
-            return None
-
-    def _try_full_sidecar(template, light_kept):
-        """Single-process sidecar load -> (carry, done, acc_start) or
-        None; eligibility via :func:`_sidecar_eligibility`."""
-        elig = _sidecar_eligibility(light_kept)
-        if elig is None:
-            return None
-        source, _, s_acc0 = elig
-        side = cfg.checkpoint_path + ".full"
-        try:
-            if source[0] == "plain":
-                carry, smeta = load_checkpoint(side, template)
-            else:
-                carry, smeta = load_checkpoint_resharded(source[1][1],
-                                                         template)
-            return carry, int(smeta["iteration"]), s_acc0
-        except Exception:  # dcfm: ignore[DCFM601] - sidecar load is best-effort; caller falls back to light resume
-            return None
-
-    def _resume_state(init_fn, Yd):
-        """-> (carry, done).  resume=True demands a compatible checkpoint;
-        resume="auto" (elastic recovery) falls back to a fresh start when
-        the checkpoint is missing or incompatible.
-
-        A plain single-process file is preferred; absent that, a complete
-        ``path.procK-of-N`` set written by an N-process run is resharded
-        onto this process (topology-flexible resume - an N-host pod's
-        chain continues on one host, checkpoint.load_checkpoint_resharded).
-        """
-        auto = cfg.resume == "auto"
-        source = None
-        if cfg.resume:
-            # One discovery picks the most-progressed source among the
-            # plain file and any .procK-of-N set (checkpoint.
-            # discover_checkpoint); in auto mode an unreadable candidate
-            # is just another reason to start fresh.
-            try:
-                source = discover_checkpoint(cfg.checkpoint_path,
-                                             prefer_plain=True)
-            except Exception:
-                if not auto:
-                    raise
-        if source is not None:
-            # Compatibility first (friendly refusal on config/data mismatch),
-            # then load into an eval_shape template - the real init never
-            # runs, so no wasted compile and no doubled accumulator peak.
-            # In auto mode an unreadable/old-format/corrupt checkpoint is
-            # just another reason to start fresh - the elastic-recovery
-            # contract must survive library upgrades, not crash-loop on
-            # them.
-            kind, found = source
-            try:
-                meta = read_checkpoint_meta(
-                    cfg.checkpoint_path if kind == "plain" else found[1][0])
-                reason = checkpoint_compatible(meta, cfg, fingerprint)
-            except Exception:
-                if not auto:
-                    raise
-                reason = "unreadable or incompatible checkpoint"
-            if reason is not None and not auto:
-                raise ValueError(f"refusing to resume: {reason}")
-            if reason is None:
-                # the payload load can fail on its own (corrupt leaf data
-                # behind a healthy meta entry) - same auto-mode fallback
-                try:
-                    template = jax.eval_shape(init_fn, k_init, Yd)
-                    carry, meta = (
-                        load_checkpoint(cfg.checkpoint_path, template)
-                        if kind == "plain" else
-                        load_checkpoint_resharded(found[1], template))
-                    it = int(meta["iteration"])
-                    if meta.get("state_only"):
-                        # Light checkpoint: accumulation restarts here,
-                        # keeping only the draws of the restarted window.
-                        # The .full sidecar (checkpoint_full_every) wins
-                        # whenever its accumulators preserve MORE draws -
-                        # including the window = 0 case (finished run, or
-                        # only tail iterations past the last thin point
-                        # remain), where a light resume would silently
-                        # return Sigma = 0.
-                        window = (num_saved_draws(run.total_iters,
-                                                  run.burnin, run.thin)
-                                  - num_saved_draws(it, run.burnin,
-                                                    run.thin))
-                        side = _try_full_sidecar(template, max(window, 0))
-                        if side is not None:
-                            return side
-                        if window <= 0:
-                            raise ValueError(
-                                "resuming a state-only (light) checkpoint "
-                                f"at iteration {it}: no further draws "
-                                "would be saved and its covariance "
-                                "accumulators were not stored, so there "
-                                "is nothing to report - extend run.mcmc "
-                                "to continue the chain, or use "
-                                "checkpoint_mode='full' / "
-                                "checkpoint_full_every for recoverable "
-                                "accumulators")
-                        return carry, it, it
-                    return carry, it, int(meta.get("acc_start", 0))
-                except Exception:
-                    if not auto:
-                        raise
-        elif cfg.resume and not auto:
-            raise FileNotFoundError(
-                f"resume=True but no checkpoint at {cfg.checkpoint_path} "
-                "(or any .procK-of-N set)")
-        return init_fn(k_init, Yd), 0, 0
-
-    def _resume_state_multiproc(init_fn, Yd):
-        """Multi-host resume: each process loads its own shard-local file
-        (utils/checkpoint.proc_path) into the shardings of a fresh init.
-
-        The resume decision is COLLECTIVE and iteration-exact: every
-        process reports the iteration its file holds (-1 = not loadable)
-        and the chain resumes only if ALL processes report the SAME
-        iteration - a kill can land between two processes' saves, leaving
-        files one chunk apart, and resuming from mismatched iterations
-        would deadlock the SPMD collectives.  No process raises before the
-        gather (a pre-collective raise would hang the peers inside it);
-        strict-mode failures surface as a local error after it.
-        """
-        auto = cfg.resume == "auto"
-        carry0 = init_fn(k_init, Yd)
-        loaded, failure = None, None
-        if cfg.resume:
-            # One discovery picks the most-progressed source among any
-            # .procK-of-N set and a plain single-process file
-            # (checkpoint.discover_checkpoint); a set written at THIS
-            # process count resumes shard-locally, anything else is
-            # resharded (topology-flexible elastic recovery; needs a
-            # shared checkpoint filesystem).  The rule is deterministic
-            # from file contents, so all processes agree, and the SAME
-            # source object flows into the loader - the set that was
-            # compatibility-checked is the set that loads.
-            meta_path = None
-            try:
-                source = discover_checkpoint(cfg.checkpoint_path,
-                                             prefer_plain=False)
-                if source is not None:
-                    meta_path = (cfg.checkpoint_path
-                                 if source[0] == "plain" else source[1][1][0])
-            except Exception as e:
-                source = None
-                failure = f"checkpoint unreadable: {e}"
-            if source is None:
-                # Per-host local checkpoint disks: discovery needs the
-                # whole set visible, but the SAME-topology fast path only
-                # ever reads this process's own file - fall back to it.
-                # Every process sees the same condition (each its own
-                # file), and the collective iteration agreement below
-                # still refuses mixed states.
-                try:
-                    source, lpath = _local_set_source(cfg.checkpoint_path)
-                    if source is not None:
-                        meta_path, failure = lpath, None
-                except Exception as e:
-                    failure = failure or f"checkpoint unreadable: {e}"
-            if source is not None:
-                try:
-                    meta = read_checkpoint_meta(meta_path)
-                    reason = checkpoint_compatible(meta, cfg, fingerprint)
-                    if reason is not None:
-                        failure = f"refusing to resume: {reason}"
-                    else:
-                        # free the init buffers before the load materializes
-                        # the checkpointed copies - no doubled accumulator
-                        # peak
-                        template = jax.tree.map(
-                            lambda a: jax.ShapeDtypeStruct(
-                                a.shape, a.dtype, sharding=a.sharding),
-                            carry0)
-                        jax.tree.map(lambda a: a.delete(), carry0)
-                        carry0 = None
-                        loaded = load_checkpoint_multiprocess(
-                            cfg.checkpoint_path, template, source=source)
-                except Exception as e:
-                    failure = f"checkpoint unreadable: {e}"
-            elif failure is None:
-                failure = (f"no checkpoint at {cfg.checkpoint_path} "
-                           "(or any .procK-of-N set)")
-
-        from jax.experimental import multihost_utils
-        # Agreement is on the full SOURCE SIGNATURE (iteration, kind,
-        # writer count), not the iteration alone: with per-host local
-        # disks two processes can resolve different checkpoint sources
-        # whose iterations coincide (e.g. a stale set from an earlier
-        # topology beside the current one) - same-iteration-different-
-        # source would still be a mixed chain state.
-        my_iter = int(loaded[1]["iteration"]) if loaded is not None else -1
-        kind_code = -1 if loaded is None else (0 if source[0] == "plain"
-                                               else 1)
-        src_count = (-1 if loaded is None or source[0] == "plain"
-                     else source[1][0])
-        # state_only is part of the signature: the light-resume branch
-        # below runs an EXTRA collective (the sidecar gates), so two
-        # processes that agree on iteration/kind/count but disagree on
-        # light-vs-full (e.g. per-host disks holding files from runs with
-        # different checkpoint_mode) must NOT pass this gate - one would
-        # enter the sidecar allgather while the other entered the chain.
-        so_code = (-1 if loaded is None
-                   else int(bool(loaded[1].get("state_only"))))
-        my_sig = np.asarray([my_iter, kind_code, src_count, so_code],
-                            np.int64)
-        # fault_event: crash-point seams for the randomized fuzz harness
-        # (resilience/faults.py kill_event; no-ops without a plan).  A
-        # kill between two collectives on ONE host is exactly the state
-        # that leaves peers blocked inside the next allgather - the pod
-        # supervisor's coordinated stop must reap them.
-        fault_event("resume_gate")
-        all_sigs = multihost_utils.process_allgather(my_sig)
-        fault_event("resume_gate_post")
-        agree = my_iter >= 0 and bool(np.all(all_sigs == my_sig[None, :]))
-        if agree:
-            meta = loaded[1]
-            if meta.get("state_only"):
-                window = (num_saved_draws(run.total_iters, run.burnin,
-                                          run.thin)
-                          - num_saved_draws(my_iter, run.burnin, run.thin))
-                # Sidecar preference (checkpoint_full_every), collective
-                # with TWO unanimity gates.  Gate 1: every process
-                # evaluates the sidecar deterministically
-                # (_sidecar_eligibility - the same rule as single-process)
-                # and the switch is considered only if ALL processes saw
-                # the SAME, more-draw-preserving source (a partially
-                # visible, torn, or absent sidecar on ANY process keeps
-                # the agreed light resume everywhere).  Gate 2: the
-                # PAYLOAD load must succeed on every process before any
-                # commits - a truncated shard file on one host must not
-                # leave it raising while peers enter the chain (that
-                # would deadlock the first collective); on any failure
-                # all processes fall back to the already-loaded light
-                # carry.  The sidecar load transiently holds both carries
-                # (same 2x-accumulator class as the snapshot transient).
-                # The signature includes acc_start (4th element): two
-                # hosts could agree on iteration/kind/count yet hold
-                # sidecars whose accumulation windows started at
-                # different iterations (e.g. mixed stale files after
-                # repeated light resumes) - committing those would
-                # silently divide by inconsistent n_saved divisors.
-                elig = _sidecar_eligibility(max(window, 0))
-                e_sig = _sidecar_esig(elig)
-                fault_event("sidecar_gate")
-                all_e = multihost_utils.process_allgather(e_sig)
-                if (e_sig[0] >= 0
-                        and bool(np.all(all_e == e_sig[None, :]))):
-                    fault_event("sidecar_load")
-                    s_carry = smeta2 = None
-                    try:
-                        s_carry, smeta2 = load_checkpoint_multiprocess(
-                            cfg.checkpoint_path + ".full", template,
-                            source=elig[0])
-                        s_ok = 1
-                    except Exception:  # dcfm: ignore[DCFM601] - failure becomes s_ok=0, surfaced via the collective gate
-                        s_ok = 0
-                    fault_event("sidecar_commit")
-                    all_ok = multihost_utils.process_allgather(
-                        np.asarray([s_ok], np.int64))
-                    fault_event("sidecar_commit_post")
-                    if bool(np.all(all_ok == 1)):
-                        jax.tree.map(
-                            lambda a: (a.delete()
-                                       if isinstance(a, jax.Array)
-                                       else None), loaded[0])
-                        return (s_carry, int(smeta2["iteration"]),
-                                int(smeta2.get("acc_start", 0)))
-                    if s_carry is not None:   # a peer failed: fall back
-                        jax.tree.map(
-                            lambda a: (a.delete()
-                                       if isinstance(a, jax.Array)
-                                       else None), s_carry)
-                if window > 0:
-                    return loaded[0], my_iter, my_iter
-                # light checkpoint with an empty restart window and no
-                # unanimously better sidecar: nothing would be
-                # accumulated (see _resume_state); raising here is safe -
-                # every process agreed on the source, so all raise
-                # identically
-                if not auto:
-                    raise ValueError(
-                        "resuming a state-only (light) checkpoint at "
-                        f"iteration {my_iter}: no further draws would be "
-                        "saved and its covariance accumulators were not "
-                        "stored - extend run.mcmc, or use "
-                        "checkpoint_full_every so a .full sidecar exists")
-            else:
-                return loaded[0], my_iter, int(meta.get("acc_start", 0))
-        if cfg.resume and not auto and not agree:
-            raise ValueError(
-                failure or "resume=True but the per-process checkpoints "
-                "disagree on the resume source "
-                f"({all_sigs.tolist()} as [iteration, kind, count, "
-                "state_only] rows) - "
-                "a crash between two processes' saves, or mixed stale "
-                "files; delete the files or use resume='auto' to restart "
-                "fresh")
-        if loaded is not None:
-            # discarding the load (disagreement, or auto-mode finished-light
-            # fallthrough): free its device buffers BEFORE re-init - the
-            # loader materialized full-size accumulator leaves, and holding
-            # them across init_fn would double the device peak
-            jax.tree.map(
-                lambda a: a.delete() if isinstance(a, jax.Array) else None,
-                loaded[0])
-        if carry0 is None:   # init was freed for a load that was discarded
-            carry0 = init_fn(k_init, Yd)
-        return carry0, 0, 0
-
-    def _rewind_source(template):
-        """Newest compatible, CRC-clean checkpoint among the retained
-        generations (checkpoint_keep_last) - the sentinel's rewind
-        target.  Returns (host carry, iteration, acc_start) or None."""
-        for p in retained_checkpoints(cfg.checkpoint_path):
-            try:
-                r_meta = read_checkpoint_meta(p)
-                if checkpoint_compatible(r_meta, cfg, fingerprint):
-                    continue
-                c, r_meta = load_checkpoint(p, template)
-                r_it = int(r_meta["iteration"])
-                if r_meta.get("state_only"):
-                    # light file: accumulation restarts at its iteration
-                    return c, r_it, r_it
-                return c, r_it, int(r_meta.get("acc_start", 0))
-            except Exception:  # dcfm: ignore[DCFM601] - walk the retention chain: next generation is the handling
-                continue    # corrupt/unreadable generation: try the next
-        return None
-
-    def _poison_carry(c):
-        # deterministic chaos only (faults op "poison_state"): simulate an
-        # on-device divergence by NaN-ing the loadings; the NEXT chunk's
-        # health reduction trips the sentinel exactly as a real blow-up
-        # would
-        nan = jnp.float32(jnp.nan)
-        return c._replace(
-            state=dataclasses.replace(c.state, Lambda=c.state.Lambda * nan))
-
-    def _run_chain(init_fn, chunk_fns, Yd, commit_fn=None):
-        """``chunk_fns(ni, model)`` -> the jitted chunk callable for a scan
-        of ``ni`` iterations under ``model`` - the base ModelConfig, or the
-        sentinel's jitter-escalated variant after a rewind."""
-        t_init = time.perf_counter()
-        carry, done, acc_start = (_resume_state_multiproc if multiproc
-                                  else _resume_state)(init_fn, Yd)
-        if commit_fn is not None and done:
-            # Commit a RESUMED carry into device-OWNED buffers before the
-            # first chunk call.  Two independent reasons, both load-
-            # bearing:
-            #
-            # 1. Lifetime.  load_checkpoint returns host numpy leaves,
-            #    and on the CPU backend jax's array ingestion can
-            #    zero-copy ALIAS a (suitably aligned) numpy buffer
-            #    without keeping the numpy array alive.  The loader's
-            #    arrays die when this rebind drops them, so the chain
-            #    would compute on freed heap - garbage Sigma when
-            #    lucky, glibc abort ("corrupted size vs. prev_size") /
-            #    SIGSEGV when not.  This was the process-killing crash
-            #    at the mesh checkpoint-resume tests in tier-1.  The
-            #    commit therefore runs a jitted COPY (jnp.copy per
-            #    leaf): jit outputs are freshly allocated XLA-owned
-            #    buffers by construction, while the numpy inputs stay
-            #    referenced for the duration of the call.
-            #
-            # 2. Signature stability.  Feeding host numpy leaves
-            #    straight into the jitted chunk presents an uncommitted
-            #    argument signature that differs from the committed
-            #    carry every fresh start uses, forcing a full recompile
-            #    of the chunk program on every resume.
-            carry = commit_fn(carry)
-        jax.block_until_ready(carry)
-        phase["init_s"] = time.perf_counter() - t_init
-        stats = None
-        traces = []
-        chunk_secs = []
-        executed = run.total_iters - done
-        # Write-behind checkpointing: each chunk-boundary save snapshots
-        # the carry on device and fetches/writes in a background thread,
-        # so the next chunk's compute overlaps the save instead of
-        # stalling on it.  checkpoint_s is the CHAIN-VISIBLE cost only
-        # (snapshot dispatch + any join on a still-running previous save
-        # + the final durability join); the hidden background fetch rides
-        # the device->host link concurrently with compute.
-        writer = AsyncCheckpointWriter() if cfg.checkpoint_path else None
-        save_fn = (save_checkpoint_multiprocess if multiproc
-                   else save_checkpoint)
-        light_mode = cfg.checkpoint_mode == "light"
-        # cadence: an int saves every k-th boundary; "auto" starts at 1 and
-        # re-sizes itself from the FIRST completed save's measured drain so
-        # that one save's hidden fetch+write fits inside the compute it
-        # overlaps (the VERDICT-r4 18x e2e inflation was exactly a cadence
-        # shorter than the drain).
-        cadence = cfg.checkpoint_every_chunks
-        auto_cadence = cadence == "auto"
-        if auto_cadence:
-            cadence = 1
-        since_save, saves_done, ck_error = 0, 0, None
-
-        def _save_failure(e, last):
-            """The ONE home of the save-failure policy: before the final
-            boundary a broken save re-raises (resume-from-last-checkpoint
-            is what the feature is for - fail fast, lose one chunk); once
-            the chain is complete it must never be discarded for a
-            save-only error, so the failure downgrades to a warning +
-            FitResult.checkpoint_error."""
-            nonlocal ck_error
-            if not last:
-                raise e
-            import warnings
-            warnings.warn(
-                f"checkpoint save failed: {e!r}; results are returned "
-                "but the run is NOT resumable from its end", RuntimeWarning)
-            ck_error = repr(e)
-        # Deterministic fault harness (resilience/faults.py): None outside
-        # chaos runs - every hook below is then skipped at one truthiness
-        # check.
-        plan = fault_plan()
-        # Divergence sentinel (FitConfig.sentinel; resilience/sentinel.py):
-        # host-side policy over the per-chunk non-finite reductions the
-        # device already computes.  "auto" resolves to rewind when there
-        # is a checkpoint to rewind to (single-process - a collective
-        # rewind would need its own unanimity protocol), abort otherwise.
-        s_mode = cfg.sentinel
-        if s_mode == "auto":
-            s_mode = ("rewind" if cfg.checkpoint_path and not multiproc
-                      else "abort")
-        elif s_mode == "rewind" and multiproc:
-            import warnings
-            warnings.warn(
-                "sentinel='rewind' is not supported on multi-process "
-                "runs (a collective rewind needs its own unanimity "
-                "protocol); degrading to 'abort' - a divergence will "
-                "raise ChainDivergedError instead of rewinding",
-                RuntimeWarning)
-            s_mode = "abort"
-        sentinel = None
-        if s_mode in ("abort", "rewind") and executed:
-            # baseline: historical non-finite counts a RESUMED carry may
-            # already hold - only NEW divergence trips
-            h = (jax.device_get(_replicate_jit(mesh)(carry.health))
-                 if multiproc else jax.device_get(carry.health))
-            sentinel = DivergenceSentinel(
-                s_mode, max_rewinds=cfg.sentinel_max_rewinds,
-                baseline_nonfinite=float(np.asarray(h)[..., 3].sum()),
-                base_jitter=m.ridge_jitter)
-        m_active = m
-        # local binding: a rewind re-lineages the chain key for THIS run
-        # only (fold_in below); the fit-level k_chain closure must stay
-        # untouched
-        key_chain = k_chain
-        rewind_template = None
-        # global iteration the TRACE array starts at: `done` unless a
-        # rewind falls back to a retained checkpoint older than the
-        # resume point (then the re-run traces start earlier, and the
-        # diagnostics' post-burn-in slice must follow)
-        trace0 = done
-        it_now = done                 # global iteration at chunk boundaries
-        queue = _chunks(executed)
-        qi = 0
-        while qi < len(queue):
-            ni = queue[qi]
-            qi += 1
-            tc = time.perf_counter()
-            carry, stats, trace = chunk_fns(ni, m_active)(
-                key_chain, Yd, carry, sched)
-            trace_host = np.asarray(trace)
-            chunk_secs.append(time.perf_counter() - tc)
-            it_now += ni
-            traces.append((it_now - ni, trace_host))
-            last = qi == len(queue)
-            if sentinel is not None and sentinel.tripped(stats):
-                reloaded = None
-                if sentinel.mode == "rewind":
-                    if writer is not None:
-                        try:
-                            writer.wait()     # no racing an in-flight save
-                        except Exception:  # dcfm: ignore[DCFM601] - a failed save of a garbage carry is moot mid-rewind
-                            pass   # a failed save is moot mid-rewind
-                    if rewind_template is None:
-                        rewind_template = jax.eval_shape(init_fn, k_init, Yd)
-                    reloaded = _rewind_source(rewind_template)
-                if reloaded is None:
-                    raise ChainDivergedError(
-                        "chain produced non-finite values in the chunk "
-                        f"ending at iteration {it_now}"
-                        + (" and no usable checkpoint exists to rewind to"
-                           if sentinel.mode == "rewind"
-                           else " (sentinel mode 'abort')"),
-                        iteration=it_now, rewinds=sentinel.rewinds)
-                sentinel.record_rewind(it_now)   # raises past the budget
-                bad = carry
-                carry, it_now, acc_start = reloaded
-                trace0 = min(trace0, it_now)
-                jax.tree.map(
-                    lambda a: a.delete() if isinstance(a, jax.Array)
-                    else None, bad)
-                if commit_fn is not None:
-                    carry = commit_fn(carry)
-                # drop the poisoned chunks' traces, re-lineage the chain
-                # key (the retry must not deterministically re-enter the
-                # same blow-up) and escalate the ridge jitter; the resumed
-                # schedule re-chunks the remaining iterations
-                traces = [(s, t) for s, t in traces if s < it_now]
-                key_chain = jax.random.fold_in(key_chain, sentinel.rewinds)
-                m_active = dataclasses.replace(
-                    m_active, ridge_jitter=sentinel.escalated_jitter())
-                queue = _chunks(run.total_iters - it_now)
-                qi = 0
-                since_save = 0
-                continue
-            if writer is None:
-                if plan is not None:
-                    plan.maybe_kill(it_now, done, "pre_save")
-                    plan.maybe_kill(it_now, done, "post_save")
-                    if plan.poison_due(it_now, done):
-                        carry = _poison_carry(carry)
-                continue
-            if writer.poll_error() is not None and not last:
-                # Durability broke mid-run (disk full, ...): fail at the
-                # NEXT chunk boundary - one chunk of lost compute instead
-                # of finishing the whole chain and aborting at the end
-                # (resume-from-last-checkpoint is exactly what the feature
-                # is for).  Once the LAST chunk has computed, though, the
-                # chain is complete and must not be discarded for a
-                # save-only error - the final wait() below downgrades the
-                # failure to a warning + FitResult.checkpoint_error.
-                writer.wait()   # joins and re-raises the stored error
-            if auto_cadence and writer.last_save_seconds is not None:
-                # steady-state chunk time: exclude chunk 0, which carries
-                # the jit compile on a cold cache and would undersize the
-                # cadence exactly when the link is slowest; 1.5x headroom
-                # so a due save's drain finishes comfortably inside the
-                # cadence.  Re-sized at every boundary from the LATEST
-                # completed save, so a later (bigger/slower) save updates
-                # it.
-                steady = chunk_secs[1:] if len(chunk_secs) > 1 else chunk_secs
-                mean_chunk = sum(steady) / len(steady)
-                cadence = max(1, int(np.ceil(
-                    1.5 * writer.last_save_seconds / max(mean_chunk, 1e-9))))
-            since_save += 1
-            if plan is not None:
-                # "pre_save" kills land BEFORE this boundary's save, so the
-                # checkpoint never advances past the trigger - the poison-
-                # iteration drill (resilience/faults.py)
-                plan.maybe_kill(it_now, done, "pre_save")
-            # the last boundary always saves (so a finished run resumes as
-            # a no-op under mode="full", or hands its exact state to a
-            # chain extension under "light").  A still-running previous
-            # save DEFERS a non-final due save to the next boundary
-            # instead of join-blocking the chain behind the link - so even
-            # a mis-sized cadence (or a periodic full save in light mode)
-            # degrades to a later save, never to a stall.
-            saved_this_boundary = False
-            if (since_save >= cadence and not writer.busy()) or last:
-                full_due = (light_mode and cfg.checkpoint_full_every > 0
-                            and (saves_done + 1)
-                            % cfg.checkpoint_full_every == 0)
-                # Full saves in light mode go to the .full SIDECAR: the
-                # next light save atomically replaces checkpoint_path, so
-                # writing the full snapshot there would void the
-                # bounds-the-loss guarantee one save later.  Resume
-                # prefers the sidecar whenever it preserves more draws
-                # than the light restart window - _try_full_sidecar
-                # single-process, the unanimity-gated collective check in
-                # _resume_state_multiproc on pods.
-                # EXCEPT on the last boundary: checkpoint_path must always
-                # receive the final state (a stale light file there would
-                # mis-resume a finished run), and a full-due final save is
-                # simply written full to the main path - no later light
-                # save exists to overwrite it.
-                target = (cfg.checkpoint_path + ".full"
-                          if full_due and not last
-                          else cfg.checkpoint_path)
-                t_ck = time.perf_counter()
-                try:
-                    writer.submit(save_fn, target, carry, cfg,
-                                  fingerprint=fingerprint,
-                                  state_only=light_mode and not full_due,
-                                  acc_start=acc_start,
-                                  keep_last=cfg.checkpoint_keep_last)
-                    saved_this_boundary = True
-                except Exception as e:
-                    # submit joins the previous save; see _save_failure
-                    _save_failure(e, last)
-                phase["checkpoint_s"] += time.perf_counter() - t_ck
-                since_save = 0
-                saves_done += 1
-            if plan is not None:
-                # chaos determinism: a "post_save" kill must observe a
-                # DURABLE save, so it only arms at a boundary whose save
-                # actually happened (cadence > 1 skips boundaries; the
-                # kill then lands at the NEXT saving boundary) - and the
-                # write-behind writer is flushed first (a background
-                # failure surfaces here exactly as the poll_error path
-                # would, downgraded on the final boundary only)
-                if saved_this_boundary:
-                    try:
-                        writer.wait()
-                    except Exception as e:
-                        _save_failure(e, last)
-                    plan.maybe_kill(it_now, done, "post_save")
-                if plan.poison_due(it_now, done):
-                    carry = _poison_carry(carry)
-        if writer is not None:
-            # the last save must be durable before fit() returns; a failure
-            # here must not discard a finished chain's results
-            t_ck = time.perf_counter()
-            try:
-                writer.wait()
-            except Exception as e:
-                _save_failure(e, True)    # chain complete: downgrade
-            phase["checkpoint_s"] += time.perf_counter() - t_ck
-        return (carry, stats, executed, [t for _, t in traces], chunk_secs,
-                done, acc_start, ck_error,
-                sentinel.rewinds if sentinel is not None else 0, trace0)
-
     C = run.num_chains
     # static draw-buffer size (0 = feature off); see RunConfig.store_draws
     S_draws = run.num_saved if run.store_draws else 0
@@ -1325,30 +487,79 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
     profile_ctx = (jax.profiler.trace(cfg.backend.profile_dir)
                    if cfg.backend.profile_dir else contextlib.nullcontext())
     phase = {"preprocess_s": preprocess_s, "upload_s": 0.0, "init_s": 0.0,
-             "chain_s": 0.0, "fetch_s": 0.0, "assemble_s": 0.0,
-             "checkpoint_s": 0.0}
+             "chain_s": 0.0, "fetch_s": 0.0, "exposed_fetch_s": 0.0,
+             "assemble_s": 0.0, "checkpoint_s": 0.0}
+
+    # Streamed accumulator fetch (BackendConfig.fetch_stream): quant8,
+    # single-process runs only ("auto"; multi-process pods keep the
+    # replicated post-hoc fetch - a per-boundary cross-host all-gather
+    # would serialize the pod on its slowest link).  The factory runs
+    # inside the chunk loop once the resume point is known: the final
+    # window divisor depends on acc_start, and a no-op resume (nothing
+    # to execute) never streams.
+    stream_on = (cfg.backend.fetch_dtype == "quant8" and not multiproc
+                 and cfg.backend.fetch_stream != "off")
+    if cfg.backend.fetch_stream == "on" and multiproc:
+        # an explicit force-stream must not be dropped silently - the
+        # user asked for an overlap the pod path cannot provide
+        import warnings
+        warnings.warn(
+            "BackendConfig.fetch_stream='on' is ignored on multi-process "
+            "runs: the streamed fetch is single-process only (pods keep "
+            "the replicated post-hoc fetch)", RuntimeWarning)
+    n_pairs = num_upper_pairs(m.num_shards)
+    P_shard = pre.data.shape[2]
+
+    def _window(acc_start: int):
+        # shared with the post-hoc epilogue - see accumulator_window's
+        # docstring for why there is exactly one copy of this
+        _, inv, bessel = accumulator_window(
+            run.total_iters, run.burnin, run.thin, acc_start, C)
+        return inv, bessel
+
+    streamer_factory = None
+    if stream_on:
+        def streamer_factory(acc_start):
+            land_mean = land_sd = None
+            if cfg.stream_artifact:
+                # land straight in the serve artifact's memmap layout:
+                # the drain writes the panel bytes the export would have
+                # re-materialized (meta is invalidated until fit()
+                # finalizes, so a crash mid-stream refuses to open)
+                from dcfm_tpu.serve.artifact import begin_streamed_artifact
+                land_mean, land_sd = begin_streamed_artifact(
+                    cfg.stream_artifact, g=m.num_shards, P=P_shard,
+                    has_sd=m.posterior_sd)
+            sd_fn = (fetch_sd_jit(m.num_shards, C, "quant8", None)
+                     if m.posterior_sd else None)
+            return StreamingFetcher(
+                fetch_jit(m.num_shards, C, "quant8", None), _window,
+                (n_pairs, P_shard, P_shard), acc_start,
+                sd_fn=sd_fn, land_mean=land_mean, land_sd=land_sd)
+
     t0 = time.perf_counter()
     with profile_ctx:
         if use_mesh:
             mesh = make_mesh(n_mesh, devices)
             shards_per_device(m.num_shards, mesh)  # validates divisibility
             t_up = time.perf_counter()
-            Y_up = _upload_host_array(pre.data, cfg.backend.upload_dtype)
+            Y_up = upload_host_array(pre.data, cfg.backend.upload_dtype)
             Yd = (place_sharded_global(Y_up, mesh) if multiproc
                   else place_sharded(Y_up, mesh))
             if Yd.dtype != jnp.float32:
-                Yd = _cast_f32_jit()(Yd)  # jit preserves the sharding
+                Yd = cast_f32_jit()(Yd)  # jit preserves the sharding
             jax.block_until_ready(Yd)
             phase["upload_s"] = time.perf_counter() - t_up
+
             def _commit_mesh(c):
                 # Resumed carry (host numpy from load_checkpoint) ->
                 # XLA-OWNED device arrays with the EXACT carry
                 # shardings the shard_map chunk expects (see the
-                # commit_fn rationale in _run_chain: a raw device_put
-                # of numpy can zero-copy alias the loader's buffers and
-                # compute on freed heap once they are dropped; the
-                # jitted jnp.copy allocates fresh device-owned
-                # buffers).
+                # commit_fn rationale in runtime/pipeline.run_chain: a
+                # raw device_put of numpy can zero-copy alias the
+                # loader's buffers and compute on freed heap once they
+                # are dropped; the jitted jnp.copy allocates fresh
+                # device-owned buffers).
                 from jax.sharding import NamedSharding, PartitionSpec
                 specs = _mesh_fns(mesh, m, chunk, C, S_draws, unroll)[2]
                 spec_leaves = jax.tree.leaves(
@@ -1359,20 +570,24 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
                 return jax.jit(lambda t: jax.tree.map(jnp.copy, t),
                                out_shardings=shardings)(c)
 
-            (carry, stats, executed, traces, chunk_secs, done, acc_start,
-             ck_error, rewinds, trace0) = _run_chain(
-                _mesh_fns(mesh, m, chunk, C, S_draws, unroll)[0],
-                lambda ni, m2: _mesh_fns(mesh, m2, ni, C, S_draws,
-                                         unroll)[1],
-                Yd, commit_fn=None if multiproc else _commit_mesh)
+            rr = run_chain(
+                cfg=cfg, model=m, run=run, sched=sched, phase=phase,
+                multiproc=multiproc, mesh=mesh, k_init=k_init,
+                k_chain=k_chain, fingerprint=fingerprint,
+                init_fn=_mesh_fns(mesh, m, chunk, C, S_draws, unroll)[0],
+                chunk_fns=lambda ni, m2: _mesh_fns(mesh, m2, ni, C,
+                                                   S_draws, unroll)[1],
+                Yd=Yd, commit_fn=None if multiproc else _commit_mesh,
+                streamer_factory=streamer_factory)
         else:
+            mesh = None
             with jax.default_device(devices[0]):
                 t_up = time.perf_counter()
                 Yd = jax.device_put(
-                    jnp.asarray(_upload_host_array(
+                    jnp.asarray(upload_host_array(
                         pre.data, cfg.backend.upload_dtype)), devices[0])
                 if Yd.dtype != jnp.float32:
-                    Yd = _cast_f32_jit()(Yd)
+                    Yd = cast_f32_jit()(Yd)
                 jax.block_until_ready(Yd)
                 phase["upload_s"] = time.perf_counter() - t_up
                 # Commit the initial carry to the device explicitly: jit
@@ -1382,123 +597,277 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
                 # sharding signature and trigger a full recompile of the
                 # chunk function (~7s at the p=10k bench shape).
                 init_fn = _local_fns(m, chunk, C, S_draws, unroll)[0]
-                (carry, stats, executed, traces, chunk_secs, done, acc_start,
-                 ck_error, rewinds, trace0) = _run_chain(
-                    lambda k, Y: jax.device_put(init_fn(k, Y), devices[0]),
-                    lambda ni, m2: _local_fns(m2, ni, C, S_draws,
-                                              unroll)[1], Yd,
+                rr = run_chain(
+                    cfg=cfg, model=m, run=run, sched=sched, phase=phase,
+                    multiproc=multiproc, mesh=None, k_init=k_init,
+                    k_chain=k_chain, fingerprint=fingerprint,
+                    init_fn=lambda k, Y2: jax.device_put(init_fn(k, Y2),
+                                                         devices[0]),
+                    chunk_fns=lambda ni, m2: _local_fns(m2, ni, C, S_draws,
+                                                        unroll)[1],
+                    Yd=Yd,
                     # jit copy FIRST (fresh XLA-owned buffers - a raw
                     # device_put of the loader's numpy can zero-copy
                     # alias memory that dies at the commit rebind; see
-                    # _run_chain), then device_put of the jax arrays to
-                    # commit them to the device.
+                    # runtime/pipeline.run_chain), then device_put of
+                    # the jax arrays to commit them to the device.
                     commit_fn=lambda c: jax.device_put(
-                        _owned_copy_jit()(c), devices[0]))
-    if stats is None:
-        # resumed from a finished checkpoint: recompute the diagnostics
-        # from the carried running-health panel (replicated first on
-        # multi-process runs - sharded leaves are not host-fetchable).
-        src_h, src_state = ((carry.health, carry.state) if not multiproc
-                            else jax.device_get(_replicate_jit(mesh)(
-                                (carry.health, carry.state))))
-        h = np.asarray(src_h)  # dcfm: ignore[DCFM701] - replicated (or fetched) above, host-safe
-        ranks = np.asarray(effective_ranks(src_state))
-        stats = ChainStats(tau_log_max=h[..., 0].max(),
-                           ps_min=h[..., 1].min(), ps_max=h[..., 2].max(),
-                           rank_min=ranks.min(), rank_max=ranks.max(),
-                           rank_mean=ranks.mean(),
-                           nonfinite_count=h[..., 3].sum(),
-                           # jnp on the (possibly sharded) global array -
-                           # a plain SPMD reduction, host-fetchable scalar
-                           acc_nonfinite=float(np.asarray(jax.device_get(
-                               jnp.sum(jnp.logical_not(jnp.isfinite(
-                                   carry.sigma_acc)).astype(jnp.float32))
-                           ))))
-    else:
-        # reduce the per-chain stats leaves ((C,) arrays when num_chains > 1)
-        # to the scalar cross-chain summary.
-        stats = jax.device_get(stats)  # dcfm: ignore[DCFM701] - stats leaves are replicated psum reductions
-        stats = ChainStats(
-            tau_log_max=np.max(stats.tau_log_max),
-            ps_min=np.min(stats.ps_min), ps_max=np.max(stats.ps_max),
-            rank_min=np.min(stats.rank_min), rank_max=np.max(stats.rank_max),
-            rank_mean=np.mean(stats.rank_mean),
-            nonfinite_count=np.sum(stats.nonfinite_count),
-            acc_nonfinite=np.sum(stats.acc_nonfinite))
+                        owned_copy_jit()(c), devices[0]),
+                    streamer_factory=streamer_factory)
+    carry, stats, executed = rr.carry, rr.stats, rr.executed
+    traces, chunk_secs = rr.traces, rr.chunk_seconds
+    done, acc_start = rr.done, rr.acc_start
+    ck_error, rewinds, trace0 = rr.checkpoint_error, rr.rewinds, rr.trace0
+    streamer = rr.streamer
 
-    # Per-iteration scalar traces -> (C, executed, S) + convergence report.
-    if traces:
-        trace_arr = np.concatenate(
-            [t if t.ndim == 3 else t[None] for t in traces], axis=1)
-    else:
-        trace_arr = np.zeros((C, 0, len(TRACE_SUMMARIES)))
-    # trace0, not done: a sentinel rewind onto a retained checkpoint older
-    # than the resume point makes the traces start below `done`
-    diagnostics = _diagnose(trace_arr, trace0, run)
+    try:
+        if stats is None:
+            # resumed from a finished checkpoint: recompute the
+            # diagnostics from the carried running-health panel
+            # (replicated first on multi-process runs - sharded leaves
+            # are not host-fetchable).
+            src_h, src_state = ((carry.health, carry.state) if not multiproc
+                                else jax.device_get(replicate_jit(mesh)(
+                                    (carry.health, carry.state))))
+            h = np.asarray(src_h)  # dcfm: ignore[DCFM701] - replicated (or fetched) above, host-safe
+            ranks = np.asarray(effective_ranks(src_state))
+            stats = ChainStats(tau_log_max=h[..., 0].max(),
+                               ps_min=h[..., 1].min(),
+                               ps_max=h[..., 2].max(),
+                               rank_min=ranks.min(), rank_max=ranks.max(),
+                               rank_mean=ranks.mean(),
+                               nonfinite_count=h[..., 3].sum(),
+                               # jnp on the (possibly sharded) global
+                               # array - a plain SPMD reduction,
+                               # host-fetchable scalar
+                               acc_nonfinite=float(np.asarray(
+                                   jax.device_get(jnp.sum(
+                                       jnp.logical_not(jnp.isfinite(
+                                           carry.sigma_acc)
+                                       ).astype(jnp.float32))))))
+        else:
+            # reduce the per-chain stats leaves ((C,) arrays when
+            # num_chains > 1) to the scalar cross-chain summary.
+            stats = jax.device_get(stats)  # dcfm: ignore[DCFM701] - stats leaves are replicated psum reductions
+            stats = ChainStats(
+                tau_log_max=np.max(stats.tau_log_max),
+                ps_min=np.min(stats.ps_min), ps_max=np.max(stats.ps_max),
+                rank_min=np.min(stats.rank_min),
+                rank_max=np.max(stats.rank_max),
+                rank_mean=np.mean(stats.rank_mean),
+                nonfinite_count=np.sum(stats.nonfinite_count),
+                acc_nonfinite=np.sum(stats.acc_nonfinite))
 
-    # Fetch results: the packed panel accumulator dominates device->host
-    # traffic (p^2/g^2 bytes per block pair); the carry already stores
-    # exactly the upper-triangle panels, so the fetch trims the padding
-    # and sends them as-is, optionally down-cast or int8-quantized
-    # (backend.fetch_dtype) on a slow link.  Chains are averaged on device first (each chain is an
-    # equal-weight posterior-mean estimate, so the mixture mean is the
-    # pooled estimate).  posterior_sd uses the same link optimizations:
-    # the E[X^2] - E[X]^2 difference (which reduced precision would cancel
-    # catastrophically) is formed ON DEVICE in f32 (_fetch_sd_jit), so
-    # only direct SD values - benign to round - cross the link.
-    fetch_mode = cfg.backend.fetch_dtype
-    # multi-process: replicate fetch outputs over the mesh (cross-host
-    # all-gather inside the jit) so every process can materialize them
-    fetch_mesh = mesh if multiproc else None
-    # The accumulators hold raw sums over saved draws; the division by the
-    # actual saved count happens on device at fetch (which is what lets a
-    # resumed run extend the chain - the count is only known at the end).
-    # acc_start > 0 after a light-checkpoint resume: the accumulators were
-    # restarted at that iteration, so the window divisor counts only the
-    # draws saved since.
-    n_saved = (num_saved_draws(done + executed, run.burnin, run.thin)
-               - num_saved_draws(acc_start, run.burnin, run.thin))
-    inv_count = np.float32(1.0 / max(n_saved, 1))
+        # Per-iteration scalar traces -> (C, executed, S) + convergence
+        # report.  Host-CPU-only work runs FIRST in the epilogue: under
+        # the streamed fetch the final snapshot's drain is still riding
+        # the link in the background, and everything done here is time
+        # the drain hides.
+        if traces:
+            trace_arr = np.concatenate(
+                [t if t.ndim == 3 else t[None] for t in traces], axis=1)
+        else:
+            trace_arr = np.zeros((C, 0, len(TRACE_SUMMARIES)))
+        # trace0, not done: a sentinel rewind onto a retained checkpoint
+        # older than the resume point makes the traces start below `done`
+        diagnostics = _diagnose(trace_arr, trace0, run)
 
-    def _fetch_upper(acc):
-        # non-quant8 modes only; the quant8 fetch goes through
-        # _quant8_start/_quant8_fetch_assemble below.
-        out = _fetch_jit(m.num_shards, C, fetch_mode, fetch_mesh)(
-            acc, inv_count)
-        return np.asarray(out).astype(np.float32, copy=False)
+        # Small device fetches (state, draws, imputation accumulator)
+        # also go BEFORE the panel join: they are MBs next to the
+        # ~p^2/2-byte panel set, and on the post-hoc path they simply
+        # precede the panel fetch.  final state for FitResult: small
+        # next to the accumulator; replicated first on multi-process
+        # runs (sharded leaves are not host-fetchable)
+        state = jax.device_get(replicate_jit(mesh)(carry.state)
+                               if multiproc else carry.state)
+        draws = None
+        if carry.draws is not None:
+            d = jax.device_get(replicate_jit(mesh)(carry.draws)
+                               if multiproc else carry.draws)
+            draws = {"Lambda": np.asarray(d.Lambda),
+                     "ps": np.asarray(d.ps), "X": np.asarray(d.X)}
+            if d.H is not None:
+                draws["H"] = np.asarray(d.H)
 
-    # reinsert_zero_cols=True: Sigma is (p, p) in the caller's coordinates,
-    # with zero rows/cols for all-zero input columns (variance of a constant
-    # is 0) - indices never shift (the reference's Q7 drops them silently).
-    # assemble_from_upper: the native one-pass conquer assembler (NumPy
-    # fallback inside).  The quant8 path assembles Sigma STRAIGHT from the
-    # int8 panels (dequant folded into the native pass); the float32 upper
-    # panels exist only lazily behind FitResult.upper_panels.
-    # Posterior-SD prep shares the fetch: with quant8 BOTH panel sets'
-    # device->host asyncs are issued before either is drained, so the mean
-    # assembly runs while the SD panels ride the link (the link is the
-    # resource either way; an SD-on fit costs ~one extra panel-set
-    # transfer, not a serialized fetch+assemble round-trip).
-    want_sd = carry.sigma_sq_acc is not None
-    if want_sd:
-        n_draws = max(n_saved * C, 1)
-        bessel = np.float32(n_draws / (n_draws - 1) if n_draws > 1 else 1.0)
-        sd_fetch = _fetch_sd_jit(m.num_shards, C, fetch_mode, fetch_mesh)
-    Sigma_sd = sd_upper = sd_q8 = sd_q8_scales = None
-    upper = q8_panels = q8_scales = None
-    if fetch_mode == "quant8":
-        q_dev, scale_dev = _fetch_jit(m.num_shards, C, "quant8", fetch_mesh)(
-            carry.sigma_acc, inv_count)
-        mean_started = _quant8_start(q_dev, scale_dev)
+        # The accumulators hold raw sums over saved draws; the division
+        # by the actual saved count happens on device at fetch (which is
+        # what lets a resumed run extend the chain - the count is only
+        # known at the end).  acc_start > 0 after a light-checkpoint
+        # resume: the accumulators were restarted at that iteration, so
+        # the window divisor counts only the draws saved since.  The
+        # SAME helper feeds the streamed fetch's window_fn - bitwise
+        # interchangeability of the two paths depends on it.
+        n_saved, inv_count, bessel = accumulator_window(
+            done + executed, run.burnin, run.thin, acc_start, C)
+
+        Y_imputed = None
+        # gated on the input actually having NaN entries: a user may
+        # force impute_missing=True on complete data (the carry then has
+        # the accumulator leaf), but the FitResult contract is "set when
+        # the input had missing entries"
+        if carry.y_imp_acc is not None and pre.n_missing:
+            yi = np.asarray(jax.device_get(
+                replicate_jit(mesh)(carry.y_imp_acc) if multiproc
+                else carry.y_imp_acc), np.float32)
+            if C > 1:
+                yi = yi.mean(axis=0)    # pool the chains' posterior means
+            rec = restore_data_matrix(yi / max(n_saved, 1), pre,
+                                      destandardize=True)
+            # observed entries are the caller's exact values; only the
+            # NaN positions take the posterior-mean imputation
+            Y_imputed = np.array(Y, np.float32, copy=True)  # dcfm: ignore[DCFM701] - Y is the caller's host matrix
+            miss = np.isnan(Y_imputed)
+            Y_imputed[miss] = rec[miss]
+        # Fetch results: the packed panel accumulator dominates
+        # device->host traffic (p^2/g^2 bytes per block pair); the carry
+        # already stores exactly the upper-triangle panels, so the fetch
+        # trims the padding and sends them as-is, optionally down-cast
+        # or int8-quantized (backend.fetch_dtype) on a slow link.
+        # Chains are averaged on device first (each chain is an
+        # equal-weight posterior-mean estimate, so the mixture mean is
+        # the pooled estimate).  posterior_sd uses the same link
+        # optimizations: the E[X^2] - E[X]^2 difference (which reduced
+        # precision would cancel catastrophically) is formed ON DEVICE
+        # in f32 (runtime/fetch.fetch_sd_jit), so only direct SD values
+        # - benign to round - cross the link.
+        #
+        # Under the streamed fetch the panels already landed (or are
+        # about to): join the background drain - the blocked time here
+        # is the EXPOSED fetch, everything earlier hid behind compute -
+        # and assemble from the landed bytes.  The landed bits are the
+        # same fetch-jit output the post-hoc branch would produce, so
+        # the two paths are bitwise-interchangeable; a drain failure
+        # falls back to the post-hoc fetch (the carry is still alive).
+        #
+        # This whole stretch stays inside the streamer abort guard: an
+        # exception anywhere before finish() returns (jit setup,
+        # KeyboardInterrupt, ...) must not abandon the blocked worker.
+        fetch_mode = cfg.backend.fetch_dtype
+        # multi-process: replicate fetch outputs over the mesh (cross-
+        # host all-gather inside the jit) so every process can
+        # materialize them
+        fetch_mesh = mesh if multiproc else None
+
+        def _fetch_upper(acc):
+            # non-quant8 modes only; the quant8 fetch goes through
+            # quant8_start/quant8_fetch_assemble below.
+            out = fetch_jit(m.num_shards, C, fetch_mode, fetch_mesh)(
+                acc, inv_count)
+            return np.asarray(out).astype(np.float32, copy=False)
+
+        want_sd = carry.sigma_sq_acc is not None
+        if want_sd:
+            sd_fetch = fetch_sd_jit(m.num_shards, C, fetch_mode,
+                                    fetch_mesh)
+        Sigma_sd = sd_upper = sd_q8 = sd_q8_scales = None
+        upper = q8_panels = q8_scales = None
+        stream_stats = None
+        artifact_path = None
+        streamed = None
+        if streamer is not None:
+            t_join = time.perf_counter()
+            try:
+                streamed = streamer.finish()
+                if not streamed["final_landed"]:
+                    streamed = None
+            except Exception as e:
+                import warnings
+                warnings.warn(
+                    f"streamed accumulator fetch failed ({e!r}); falling "
+                    "back to the post-hoc fetch", RuntimeWarning)
+                streamed = None
+            phase["exposed_fetch_s"] = time.perf_counter() - t_join
+    except BaseException:
+        # the background drain must never outlive a failing fit blocked
+        # on a queue nobody will close (it is a non-daemon thread - an
+        # abandoned blocked worker would hang interpreter shutdown).
+        # abort() after a completed finish() is an idempotent no-op.
+        if streamer is not None:
+            streamer.abort()
+        raise
+    if streamed is not None:
+        # the final submit's blocked slot wait happened inside the chunk
+        # loop - exposed fetch time the join wall above cannot see
+        phase["exposed_fetch_s"] += float(streamed["final_wait_s"])
+        phase["fetch_s"] += float(sum(streamed["chunk_fetch_s"]))
+        stream_stats = {
+            "streamed": True,
+            "snapshots": streamed["snapshots"],
+            "skipped": streamed["skipped"],
+            "exposed_fetch_s": phase["exposed_fetch_s"],
+            "chunk_fetch_s": [float(s) for s in streamed["chunk_fetch_s"]],
+        }
+        q8_panels, q8_scales = streamed["q8"], streamed["scales"]
+        t_as = time.perf_counter()
+        Sigma = assemble_q8_sigma(np.ascontiguousarray(q8_panels),
+                                  q8_scales, pre)
+        if Sigma is None:
+            # no native library: dequantize once, keep f32 panels (the
+            # landed buffer is already host memory - plain array or the
+            # artifact memmap)
+            upper = dequantize_panels(q8_panels, q8_scales)
+            q8_panels = q8_scales = None
+            Sigma = assemble_from_upper(upper, pre, reinsert_zero_cols=True)
+        phase["assemble_s"] += time.perf_counter() - t_as
+        if want_sd and streamed["sd_scales"] is not None:
+            sd_q8, sd_q8_scales = streamed["sd_q8"], streamed["sd_scales"]
+            t_as = time.perf_counter()
+            Sigma_sd = assemble_q8_sigma(np.ascontiguousarray(sd_q8),
+                                         sd_q8_scales, pre)
+            if Sigma_sd is None:
+                sd_upper = dequantize_panels(sd_q8, sd_q8_scales)
+                sd_q8 = sd_q8_scales = None
+                Sigma_sd = assemble_from_upper(sd_upper, pre,
+                                               reinsert_zero_cols=True)
+            phase["assemble_s"] += time.perf_counter() - t_as
+        if cfg.stream_artifact:
+            # panels already landed in the artifact's memmaps; finalize
+            # writes the O(p) maps + metadata - fit -> export is free
+            from dcfm_tpu.serve.artifact import finalize_streamed_artifact
+            art = finalize_streamed_artifact(
+                cfg.stream_artifact,
+                mean_mm=streamed["q8"], mean_scale=streamed["scales"],
+                pre=pre, sd_mm=streamed["sd_q8"],
+                sd_scale=streamed["sd_scales"],
+                provenance={
+                    "source": "fit-stream",
+                    "num_shards": m.num_shards,
+                    "factors_per_shard": m.factors_per_shard,
+                    "prior": m.prior,
+                    "estimator": m.estimator,
+                    "seed": run.seed,
+                    "total_iters": run.total_iters,
+                })
+            # The FitResult must NOT keep the WRITABLE landing memmaps:
+            # a user mutation would corrupt the finalized artifact
+            # behind its recorded CRCs, and a later stream to the same
+            # path would rewrite the bytes under the result's lazy
+            # panel views.  Rebind to the artifact's read-only maps
+            # (begin_streamed_artifact gives each stream a fresh inode,
+            # so these views also survive a re-stream of the path).
+            if q8_panels is not None:
+                q8_panels = art.mean_panels
+            if sd_q8 is not None and art.sd_panels is not None:
+                sd_q8 = art.sd_panels
+            artifact_path = cfg.stream_artifact
+    elif fetch_mode == "quant8":
+        q_dev, scale_dev = fetch_jit(m.num_shards, C, "quant8",
+                                     fetch_mesh)(carry.sigma_acc, inv_count)
+        mean_started = quant8_start(q_dev, scale_dev)
         if want_sd:
             qsd_dev, ssd_dev = sd_fetch(carry.sigma_acc, carry.sigma_sq_acc,
                                         inv_count, bessel)
-            sd_started = _quant8_start(qsd_dev, ssd_dev)
-        Sigma, q8_panels, q8_scales, upper = _quant8_fetch_assemble(
+            sd_started = quant8_start(qsd_dev, ssd_dev)
+        Sigma, q8_panels, q8_scales, upper = quant8_fetch_assemble(
             mean_started, q_dev.shape, pre, phase)
         if want_sd:
-            Sigma_sd, sd_q8, sd_q8_scales, sd_upper = _quant8_fetch_assemble(
+            Sigma_sd, sd_q8, sd_q8_scales, sd_upper = quant8_fetch_assemble(
                 sd_started, qsd_dev.shape, pre, phase)
+        # += not =: on the drain-failure fallback the join wall already
+        # spent blocked in finish() is in exposed_fetch_s and must not
+        # be discarded (never-streamed runs start from 0.0, so += is
+        # the plain assignment there)
+        phase["exposed_fetch_s"] += phase["fetch_s"]
     else:
         t_f = time.perf_counter()
         upper = _fetch_upper(carry.sigma_acc)
@@ -1516,42 +885,12 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
             Sigma_sd = assemble_from_upper(sd_upper, pre,
                                            reinsert_zero_cols=True)
             phase["assemble_s"] += time.perf_counter() - t_as
-    # final state for FitResult: small next to the accumulator; replicated
-    # first on multi-process runs (sharded leaves are not host-fetchable)
-    state = jax.device_get(_replicate_jit(mesh)(carry.state)
-                           if multiproc else carry.state)
-    draws = None
-    if carry.draws is not None:
-        d = jax.device_get(_replicate_jit(mesh)(carry.draws)
-                           if multiproc else carry.draws)
-        draws = {"Lambda": np.asarray(d.Lambda), "ps": np.asarray(d.ps),
-                 "X": np.asarray(d.X)}
-        if d.H is not None:
-            draws["H"] = np.asarray(d.H)
-
-    Y_imputed = None
-    # gated on the input actually having NaN entries: a user may force
-    # impute_missing=True on complete data (the carry then has the
-    # accumulator leaf), but the FitResult contract is "set when the input
-    # had missing entries"
-    if carry.y_imp_acc is not None and pre.n_missing:
-        yi = np.asarray(jax.device_get(
-            _replicate_jit(mesh)(carry.y_imp_acc) if multiproc
-            else carry.y_imp_acc), np.float32)
-        if C > 1:
-            yi = yi.mean(axis=0)        # pool the chains' posterior means
-        rec = restore_data_matrix(yi / max(n_saved, 1), pre,
-                                  destandardize=True)
-        # observed entries are the caller's exact values; only the NaN
-        # positions take the posterior-mean imputation
-        Y_imputed = np.array(Y, np.float32, copy=True)  # dcfm: ignore[DCFM701] - Y is the caller's host matrix
-        miss = np.isnan(Y_imputed)
-        Y_imputed[miss] = rec[miss]
+        phase["exposed_fetch_s"] += phase["fetch_s"]
 
     seconds = time.perf_counter() - t0
     phase["chain_s"] = float(sum(chunk_secs))
 
-    return FitResult(
+    res = FitResult(
         Sigma=Sigma,
         _upper_f32=upper,
         _q8_panels=q8_panels,
@@ -1578,7 +917,28 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
         Y_imputed=Y_imputed,
         checkpoint_error=ck_error,
         sentinel_rewinds=rewinds,
+        stream_stats=stream_stats,
+        artifact_path=artifact_path,
     )
+    if cfg.stream_artifact and res.artifact_path is None:
+        # The stream did not land (multi-process fit, a no-op finished
+        # resume that executed zero chunks, or a drain-failure fallback):
+        # export post-hoc so the contract - the artifact exists at
+        # stream_artifact after fit() returns - holds unconditionally.
+        # One writer on multi-process runs (the fetch is replicated),
+        # and a collective barrier before ANY process returns: without
+        # it a peer could hand the path to a consumer while process 0
+        # is still mid-write with meta.json deleted.  Like checkpoint
+        # discovery, this assumes a shared artifact filesystem.
+        if not multiproc or jax.process_index() == 0:
+            from dcfm_tpu.serve.artifact import export_fit_result
+            export_fit_result(res, cfg.stream_artifact)
+        if multiproc:
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices(
+                "dcfm-stream-artifact-export")
+        res.artifact_path = cfg.stream_artifact
+    return res
 
 
 def divideconquer(
@@ -1625,3 +985,21 @@ def divideconquer(
         backend=BackendConfig(backend=backend),
     )
     return fit(Y, cfg).Sigma
+
+
+# ---------------------------------------------------------------------------
+# Back-compat aliases: this machinery lived in api.py before the
+# dcfm_tpu/runtime/ split (PR 6); external references (tests, scripts,
+# notebooks) keep working through these names.
+# ---------------------------------------------------------------------------
+_cast_for_link = cast_for_link
+_fetch_jit = fetch_jit
+_fetch_sd_jit = fetch_sd_jit
+_replicate_jit = replicate_jit
+_cast_f32_jit = cast_f32_jit
+_owned_copy_jit = owned_copy_jit
+_upload_host_array = upload_host_array
+_quant8_start = quant8_start
+_quant8_drain = quant8_drain
+_quant8_fetch_assemble = quant8_fetch_assemble
+_sidecar_esig = sidecar_esig

@@ -249,6 +249,22 @@ class BackendConfig:
     # The per-conditional named_scope labels (z_update, x_update,
     # lambda_update, prior_update, ps_update, combine) mark the phases.
     profile_dir: Optional[str] = None
+    # Streamed accumulator fetch (runtime/pipeline.StreamingFetcher):
+    # at every chunk boundary the quantized snapshot of the running-sum
+    # accumulator is dispatched device->host asynchronously and drained
+    # by a background worker while the next chunk computes, so the
+    # post-chain fetch wall collapses to one exposed snapshot drain
+    # (FitResult.phase_seconds["exposed_fetch_s"]).  The final
+    # boundary's snapshot is the SAME fetch-jit output the post-hoc
+    # fetch would produce, so results are bitwise-identical either way
+    # (see runtime/pipeline.py for the snapshot-not-delta rationale).
+    #   "auto" - stream when fetch_dtype == "quant8" and the run is
+    #            single-process (mesh or vmap; multi-process pods keep
+    #            the replicated post-hoc fetch);
+    #   "on"   - force streaming (quant8 only; validate() refuses other
+    #            fetch dtypes);
+    #   "off"  - the pre-streaming post-hoc fetch.
+    fetch_stream: str = "auto"   # "auto" | "on" | "off"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -341,6 +357,17 @@ class FitConfig:
     # ChainDivergedError instead of looping (each rewind escalates the
     # ridge jitter 10x, so the budget also caps the jitter).
     sentinel_max_rewinds: int = 3
+    # If set, the streamed fetch lands the quantized posterior panels
+    # DIRECTLY into a serve artifact directory at this path (the int8
+    # ``mean_q8.bin`` / ``sd_q8.bin`` memmaps of serve/artifact.py);
+    # fit() finalizes the maps/metadata on completion, so
+    # ``fit -> export_artifact`` costs a metadata write instead of a
+    # second full p^2/2-byte materialization, and
+    # ``FitResult.export_artifact(same_path)`` just opens it.  Requires
+    # the quant8 streamed fetch (fetch_dtype="quant8" and fetch_stream
+    # not "off").  The artifact's bytes are bitwise-identical to a
+    # post-hoc ``res.export_artifact`` of the same chain.
+    stream_artifact: Optional[str] = None
 
 
 def validate(cfg: FitConfig, n: int, p: int) -> None:
@@ -447,6 +474,25 @@ def validate(cfg: FitConfig, n: int, p: int) -> None:
         raise ValueError(
             f"unknown upload_dtype {cfg.backend.upload_dtype!r} "
             "(float32 | float16 | bfloat16)")
+    if cfg.backend.fetch_stream not in ("auto", "on", "off"):
+        raise ValueError(
+            f"unknown fetch_stream {cfg.backend.fetch_stream!r} "
+            "(auto | on | off)")
+    if (cfg.backend.fetch_stream == "on"
+            and cfg.backend.fetch_dtype != "quant8"):
+        raise ValueError(
+            "fetch_stream='on' requires fetch_dtype='quant8': the "
+            "streamed double buffer lands int8 panels (use fetch_stream="
+            "'auto', which simply does not engage for other dtypes)")
+    if cfg.stream_artifact is not None:
+        if cfg.backend.fetch_dtype != "quant8":
+            raise ValueError(
+                "stream_artifact requires fetch_dtype='quant8' (the "
+                "artifact layout is the int8 panel set)")
+        if cfg.backend.fetch_stream == "off":
+            raise ValueError(
+                "stream_artifact requires the streamed fetch "
+                "(fetch_stream 'auto' or 'on', not 'off')")
     if cfg.backend.fetch_dtype == "float16" and not cfg.standardize:
         raise ValueError(
             "fetch_dtype='float16' requires standardize=True: raw-scale "

@@ -52,7 +52,7 @@ Ops:
 * ``kill_event`` - SIGKILL this process at the ``at_occurrence``-th
   (1-based, default 1) firing of a NAMED code-path event.  Events are
   emitted by :func:`fault_event` calls threaded through the multi-host
-  resume path (api._resume_state_multiproc): ``resume_gate`` /
+  resume path (runtime/resume.resume_state_multiproc): ``resume_gate`` /
   ``resume_gate_post`` bracket the source-signature allgather,
   ``sidecar_gate`` precedes the sidecar-eligibility allgather (gate 1),
   ``sidecar_load`` lands between gate 1 passing and the payload load,
@@ -110,8 +110,12 @@ LAUNCH_ENV_VAR = "DCFM_FAULT_LAUNCH"
 _VALID_OPS = {"kill", "kill_event", "poison_state", "torn_write",
               "bit_flip", "io_error", "io_delay"}
 
-# Resume-path events the multi-host fuzz targets (api.fit emits them via
-# fault_event; see the kill_event op above).
+# Resume-path events the multi-host fuzz targets (the runtime pipeline
+# emits them via fault_event; see the kill_event op above).  The chunk
+# loop additionally emits ``stream_submit`` / ``stream_submit_post``
+# around each boundary's streamed-fetch dispatch
+# (runtime/pipeline.run_chain) - not fuzzed by default, but available
+# to plans that want a kill INSIDE the streaming window.
 FUZZ_EVENTS = ("resume_gate", "resume_gate_post", "sidecar_gate",
                "sidecar_load", "sidecar_commit", "sidecar_commit_post")
 
@@ -336,9 +340,11 @@ def clear() -> None:
 
 def fault_event(name: str) -> None:
     """Emit a named code-path event into the fault harness (a cheap
-    no-op without a plan).  api.fit threads these through the multi-host
-    resume path so kill_event faults can land INSIDE the collective
-    gate windows - see :data:`FUZZ_EVENTS`."""
+    no-op without a plan).  The runtime pipeline threads these through
+    the multi-host resume path (collective gate windows - see
+    :data:`FUZZ_EVENTS`) and around each chunk boundary's streamed-fetch
+    dispatch (``stream_submit`` / ``stream_submit_post``), so kill_event
+    faults can land inside either window."""
     plan = fault_plan()
     if plan is not None:
         plan.maybe_kill_event(name)

@@ -1,0 +1,32 @@
+"""Runtime pipeline: the chunk loop, fetch/assemble jits, and resume gates.
+
+This package is the explicit seam between the public API (``api.fit``)
+and the machinery that actually drives a chain on a device:
+
+* :mod:`dcfm_tpu.runtime.fetch` - the jitted device-side fetch preps
+  (chain-average, padding trim, quant8/f16 down-cast), the pipelined
+  quant8 drain helpers, and the small utility jits (owned-copy commit,
+  replication, f32 cast) the chunk loop and resume paths share;
+* :mod:`dcfm_tpu.runtime.pipeline` - the chunk loop (checkpoint
+  write-behind, divergence sentinel, fault seams) plus the
+  :class:`~dcfm_tpu.runtime.pipeline.StreamingFetcher` double buffer
+  that overlaps the device->host accumulator fetch with chain compute;
+* :mod:`dcfm_tpu.runtime.resume` - the single- and multi-process
+  checkpoint resume gates (source discovery, sidecar unanimity,
+  sentinel rewind source).
+
+dcfm-lint rule DCFM801 holds this package to an async-first fetch
+discipline: a blocking host fetch inside a runtime module must either
+be preceded by a ``copy_to_host_async`` dispatch in the same function
+or carry an inline ``# dcfm: ignore[DCFM801] - why`` annotation.
+"""
+
+from dcfm_tpu.runtime.fetch import (  # noqa: F401
+    cast_f32_jit, cast_for_link, fetch_jit, fetch_sd_jit, owned_copy_jit,
+    quant8_drain, quant8_fetch_assemble, quant8_start, replicate_jit,
+    upload_host_array)
+from dcfm_tpu.runtime.pipeline import (  # noqa: F401
+    ChainRunResult, StreamingFetcher, chunk_schedule, run_chain)
+from dcfm_tpu.runtime.resume import (  # noqa: F401
+    ResumeContext, resume_state, resume_state_multiproc, rewind_source,
+    sidecar_esig)

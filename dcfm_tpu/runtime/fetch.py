@@ -1,0 +1,256 @@
+"""Device->host fetch/assemble jits: the link-optimization layer.
+
+The covariance accumulator is the biggest device->host artifact of a run
+(~p^2/2 floats); everything here exists to move it cheaply and safely:
+
+* :func:`cast_for_link` / :func:`fetch_jit` / :func:`fetch_sd_jit` - the
+  jitted device-side fetch preps (chain-average, padding trim, quant8 /
+  reduced-dtype down-cast), lru-cached on their static signature so
+  repeated ``fit()`` calls reuse compilations;
+* :func:`quant8_start` / :func:`quant8_drain` /
+  :func:`quant8_fetch_assemble` - the pipelined int8 drain (all
+  ``copy_to_host_async`` dispatched up front, slices memcpy'd as they
+  arrive) and the native one-pass assembly to the caller-coordinate
+  matrix;
+* :func:`owned_copy_jit` / :func:`replicate_jit` / :func:`cast_f32_jit`
+  / :func:`upload_host_array` - the small utility jits the chunk loop,
+  resume paths, and upload share.
+
+Every helper moved here keeps the name it had as an ``api.py`` private
+(`api._fetch_jit` etc. remain as aliases for external references).
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dcfm_tpu.models.sampler import num_saved_draws
+from dcfm_tpu.models.state import num_upper_pairs
+from dcfm_tpu.utils.estimate import (
+    assemble_from_q8, assemble_from_upper, dequantize_panels)
+from dcfm_tpu.utils.preprocess import PreprocessResult
+
+
+def accumulator_window(total_iters: int, burnin: int, thin: int,
+                       acc_start: int, num_chains: int):
+    """``(n_saved, inv_count, bessel)`` for the accumulator window
+    ``(acc_start, total_iters]`` - the ONE encoding of the divisor the
+    fetch jits quantize with.  Both the streamed fetch (via
+    ``StreamingFetcher``'s window_fn) and the post-hoc epilogue call
+    THIS helper: the streamed==post-hoc bitwise contract requires the
+    two paths to feed the jits identical float32 divisors, so the
+    computation must not exist twice."""
+    n_saved = (num_saved_draws(total_iters, burnin, thin)
+               - num_saved_draws(acc_start, burnin, thin))
+    inv_count = np.float32(1.0 / max(n_saved, 1))
+    n_draws = max(n_saved * num_chains, 1)
+    bessel = np.float32(n_draws / (n_draws - 1) if n_draws > 1 else 1.0)
+    return n_saved, inv_count, bessel
+
+
+def cast_for_link(u, mode: str):
+    """Down-cast upper panels for the device->host link - the single
+    device-side home for the quantization convention that
+    utils/estimate.dequantize_panels and the native q8 assembler mirror
+    (and serve/artifact.quantize_panels twins host-side, bit for bit).
+
+    quant8 is max-abs int8 per panel: one float32 scale per P x P block,
+    entry error <= scale/254, ~4e-3 of the panel max - far below Monte
+    Carlo error; accumulation stayed float32 on device."""
+    if mode == "quant8":
+        scale = jnp.max(jnp.abs(u), axis=(1, 2))            # (n_pairs,)
+        safe = jnp.where(scale > 0, scale, 1.0)[:, None, None]
+        q = jnp.round(u * (127.0 / safe)).astype(jnp.int8)
+        return q, scale
+    return u.astype(jnp.dtype(mode))
+
+
+@functools.lru_cache(maxsize=64)
+def fetch_jit(g: int, num_chains: int, mode: str, mesh=None):
+    """Jitted device-side fetch prep: chain-average, padding trim, and the
+    down-cast/quantization for the link.  The carry already stores the
+    packed upper-triangle panels in canonical triu order
+    (models.state.packed_pair_indices), so the fetch reads them NATIVELY -
+    no on-device re-packing materialization; only the few padding panels
+    past g(g+1)/2 are sliced off.  Cached on (g, chains, mode, mesh) so
+    repeated fit() calls reuse the compilation (a fresh
+    ``jax.jit(lambda ...)`` per call would re-trace every time); single-
+    and multi-process fits therefore compile separately, and the cached
+    entry keeps its Mesh alive.
+
+    The cache is ALSO what makes the streamed fetch bitwise-trivial: the
+    per-boundary snapshot stream (pipeline.StreamingFetcher) and the
+    post-hoc fetch call the SAME compiled executable, so the final
+    boundary's snapshot is definitionally the post-hoc fetch's bits.
+
+    ``mesh`` (multi-process runs only): replicate the output over the mesh
+    so every process can materialize it on host - XLA inserts the
+    cross-host all-gather inside the jit.
+
+    ``inv_count`` (traced): 1/saved-draw-count - the accumulators are raw
+    sums over saved draws (models.sampler.ChainCarry), so the posterior
+    mean is formed here, on device, before any down-cast/quantization."""
+    n_pairs = num_upper_pairs(g)
+
+    def prep(acc, inv_count):
+        u = (acc.mean(axis=0) if num_chains > 1 else acc)
+        u = u[:n_pairs] * inv_count
+        return cast_for_link(u, mode)
+    if mesh is None:
+        return jax.jit(prep)
+    from jax.sharding import NamedSharding, PartitionSpec
+    return jax.jit(prep, out_shardings=NamedSharding(mesh, PartitionSpec()))
+
+
+@functools.lru_cache(maxsize=64)
+def fetch_sd_jit(g: int, num_chains: int, mode: str, mesh=None):
+    """Jitted device-side posterior-SD fetch prep: the entrywise SD is
+    formed ON DEVICE in float32 from the raw first/second-moment sums
+    (Bessel-corrected over the pooled draw count), and only then
+    down-cast/quantized for the link.  Variance-by-differences cancels
+    catastrophically in reduced precision, so the subtraction must happen
+    at full precision - but an SD VALUE, like a covariance value, rounds
+    benignly; computing it on device is what lets posterior_sd runs use
+    the same quant8/f16 link optimizations as the mean (the old design
+    forced a full-f32 fetch of both moment panels instead, 4x the
+    bytes)."""
+    n_pairs = num_upper_pairs(g)
+
+    def prep(acc, acc_sq, inv_count, bessel):
+        if num_chains > 1:
+            acc, acc_sq = acc.mean(axis=0), acc_sq.mean(axis=0)
+        # the carry is already packed upper panels; trim the padding and
+        # run the variance/sqrt math on g(g+1)/2 panels
+        mean = acc[:n_pairs] * inv_count
+        m2 = acc_sq[:n_pairs] * inv_count
+        sd = jnp.sqrt(jnp.maximum(m2 - mean * mean, 0.0) * bessel)
+        return cast_for_link(sd, mode)
+    if mesh is None:
+        return jax.jit(prep)
+    from jax.sharding import NamedSharding, PartitionSpec
+    return jax.jit(prep, out_shardings=NamedSharding(mesh, PartitionSpec()))
+
+
+@functools.lru_cache(maxsize=8)
+def replicate_jit(mesh):
+    """Identity jit that replicates a (sharded) pytree over the mesh -
+    the multi-process path uses it to make small outputs host-fetchable."""
+    from jax.sharding import NamedSharding, PartitionSpec
+    return jax.jit(lambda x: x,
+                   out_shardings=NamedSharding(mesh, PartitionSpec()))
+
+
+@functools.lru_cache(maxsize=4)
+def cast_f32_jit():
+    return jax.jit(lambda x: x.astype(jnp.float32))
+
+
+@functools.lru_cache(maxsize=4)
+def owned_copy_jit():
+    """Identity-copy jit: every output leaf is a freshly allocated,
+    XLA-owned buffer.  The safe ingestion seam for host numpy pytrees
+    (checkpoint loads) that will outlive their numpy sources - the CPU
+    backend's zero-copy device_put can alias a numpy buffer WITHOUT
+    keeping it alive, and computing on it after the source is dropped
+    reads freed heap (garbage results / glibc abort).  Re-traces per
+    pytree structure, cached thereafter."""
+    return jax.jit(lambda t: jax.tree.map(jnp.copy, t))
+
+
+def upload_host_array(data: np.ndarray, upload_dtype: str) -> np.ndarray:
+    """Down-cast the standardized data on the host so fewer bytes cross the
+    host->device link; the device casts back to float32 on arrival."""
+    if upload_dtype == "float32":
+        return data
+    if upload_dtype == "float16":
+        return data.astype(np.float16)
+    import ml_dtypes  # jax dependency, always present
+    return data.astype(ml_dtypes.bfloat16)
+
+
+def quant8_start(q_dev, scale_dev, n_slices: int = 8):
+    """Issue the pipelined device->host drain of an int8 panel set: the
+    scales' and every slice's ``copy_to_host_async`` are dispatched up
+    front, so the link stays saturated while arrived slices are memcpy'd
+    into place - and so a SECOND panel set (the posterior-SD moment
+    panels) can queue its transfers behind the first before the first is
+    even drained.  The tiny scales transfer is queued FIRST: the link is
+    FIFO, so anything requested after the panel asyncs would arrive (and
+    block) behind them.  Returns the (slices, scale_dev) pair to hand to
+    :func:`quant8_drain` / :func:`quant8_fetch_assemble`."""
+    scale_dev.copy_to_host_async()
+    n_pairs = q_dev.shape[0]
+    bounds = np.linspace(0, n_pairs, min(n_slices, n_pairs) + 1).astype(int)
+    slices = [q_dev[a:b] for a, b in zip(bounds[:-1], bounds[1:]) if b > a]
+    for s in slices:
+        s.copy_to_host_async()
+    return slices, scale_dev
+
+
+def quant8_drain(slices, shape, out: np.ndarray = None) -> np.ndarray:
+    """Wait out a started drain; returns the assembled int8 host array.
+
+    ``out`` (optional) is a preallocated landing buffer - a plain array
+    or the serve artifact's ``mean_q8.bin`` memmap (streamed export) -
+    that the arrived slices are memcpy'd into; when omitted a fresh
+    array is allocated.  Either way the panels are committed through an
+    OWNED host copy while the device slices are still alive (the
+    ``_owned_copy_jit`` discipline: nothing downstream ever aliases a
+    device buffer that a later donation or delete can invalidate).
+
+    The device->host transfer is the wall-clock bottleneck of a real fit
+    (the panels are ~p^2/2 entries); assembly of the posterior MEAN is
+    overlapped with the posterior-SD panel drain (both sets' asyncs are
+    issued before either is drained), but not with its own - the
+    output-row-major native assembler needs the full canonical panel set
+    and is fast enough (~0.3 s at p=10k) that slicing it finer buys
+    nothing."""
+    q_host = np.empty(shape, np.int8) if out is None else out
+    pos = 0
+    for s in slices:
+        # waits for this slice's async transfer to arrive
+        qh = np.asarray(s)  # dcfm: ignore[DCFM801] - the drain half: asyncs were dispatched in quant8_start
+        q_host[pos:pos + qh.shape[0]] = qh
+        pos += qh.shape[0]
+    return q_host
+
+
+def quant8_fetch_assemble(started, shape, pre: PreprocessResult, phase):
+    """Drain a started quant8 fetch + native one-pass assembly to the
+    final caller-coordinate matrix - the shared path for the posterior-
+    mean and posterior-SD panels.  ``started`` is a :func:`quant8_start`
+    result.  Returns ``(out, q8_panels, q8_scales, upper)`` with exactly
+    one of the (int8 panels+scales, float32 upper) backings set for the
+    FitResult's lazy panel storage; updates ``phase`` fetch/assemble
+    entries in place."""
+    slices, scale_dev = started
+    t_f = time.perf_counter()
+    # async already issued in quant8_start; the scales arrive first
+    scales = np.asarray(scale_dev)  # dcfm: ignore[DCFM801] - the drain half: asyncs were dispatched in quant8_start
+    q8 = quant8_drain(slices, shape)
+    phase["fetch_s"] += time.perf_counter() - t_f
+    t_as = time.perf_counter()
+    out = assemble_q8_sigma(q8, scales, pre)
+    upper = None
+    if out is None:
+        # no native library: dequantize once and keep the f32 panels as
+        # the FitResult backing store (they exist anyway)
+        upper = dequantize_panels(q8, scales)
+        q8 = scales = None
+        out = assemble_from_upper(upper, pre, reinsert_zero_cols=True)
+    phase["assemble_s"] += time.perf_counter() - t_as
+    return out, q8, scales, upper
+
+
+def assemble_q8_sigma(q8: np.ndarray, scales: np.ndarray,
+                      pre: PreprocessResult):
+    """Native one-pass int8 panels -> caller-coordinate matrix (None when
+    the native library is unavailable; callers fall back to the f32
+    dequant + numpy assembly)."""
+    return assemble_from_q8(q8, scales, pre,
+                            destandardize=True, reinsert_zero_cols=True)

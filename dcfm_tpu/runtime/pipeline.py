@@ -1,0 +1,635 @@
+"""The chunk pipeline: Gibbs chunk loop + streamed accumulator fetch.
+
+Two halves:
+
+* :func:`run_chain` - the host-side chunk loop moved out of ``api.fit``:
+  resume (runtime/resume.py), write-behind checkpointing
+  (utils/checkpoint.AsyncCheckpointWriter), the divergence sentinel,
+  the deterministic fault seams (``DCFM_FAULT_*``), and - new - the
+  per-boundary snapshot stream below.
+
+* :class:`StreamingFetcher` - the double-buffered device->host
+  accumulator stream.  While chunk N+1 computes on device, chunk N's
+  quant8 packed panels (and posterior-SD panels when enabled) ride the
+  link: the fetch jit and every ``copy_to_host_async`` are dispatched
+  at the chunk boundary, and a background worker drains arrived slices
+  into one owned host landing buffer (optionally the serve artifact's
+  ``mean_q8.bin`` memmap, which is what makes ``fit -> export_artifact``
+  free).
+
+**Snapshot semantics, not deltas - and why.**  The accumulators are
+running float32 sums over saved draws.  Each boundary streams the
+quantized snapshot of the CURRENT running sum under the final window
+divisor; a later snapshot supersedes the earlier one in the landing
+buffer.  The final boundary's snapshot runs the SAME cached fetch
+executable on the SAME final accumulator as the post-hoc fetch would,
+so the streamed result is bitwise-identical to the unstreamed one *by
+construction*.  Per-chunk deltas were rejected: float32 addition is
+non-associative, so a host-side sum of fetched deltas - quantized or
+full precision - cannot reproduce the device's running-sum bit pattern
+(``a + (b - a) != b`` in floating point), and int8-quantized deltas
+would additionally compound one quantization error per chunk.  The
+price of snapshots is that intermediate streams are superseded bytes;
+they ride an otherwise-idle link while the device computes, and the
+exposed cost after the chain is a single snapshot drain overlapped
+with the rest of fit()'s epilogue (checkpoint join, state/draw
+fetches, diagnostics).
+
+**Bounded buffering.**  At most ``max_inflight`` (default 2) snapshot
+sets exist at any time: each holds device-side int8 panels plus the
+in-drain host slices; host memory beyond that is ONE landing buffer
+per panel kind.  When both slots are busy at a boundary the stream is
+SKIPPED (recorded, never blocking the chain); the final boundary always
+streams, waiting for a slot if it must - that wait is exposed fetch
+time and is recorded as such.
+
+**Ownership.**  The drain commits through owned host copies while the
+device sources are alive (the ``owned_copy_jit`` discipline from the
+PR-1/PR-5 use-after-free class): ``quant8_drain`` memcpys every arrived
+slice into the landing buffer and the scales are copied with
+``np.array(..., copy=True)``, so nothing downstream ever aliases a
+device buffer that a later donation or ``delete()`` can invalidate.
+A regression test deletes the device snapshot right after submit and
+pins the landed bytes (tests/test_runtime_stream.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+import time
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dcfm_tpu.models.sampler import num_saved_draws
+from dcfm_tpu.resilience.faults import fault_event, fault_plan
+from dcfm_tpu.resilience.sentinel import (
+    ChainDivergedError, DivergenceSentinel)
+from dcfm_tpu.runtime.fetch import quant8_drain, quant8_start, replicate_jit
+from dcfm_tpu.runtime.resume import (
+    ResumeContext, resume_state, resume_state_multiproc, rewind_source)
+from dcfm_tpu.utils.checkpoint import (
+    AsyncCheckpointWriter, save_checkpoint, save_checkpoint_multiprocess)
+
+
+def chunk_schedule(num_iters: int, chunk: int) -> list:
+    """Full chunks + one remainder chunk (exactly ``num_iters``; per-
+    iteration RNG keys derive from the GLOBAL iteration index in
+    run_chunk, so neither chunking nor a checkpoint/resume boundary
+    changes the chain)."""
+    out = [chunk] * (num_iters // chunk)
+    if num_iters % chunk:
+        out.append(num_iters % chunk)
+    return out
+
+
+@dataclasses.dataclass
+class _StreamJob:
+    """One submitted snapshot: the started mean (and optional SD) drains
+    plus bookkeeping.  ``final`` marks the last boundary's snapshot -
+    the one whose landed bytes ARE the result."""
+
+    mean_started: Any                  # quant8_start result
+    mean_shape: tuple
+    sd_started: Any = None
+    sd_shape: Optional[tuple] = None
+    final: bool = False
+
+
+class StreamingFetcher:
+    """Double-buffered background drain of per-boundary accumulator
+    snapshots (module docstring has the full design rationale).
+
+    ``mean_fn(acc, inv_count) -> (q_dev, scale_dev)`` and optionally
+    ``sd_fn(acc, sq_acc, inv_count, bessel) -> (q_dev, scale_dev)`` are
+    the cached fetch jits; ``window_fn(acc_start) -> (inv_count,
+    bessel)`` recomputes the final-window divisor when a sentinel
+    rewind moves ``acc_start``.  ``land_mean`` / ``land_sd`` are
+    optional preallocated landing buffers (plain arrays or the serve
+    artifact's int8 panel memmaps); fresh arrays are allocated when
+    omitted."""
+
+    def __init__(self, mean_fn: Callable, window_fn: Callable,
+                 shape: tuple, acc_start: int, *,
+                 sd_fn: Optional[Callable] = None,
+                 land_mean: Optional[np.ndarray] = None,
+                 land_sd: Optional[np.ndarray] = None,
+                 max_inflight: int = 2, n_slices: int = 8):
+        self._mean_fn = mean_fn
+        self._sd_fn = sd_fn
+        self._window_fn = window_fn
+        self._inv_count, self._bessel = window_fn(acc_start)
+        self._shape = tuple(shape)
+        self._n_slices = n_slices
+        self.land_mean = (np.empty(self._shape, np.int8)
+                          if land_mean is None else land_mean)
+        self.land_sd = land_sd
+        if sd_fn is not None and land_sd is None:
+            self.land_sd = np.empty(self._shape, np.int8)
+        self.mean_scale: Optional[np.ndarray] = None
+        self.sd_scale: Optional[np.ndarray] = None
+        self.snapshots = 0
+        self.skipped = 0
+        self.chunk_fetch_s: list = []
+        # wall-clock the FINAL submit spent blocked waiting for a free
+        # in-flight slot - already-exposed fetch time the caller must
+        # add to the join wall (it happens inside the chunk loop, not
+        # inside finish())
+        self.final_wait_s = 0.0
+        self.final_landed = False
+        self._slots = threading.Semaphore(max_inflight)
+        self._queue: "queue.Queue" = queue.Queue()
+        self._error: Optional[BaseException] = None
+        self._finished = False
+        # NON-daemon deliberately (dcfm-lint DCFM501): a daemon drain
+        # still inside np.asarray / the device transfer at interpreter
+        # teardown aborts the process; finish()/abort() join it, and
+        # threading._shutdown joins it even on an abandoned fit.
+        self._worker = threading.Thread(
+            target=self._drain_loop, name="dcfm-stream-drain")
+        self._worker.start()
+
+    # -- main-thread side --------------------------------------------
+
+    def reset_window(self, acc_start: int) -> None:
+        """Sentinel rewind moved the accumulation window: recompute the
+        final divisor.  Already-queued snapshots of the pre-rewind
+        accumulator drain harmlessly - snapshot semantics mean every
+        stale landing is superseded by the final boundary's."""
+        self._inv_count, self._bessel = self._window_fn(acc_start)
+
+    def submit(self, acc, sq_acc=None, *, final: bool = False) -> bool:
+        """Dispatch one boundary's snapshot: run the fetch jits, issue
+        every ``copy_to_host_async``, and queue the drain.  Non-final
+        submits never block: when both in-flight slots are busy the
+        boundary is skipped (returns False).  The final submit waits
+        for a slot - that wait is already exposed fetch time."""
+        if self._error is not None:
+            return False          # surfaced by finish(); stop streaming
+        if final:
+            # the final snapshot must stream; a blocked wait here IS
+            # exposed fetch time and is recorded as such
+            t_wait = time.perf_counter()
+            self._slots.acquire()
+            self.final_wait_s = time.perf_counter() - t_wait
+        elif not self._slots.acquire(blocking=False):
+            self.skipped += 1
+            return False
+        try:
+            q_dev, scale_dev = self._mean_fn(acc, self._inv_count)
+            job = _StreamJob(
+                mean_started=quant8_start(q_dev, scale_dev,
+                                          self._n_slices),
+                mean_shape=tuple(q_dev.shape), final=final)
+            if self._sd_fn is not None and sq_acc is not None:
+                qsd, ssd = self._sd_fn(acc, sq_acc, self._inv_count,
+                                       self._bessel)
+                job.sd_started = quant8_start(qsd, ssd, self._n_slices)
+                job.sd_shape = tuple(qsd.shape)
+        except BaseException:
+            # the slot must not leak: a later FINAL submit blocks on it
+            self._slots.release()
+            raise
+        self.snapshots += 1
+        self._queue.put(job)
+        return True
+
+    def finish(self) -> dict:
+        """Join the drain (the caller times this join: it is the exposed
+        fetch) and return the landed result + stream telemetry.  Raises
+        the worker's stored failure, if any - callers fall back to the
+        post-hoc fetch (the carry is still alive)."""
+        self._close()
+        if self._error is not None:
+            e, self._error = self._error, None
+            raise e
+        return {
+            "q8": self.land_mean, "scales": self.mean_scale,
+            "sd_q8": self.land_sd if self.sd_scale is not None else None,
+            "sd_scales": self.sd_scale,
+            "final_landed": self.final_landed,
+            "snapshots": self.snapshots, "skipped": self.skipped,
+            "final_wait_s": self.final_wait_s,
+            "chunk_fetch_s": list(self.chunk_fetch_s),
+        }
+
+    def abort(self) -> None:
+        """Exception path: stop the worker and drop queued snapshots
+        without surfacing drain errors (the fit is already failing)."""
+        self._close()
+        self._error = None
+
+    def _close(self) -> None:
+        if not self._finished:
+            self._finished = True
+            self._queue.put(None)
+            self._worker.join()
+
+    # -- worker side -------------------------------------------------
+
+    def _drain_loop(self) -> None:
+        while True:
+            job = self._queue.get()
+            if job is None:
+                return
+            try:
+                if self._error is None:
+                    self._drain_one(job)
+            except BaseException as e:  # surfaced by finish()
+                self._error = e
+            finally:
+                self._slots.release()
+
+    def _drain_one(self, job: _StreamJob) -> None:
+        t0 = time.perf_counter()
+        slices, scale_dev = job.mean_started
+        quant8_drain(slices, job.mean_shape, out=self.land_mean)
+        # owned copy while the device array is alive: np.asarray of a
+        # CPU-backed jax array may alias the device buffer, and the
+        # landing must survive any later delete()/donation of it
+        self.mean_scale = np.array(scale_dev, np.float32, copy=True)  # dcfm: ignore[DCFM801] - drain half: async was dispatched in submit/quant8_start
+        if job.sd_started is not None:
+            sd_slices, sd_scale_dev = job.sd_started
+            quant8_drain(sd_slices, job.sd_shape, out=self.land_sd)
+            self.sd_scale = np.array(sd_scale_dev, np.float32, copy=True)  # dcfm: ignore[DCFM801] - drain half: async was dispatched in submit/quant8_start
+        if job.final:
+            self.final_landed = True
+        self.chunk_fetch_s.append(time.perf_counter() - t0)
+
+
+@dataclasses.dataclass
+class ChainRunResult:
+    """Everything the chunk loop hands back to ``api.fit``'s epilogue."""
+
+    carry: Any
+    stats: Any
+    executed: int
+    traces: list
+    chunk_seconds: list
+    done: int
+    acc_start: int
+    checkpoint_error: Optional[str]
+    rewinds: int
+    trace0: int
+    streamer: Optional[StreamingFetcher]
+
+
+def run_chain(*, cfg, model, run, sched, phase: dict, multiproc: bool,
+              mesh, k_init, k_chain, fingerprint,
+              init_fn, chunk_fns, Yd, commit_fn=None,
+              streamer_factory: Optional[Callable] = None
+              ) -> ChainRunResult:
+    """The host-side chunk loop.  ``chunk_fns(ni, model)`` -> the jitted
+    chunk callable for a scan of ``ni`` iterations under ``model`` - the
+    base ModelConfig, or the sentinel's jitter-escalated variant after a
+    rewind.  ``streamer_factory(acc_start)`` (optional) builds the
+    :class:`StreamingFetcher` once the resume point is known; it is fed
+    a snapshot at every chunk boundary and handed to the caller inside
+    the result for the final join."""
+    rctx = ResumeContext(cfg=cfg, fingerprint=fingerprint,
+                         multiproc=multiproc, k_init=k_init)
+    chunk = run.chunk_size or run.total_iters
+
+    def _poison_carry(c):
+        # deterministic chaos only (faults op "poison_state"): simulate an
+        # on-device divergence by NaN-ing the loadings; the NEXT chunk's
+        # health reduction trips the sentinel exactly as a real blow-up
+        # would
+        nan = jnp.float32(jnp.nan)
+        return c._replace(
+            state=dataclasses.replace(c.state, Lambda=c.state.Lambda * nan))
+
+    t_init = time.perf_counter()
+    carry, done, acc_start = (resume_state_multiproc if multiproc
+                              else resume_state)(rctx, init_fn, Yd)
+    if commit_fn is not None and done:
+        # Commit a RESUMED carry into device-OWNED buffers before the
+        # first chunk call.  Two independent reasons, both load-
+        # bearing:
+        #
+        # 1. Lifetime.  load_checkpoint returns host numpy leaves,
+        #    and on the CPU backend jax's array ingestion can
+        #    zero-copy ALIAS a (suitably aligned) numpy buffer
+        #    without keeping the numpy array alive.  The loader's
+        #    arrays die when this rebind drops them, so the chain
+        #    would compute on freed heap - garbage Sigma when
+        #    lucky, glibc abort ("corrupted size vs. prev_size") /
+        #    SIGSEGV when not.  This was the process-killing crash
+        #    at the mesh checkpoint-resume tests in tier-1.  The
+        #    commit therefore runs a jitted COPY (jnp.copy per
+        #    leaf): jit outputs are freshly allocated XLA-owned
+        #    buffers by construction, while the numpy inputs stay
+        #    referenced for the duration of the call.
+        #
+        # 2. Signature stability.  Feeding host numpy leaves
+        #    straight into the jitted chunk presents an uncommitted
+        #    argument signature that differs from the committed
+        #    carry every fresh start uses, forcing a full recompile
+        #    of the chunk program on every resume.
+        carry = commit_fn(carry)
+    jax.block_until_ready(carry)
+    phase["init_s"] = time.perf_counter() - t_init
+    stats = None
+    traces = []
+    chunk_secs = []
+    executed = run.total_iters - done
+    # Write-behind checkpointing: each chunk-boundary save snapshots
+    # the carry on device and fetches/writes in a background thread,
+    # so the next chunk's compute overlaps the save instead of
+    # stalling on it.  checkpoint_s is the CHAIN-VISIBLE cost only
+    # (snapshot dispatch + any join on a still-running previous save
+    # + the final durability join); the hidden background fetch rides
+    # the device->host link concurrently with compute.
+    writer = AsyncCheckpointWriter() if cfg.checkpoint_path else None
+    save_fn = (save_checkpoint_multiprocess if multiproc
+               else save_checkpoint)
+    light_mode = cfg.checkpoint_mode == "light"
+    # cadence: an int saves every k-th boundary; "auto" starts at 1 and
+    # re-sizes itself from the FIRST completed save's measured drain so
+    # that one save's hidden fetch+write fits inside the compute it
+    # overlaps (the VERDICT-r4 18x e2e inflation was exactly a cadence
+    # shorter than the drain).
+    cadence = cfg.checkpoint_every_chunks
+    auto_cadence = cadence == "auto"
+    if auto_cadence:
+        cadence = 1
+    since_save, saves_done, ck_error = 0, 0, None
+
+    def _save_failure(e, last):
+        """The ONE home of the save-failure policy: before the final
+        boundary a broken save re-raises (resume-from-last-checkpoint
+        is what the feature is for - fail fast, lose one chunk); once
+        the chain is complete it must never be discarded for a
+        save-only error, so the failure downgrades to a warning +
+        FitResult.checkpoint_error."""
+        nonlocal ck_error
+        if not last:
+            raise e
+        import warnings
+        warnings.warn(
+            f"checkpoint save failed: {e!r}; results are returned "
+            "but the run is NOT resumable from its end", RuntimeWarning)
+        ck_error = repr(e)
+    # Deterministic fault harness (resilience/faults.py): None outside
+    # chaos runs - every hook below is then skipped at one truthiness
+    # check.
+    plan = fault_plan()
+    # Divergence sentinel (FitConfig.sentinel; resilience/sentinel.py):
+    # host-side policy over the per-chunk non-finite reductions the
+    # device already computes.  "auto" resolves to rewind when there
+    # is a checkpoint to rewind to (single-process - a collective
+    # rewind would need its own unanimity protocol), abort otherwise.
+    s_mode = cfg.sentinel
+    if s_mode == "auto":
+        s_mode = ("rewind" if cfg.checkpoint_path and not multiproc
+                  else "abort")
+    elif s_mode == "rewind" and multiproc:
+        import warnings
+        warnings.warn(
+            "sentinel='rewind' is not supported on multi-process "
+            "runs (a collective rewind needs its own unanimity "
+            "protocol); degrading to 'abort' - a divergence will "
+            "raise ChainDivergedError instead of rewinding",
+            RuntimeWarning)
+        s_mode = "abort"
+    sentinel = None
+    if s_mode in ("abort", "rewind") and executed:
+        # baseline: historical non-finite counts a RESUMED carry may
+        # already hold - only NEW divergence trips.  The health panel is
+        # tiny; a sync fetch here costs nothing and runs once.
+        h_src = (replicate_jit(mesh)(carry.health) if multiproc
+                 else carry.health)
+        h = jax.device_get(h_src)  # dcfm: ignore[DCFM801] - one-off KB-sized health panel before the loop starts
+        sentinel = DivergenceSentinel(
+            s_mode, max_rewinds=cfg.sentinel_max_rewinds,
+            baseline_nonfinite=float(np.asarray(h[..., 3]).sum()),
+            base_jitter=model.ridge_jitter)
+    m_active = model
+    # local binding: a rewind re-lineages the chain key for THIS run
+    # only (fold_in below); the fit-level k_chain closure must stay
+    # untouched
+    key_chain = k_chain
+    rewind_template = None
+    # global iteration the TRACE array starts at: `done` unless a
+    # rewind falls back to a retained checkpoint older than the
+    # resume point (then the re-run traces start earlier, and the
+    # diagnostics' post-burn-in slice must follow)
+    trace0 = done
+    it_now = done                 # global iteration at chunk boundaries
+    # Streamed fetch (StreamingFetcher): built once the resume point is
+    # known (the final window divisor depends on acc_start); a no-op
+    # resume (executed == 0) never streams - the epilogue's post-hoc
+    # fetch serves it.
+    streamer = (streamer_factory(acc_start)
+                if streamer_factory is not None and executed else None)
+    queue_ = chunk_schedule(executed, chunk)
+    qi = 0
+    try:
+        while qi < len(queue_):
+            ni = queue_[qi]
+            qi += 1
+            tc = time.perf_counter()
+            carry, stats, trace = chunk_fns(ni, m_active)(
+                key_chain, Yd, carry, sched)
+            trace_host = np.asarray(trace)  # dcfm: ignore[DCFM801] - per-chunk trace rows are KBs; an async drain would buy nothing
+            chunk_secs.append(time.perf_counter() - tc)
+            it_now += ni
+            traces.append((it_now - ni, trace_host))
+            last = qi == len(queue_)
+            if sentinel is not None and sentinel.tripped(stats):
+                reloaded = None
+                if sentinel.mode == "rewind":
+                    if writer is not None:
+                        try:
+                            writer.wait()     # no racing an in-flight save
+                        except Exception:  # dcfm: ignore[DCFM601] - a failed save of a garbage carry is moot mid-rewind
+                            pass   # a failed save is moot mid-rewind
+                    if rewind_template is None:
+                        rewind_template = jax.eval_shape(init_fn, k_init,
+                                                         Yd)
+                    reloaded = rewind_source(rctx, rewind_template)
+                if reloaded is None:
+                    raise ChainDivergedError(
+                        "chain produced non-finite values in the chunk "
+                        f"ending at iteration {it_now}"
+                        + (" and no usable checkpoint exists to rewind to"
+                           if sentinel.mode == "rewind"
+                           else " (sentinel mode 'abort')"),
+                        iteration=it_now, rewinds=sentinel.rewinds)
+                sentinel.record_rewind(it_now)   # raises past the budget
+                bad = carry
+                carry, it_now, acc_start = reloaded
+                trace0 = min(trace0, it_now)
+                jax.tree.map(
+                    lambda a: a.delete() if isinstance(a, jax.Array)
+                    else None, bad)
+                if commit_fn is not None:
+                    carry = commit_fn(carry)
+                # drop the poisoned chunks' traces, re-lineage the chain
+                # key (the retry must not deterministically re-enter the
+                # same blow-up) and escalate the ridge jitter; the resumed
+                # schedule re-chunks the remaining iterations.  The
+                # stream's window divisor follows the moved acc_start
+                # (stale queued snapshots are superseded, never summed).
+                traces = [(s, t) for s, t in traces if s < it_now]
+                key_chain = jax.random.fold_in(key_chain, sentinel.rewinds)
+                m_active = dataclasses.replace(
+                    m_active, ridge_jitter=sentinel.escalated_jitter())
+                if streamer is not None:
+                    streamer.reset_window(acc_start)
+                queue_ = chunk_schedule(run.total_iters - it_now, chunk)
+                qi = 0
+                since_save = 0
+                continue
+            if streamer is not None:
+                # Boundary snapshot stream: dispatched BEFORE the
+                # checkpoint snapshot/save so the panel asyncs are first
+                # in the FIFO link queue.  Burn-in boundaries (no saved
+                # draws yet) skip - an all-zero snapshot is wasted link.
+                draws_so_far = (
+                    num_saved_draws(it_now, run.burnin, run.thin)
+                    - num_saved_draws(acc_start, run.burnin, run.thin))
+                if last or draws_so_far > 0:
+                    fault_event("stream_submit")
+                    try:
+                        streamer.submit(carry.sigma_acc,
+                                        carry.sigma_sq_acc, final=last)
+                    except Exception as e:
+                        # the stream is an overlap OPTIMIZATION: a
+                        # dispatch failure must never kill an otherwise
+                        # healthy chain - disable streaming and let the
+                        # epilogue's post-hoc fetch serve the result
+                        # (the same policy a drain failure gets via
+                        # finish()'s fallback)
+                        import warnings
+                        warnings.warn(
+                            f"streamed fetch dispatch failed ({e!r}); "
+                            "disabling streaming for this run - the "
+                            "post-hoc fetch will serve the result",
+                            RuntimeWarning)
+                        streamer.abort()
+                        streamer = None
+                    fault_event("stream_submit_post")
+            if writer is None:
+                if plan is not None:
+                    plan.maybe_kill(it_now, done, "pre_save")
+                    plan.maybe_kill(it_now, done, "post_save")
+                    if plan.poison_due(it_now, done):
+                        carry = _poison_carry(carry)
+                continue
+            if writer.poll_error() is not None and not last:
+                # Durability broke mid-run (disk full, ...): fail at the
+                # NEXT chunk boundary - one chunk of lost compute instead
+                # of finishing the whole chain and aborting at the end
+                # (resume-from-last-checkpoint is exactly what the feature
+                # is for).  Once the LAST chunk has computed, though, the
+                # chain is complete and must not be discarded for a
+                # save-only error - the final wait() below downgrades the
+                # failure to a warning + FitResult.checkpoint_error.
+                writer.wait()   # joins and re-raises the stored error
+            if auto_cadence and writer.last_save_seconds is not None:
+                # steady-state chunk time: exclude chunk 0, which carries
+                # the jit compile on a cold cache and would undersize the
+                # cadence exactly when the link is slowest; 1.5x headroom
+                # so a due save's drain finishes comfortably inside the
+                # cadence.  Re-sized at every boundary from the LATEST
+                # completed save, so a later (bigger/slower) save updates
+                # it.
+                steady = (chunk_secs[1:] if len(chunk_secs) > 1
+                          else chunk_secs)
+                mean_chunk = sum(steady) / len(steady)
+                cadence = max(1, int(np.ceil(
+                    1.5 * writer.last_save_seconds
+                    / max(mean_chunk, 1e-9))))
+            since_save += 1
+            if plan is not None:
+                # "pre_save" kills land BEFORE this boundary's save, so the
+                # checkpoint never advances past the trigger - the poison-
+                # iteration drill (resilience/faults.py)
+                plan.maybe_kill(it_now, done, "pre_save")
+            # the last boundary always saves (so a finished run resumes as
+            # a no-op under mode="full", or hands its exact state to a
+            # chain extension under "light").  A still-running previous
+            # save DEFERS a non-final due save to the next boundary
+            # instead of join-blocking the chain behind the link - so even
+            # a mis-sized cadence (or a periodic full save in light mode)
+            # degrades to a later save, never to a stall.
+            saved_this_boundary = False
+            if (since_save >= cadence and not writer.busy()) or last:
+                full_due = (light_mode and cfg.checkpoint_full_every > 0
+                            and (saves_done + 1)
+                            % cfg.checkpoint_full_every == 0)
+                # Full saves in light mode go to the .full SIDECAR: the
+                # next light save atomically replaces checkpoint_path, so
+                # writing the full snapshot there would void the
+                # bounds-the-loss guarantee one save later.  Resume
+                # prefers the sidecar whenever it preserves more draws
+                # than the light restart window - _try_full_sidecar
+                # single-process, the unanimity-gated collective check in
+                # resume_state_multiproc on pods.
+                # EXCEPT on the last boundary: checkpoint_path must always
+                # receive the final state (a stale light file there would
+                # mis-resume a finished run), and a full-due final save is
+                # simply written full to the main path - no later light
+                # save exists to overwrite it.
+                target = (cfg.checkpoint_path + ".full"
+                          if full_due and not last
+                          else cfg.checkpoint_path)
+                t_ck = time.perf_counter()
+                try:
+                    writer.submit(save_fn, target, carry, cfg,
+                                  fingerprint=fingerprint,
+                                  state_only=light_mode and not full_due,
+                                  acc_start=acc_start,
+                                  keep_last=cfg.checkpoint_keep_last)
+                    saved_this_boundary = True
+                except Exception as e:
+                    # submit joins the previous save; see _save_failure
+                    _save_failure(e, last)
+                phase["checkpoint_s"] += time.perf_counter() - t_ck
+                since_save = 0
+                saves_done += 1
+            if plan is not None:
+                # chaos determinism: a "post_save" kill must observe a
+                # DURABLE save, so it only arms at a boundary whose save
+                # actually happened (cadence > 1 skips boundaries; the
+                # kill then lands at the NEXT saving boundary) - and the
+                # write-behind writer is flushed first (a background
+                # failure surfaces here exactly as the poll_error path
+                # would, downgraded on the final boundary only)
+                if saved_this_boundary:
+                    try:
+                        writer.wait()
+                    except Exception as e:
+                        _save_failure(e, last)
+                    plan.maybe_kill(it_now, done, "post_save")
+                if plan.poison_due(it_now, done):
+                    carry = _poison_carry(carry)
+        if writer is not None:
+            # the last save must be durable before fit() returns; a failure
+            # here must not discard a finished chain's results.  The
+            # streamed final snapshot's asyncs were dispatched BEFORE this
+            # join, so its panels ride the link concurrently with the
+            # checkpoint drain.
+            t_ck = time.perf_counter()
+            try:
+                writer.wait()
+            except Exception as e:
+                _save_failure(e, True)    # chain complete: downgrade
+            phase["checkpoint_s"] += time.perf_counter() - t_ck
+    except BaseException:
+        # the chain is failing: the background drain must not outlive it
+        # blocked on a queue nobody will close
+        if streamer is not None:
+            streamer.abort()
+        raise
+    return ChainRunResult(
+        carry=carry, stats=stats, executed=executed,
+        traces=[t for _, t in traces], chunk_seconds=chunk_secs,
+        done=done, acc_start=acc_start, checkpoint_error=ck_error,
+        rewinds=sentinel.rewinds if sentinel is not None else 0,
+        trace0=trace0, streamer=streamer)

@@ -1,0 +1,465 @@
+"""Checkpoint resume gates: single-process, multi-process, and rewind.
+
+The chunk loop (runtime/pipeline.py) starts every chain through one of
+the two resume entry points here:
+
+* :func:`resume_state` - single-process: discovery picks the most
+  progressed source among the plain file and any ``.procK-of-N`` set,
+  compatibility is checked BEFORE the payload loads, and the ``.full``
+  sidecar (``checkpoint_full_every``) wins over a light resume whenever
+  it preserves more saved draws;
+* :func:`resume_state_multiproc` - multi-host SPMD: the resume decision
+  is COLLECTIVE and source-signature-exact (a kill can land between two
+  processes' saves; resuming mismatched states would deadlock the SPMD
+  collectives), with the sidecar preference behind TWO unanimity gates
+  and the ``fault_event`` crash seams the randomized fuzz harness
+  (resilience/faults.py) kills inside;
+* :func:`rewind_source` - the divergence sentinel's rewind target: the
+  newest compatible, CRC-clean retained generation.
+
+All functions take a :class:`ResumeContext` - the slice of ``fit()``'s
+state the gates need - so the machinery is testable without a fit.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+from dcfm_tpu.config import FitConfig
+from dcfm_tpu.models.sampler import num_saved_draws
+from dcfm_tpu.resilience.faults import fault_event
+from dcfm_tpu.utils.checkpoint import (
+    checkpoint_compatible, discover_checkpoint, load_checkpoint,
+    load_checkpoint_multiprocess, load_checkpoint_resharded, proc_path,
+    read_checkpoint_meta, retained_checkpoints)
+
+
+@dataclasses.dataclass
+class ResumeContext:
+    """The slice of fit() state the resume gates close over: the config,
+    the data fingerprint the checkpoint must match, whether this is a
+    multi-process SPMD run, and the init key (shape-only uses)."""
+
+    cfg: FitConfig
+    fingerprint: Optional[str]
+    multiproc: bool
+    k_init: Any
+
+
+def sidecar_esig(elig) -> np.ndarray:
+    """Collective unanimity signature of a sidecar eligibility result
+    (``_sidecar_eligibility``'s ``(source, iteration, acc_start)``, or
+    None): ``[iteration, kind, writer_count, acc_start]`` as int64, all
+    -1 when ineligible.  ``acc_start`` is the load-bearing 4th element
+    (ADVICE r5): with per-host local disks two processes can hold
+    sidecars agreeing on iteration/kind/count whose accumulation
+    windows started at DIFFERENT iterations (mixed stale files after
+    repeated light resumes); committing those would divide each host's
+    raw-sum accumulators by a different n_saved and return inconsistent
+    Sigma with no error.  The gate must refuse the pair instead."""
+    if elig is None:
+        return np.asarray([-1, -1, -1, -1], np.int64)
+    source, it, acc0 = elig
+    return np.asarray(
+        [it, 0 if source[0] == "plain" else 1,
+         -1 if source[0] == "plain" else source[1][0], acc0], np.int64)
+
+
+def _local_set_source(path):
+    """Per-host local-disk fallback, shared by the main multi-process
+    resume and the sidecar eligibility check: fabricate a "local-set"
+    source from THIS process's own ``.procK-of-N`` file.  "local-set",
+    not "set": the peer files were never verified to exist on this
+    host - the loader's fast path treats it like a set (it only reads
+    the local file) while the reshard branch rejects the kind rather
+    than crashing on missing peers; callers additionally gate on
+    collective agreement.  -> (source, this process's file path), or
+    (None, None) when no local file exists."""
+    n = jax.process_count()
+    mine = proc_path(path, jax.process_index(), n)
+    if not os.path.exists(mine):
+        return None, None
+    it = int(read_checkpoint_meta(mine)["iteration"])
+    return ("local-set",
+            (n, [proc_path(path, i, n) for i in range(n)], it)), mine
+
+
+def _sidecar_eligibility(ctx: ResumeContext, light_kept: int):
+    """The ONE home of the "does the .full sidecar beat the light
+    resume" rule (checkpoint_full_every): discover the sidecar - a
+    plain file or a ``.procK-of-N`` set at ``checkpoint_path +
+    ".full"``, falling back to this process's own set file when peers
+    live on per-host local disks - and return ``(source, iteration,
+    acc_start)`` iff it is full, compatible, and preserves MORE saved
+    draws than ``light_kept`` (the light restart window; 0 for a
+    finished run).  None otherwise; never raises.  Resuming the
+    sidecar re-runs the tail from its earlier iteration - more
+    compute - but keeps every draw its accumulators already hold,
+    which is the point of maintaining it."""
+    cfg, run = ctx.cfg, ctx.cfg.run
+    side = cfg.checkpoint_path + ".full"
+    try:
+        source = discover_checkpoint(side, prefer_plain=not ctx.multiproc)
+        meta_path = None
+        if source is not None:
+            meta_path = side if source[0] == "plain" else source[1][1][0]
+        elif ctx.multiproc:
+            # per-host local disks: the shared local-set fallback; the
+            # unanimity gate in the caller keeps a partially present
+            # set from ever being acted on
+            source, meta_path = _local_set_source(side)
+        if source is None:
+            return None
+        smeta = read_checkpoint_meta(meta_path)
+        if (smeta.get("state_only")
+                or checkpoint_compatible(smeta, cfg, ctx.fingerprint)
+                is not None):
+            return None
+        s_acc0 = int(smeta.get("acc_start", 0))
+        s_kept = (num_saved_draws(run.total_iters, run.burnin, run.thin)
+                  - num_saved_draws(s_acc0, run.burnin, run.thin))
+        if s_kept <= light_kept:
+            return None
+        return source, int(smeta["iteration"]), s_acc0
+    except Exception:  # dcfm: ignore[DCFM601] - eligibility probe: any failure = sidecar not usable
+        return None
+
+
+def _try_full_sidecar(ctx: ResumeContext, template, light_kept: int):
+    """Single-process sidecar load -> (carry, done, acc_start) or
+    None; eligibility via :func:`_sidecar_eligibility`."""
+    elig = _sidecar_eligibility(ctx, light_kept)
+    if elig is None:
+        return None
+    source, _, s_acc0 = elig
+    side = ctx.cfg.checkpoint_path + ".full"
+    try:
+        if source[0] == "plain":
+            carry, smeta = load_checkpoint(side, template)
+        else:
+            carry, smeta = load_checkpoint_resharded(source[1][1],
+                                                     template)
+        return carry, int(smeta["iteration"]), s_acc0
+    except Exception:  # dcfm: ignore[DCFM601] - sidecar load is best-effort; caller falls back to light resume
+        return None
+
+
+def resume_state(ctx: ResumeContext, init_fn, Yd):
+    """-> (carry, done, acc_start).  resume=True demands a compatible
+    checkpoint; resume="auto" (elastic recovery) falls back to a fresh
+    start when the checkpoint is missing or incompatible.
+
+    A plain single-process file is preferred; absent that, a complete
+    ``path.procK-of-N`` set written by an N-process run is resharded
+    onto this process (topology-flexible resume - an N-host pod's
+    chain continues on one host, checkpoint.load_checkpoint_resharded).
+    """
+    cfg, run = ctx.cfg, ctx.cfg.run
+    auto = cfg.resume == "auto"
+    source = None
+    if cfg.resume:
+        # One discovery picks the most-progressed source among the
+        # plain file and any .procK-of-N set (checkpoint.
+        # discover_checkpoint); in auto mode an unreadable candidate
+        # is just another reason to start fresh.
+        try:
+            source = discover_checkpoint(cfg.checkpoint_path,
+                                         prefer_plain=True)
+        except Exception:
+            if not auto:
+                raise
+    if source is not None:
+        # Compatibility first (friendly refusal on config/data mismatch),
+        # then load into an eval_shape template - the real init never
+        # runs, so no wasted compile and no doubled accumulator peak.
+        # In auto mode an unreadable/old-format/corrupt checkpoint is
+        # just another reason to start fresh - the elastic-recovery
+        # contract must survive library upgrades, not crash-loop on
+        # them.
+        kind, found = source
+        try:
+            meta = read_checkpoint_meta(
+                cfg.checkpoint_path if kind == "plain" else found[1][0])
+            reason = checkpoint_compatible(meta, cfg, ctx.fingerprint)
+        except Exception:
+            if not auto:
+                raise
+            reason = "unreadable or incompatible checkpoint"
+        if reason is not None and not auto:
+            raise ValueError(f"refusing to resume: {reason}")
+        if reason is None:
+            # the payload load can fail on its own (corrupt leaf data
+            # behind a healthy meta entry) - same auto-mode fallback
+            try:
+                template = jax.eval_shape(init_fn, ctx.k_init, Yd)
+                carry, meta = (
+                    load_checkpoint(cfg.checkpoint_path, template)
+                    if kind == "plain" else
+                    load_checkpoint_resharded(found[1], template))
+                it = int(meta["iteration"])
+                if meta.get("state_only"):
+                    # Light checkpoint: accumulation restarts here,
+                    # keeping only the draws of the restarted window.
+                    # The .full sidecar (checkpoint_full_every) wins
+                    # whenever its accumulators preserve MORE draws -
+                    # including the window = 0 case (finished run, or
+                    # only tail iterations past the last thin point
+                    # remain), where a light resume would silently
+                    # return Sigma = 0.
+                    window = (num_saved_draws(run.total_iters,
+                                              run.burnin, run.thin)
+                              - num_saved_draws(it, run.burnin,
+                                                run.thin))
+                    side = _try_full_sidecar(ctx, template,
+                                             max(window, 0))
+                    if side is not None:
+                        return side
+                    if window <= 0:
+                        raise ValueError(
+                            "resuming a state-only (light) checkpoint "
+                            f"at iteration {it}: no further draws "
+                            "would be saved and its covariance "
+                            "accumulators were not stored, so there "
+                            "is nothing to report - extend run.mcmc "
+                            "to continue the chain, or use "
+                            "checkpoint_mode='full' / "
+                            "checkpoint_full_every for recoverable "
+                            "accumulators")
+                    return carry, it, it
+                return carry, it, int(meta.get("acc_start", 0))
+            except Exception:
+                if not auto:
+                    raise
+    elif cfg.resume and not auto:
+        raise FileNotFoundError(
+            f"resume=True but no checkpoint at {cfg.checkpoint_path} "
+            "(or any .procK-of-N set)")
+    return init_fn(ctx.k_init, Yd), 0, 0
+
+
+def resume_state_multiproc(ctx: ResumeContext, init_fn, Yd):
+    """Multi-host resume: each process loads its own shard-local file
+    (utils/checkpoint.proc_path) into the shardings of a fresh init.
+
+    The resume decision is COLLECTIVE and iteration-exact: every
+    process reports the iteration its file holds (-1 = not loadable)
+    and the chain resumes only if ALL processes report the SAME
+    iteration - a kill can land between two processes' saves, leaving
+    files one chunk apart, and resuming from mismatched iterations
+    would deadlock the SPMD collectives.  No process raises before the
+    gather (a pre-collective raise would hang the peers inside it);
+    strict-mode failures surface as a local error after it.
+    """
+    cfg, run = ctx.cfg, ctx.cfg.run
+    auto = cfg.resume == "auto"
+    carry0 = init_fn(ctx.k_init, Yd)
+    loaded, failure = None, None
+    template = None
+    if cfg.resume:
+        # One discovery picks the most-progressed source among any
+        # .procK-of-N set and a plain single-process file
+        # (checkpoint.discover_checkpoint); a set written at THIS
+        # process count resumes shard-locally, anything else is
+        # resharded (topology-flexible elastic recovery; needs a
+        # shared checkpoint filesystem).  The rule is deterministic
+        # from file contents, so all processes agree, and the SAME
+        # source object flows into the loader - the set that was
+        # compatibility-checked is the set that loads.
+        meta_path = None
+        try:
+            source = discover_checkpoint(cfg.checkpoint_path,
+                                         prefer_plain=False)
+            if source is not None:
+                meta_path = (cfg.checkpoint_path
+                             if source[0] == "plain" else source[1][1][0])
+        except Exception as e:
+            source = None
+            failure = f"checkpoint unreadable: {e}"
+        if source is None:
+            # Per-host local checkpoint disks: discovery needs the
+            # whole set visible, but the SAME-topology fast path only
+            # ever reads this process's own file - fall back to it.
+            # Every process sees the same condition (each its own
+            # file), and the collective iteration agreement below
+            # still refuses mixed states.
+            try:
+                source, lpath = _local_set_source(cfg.checkpoint_path)
+                if source is not None:
+                    meta_path, failure = lpath, None
+            except Exception as e:
+                failure = failure or f"checkpoint unreadable: {e}"
+        if source is not None:
+            try:
+                meta = read_checkpoint_meta(meta_path)
+                reason = checkpoint_compatible(meta, cfg, ctx.fingerprint)
+                if reason is not None:
+                    failure = f"refusing to resume: {reason}"
+                else:
+                    # free the init buffers before the load materializes
+                    # the checkpointed copies - no doubled accumulator
+                    # peak
+                    template = jax.tree.map(
+                        lambda a: jax.ShapeDtypeStruct(
+                            a.shape, a.dtype, sharding=a.sharding),
+                        carry0)
+                    jax.tree.map(lambda a: a.delete(), carry0)
+                    carry0 = None
+                    loaded = load_checkpoint_multiprocess(
+                        cfg.checkpoint_path, template, source=source)
+            except Exception as e:
+                failure = f"checkpoint unreadable: {e}"
+        elif failure is None:
+            failure = (f"no checkpoint at {cfg.checkpoint_path} "
+                       "(or any .procK-of-N set)")
+
+    from jax.experimental import multihost_utils
+    # Agreement is on the full SOURCE SIGNATURE (iteration, kind,
+    # writer count), not the iteration alone: with per-host local
+    # disks two processes can resolve different checkpoint sources
+    # whose iterations coincide (e.g. a stale set from an earlier
+    # topology beside the current one) - same-iteration-different-
+    # source would still be a mixed chain state.
+    my_iter = int(loaded[1]["iteration"]) if loaded is not None else -1
+    kind_code = -1 if loaded is None else (0 if source[0] == "plain"
+                                           else 1)
+    src_count = (-1 if loaded is None or source[0] == "plain"
+                 else source[1][0])
+    # state_only is part of the signature: the light-resume branch
+    # below runs an EXTRA collective (the sidecar gates), so two
+    # processes that agree on iteration/kind/count but disagree on
+    # light-vs-full (e.g. per-host disks holding files from runs with
+    # different checkpoint_mode) must NOT pass this gate - one would
+    # enter the sidecar allgather while the other entered the chain.
+    so_code = (-1 if loaded is None
+               else int(bool(loaded[1].get("state_only"))))
+    my_sig = np.asarray([my_iter, kind_code, src_count, so_code],
+                        np.int64)
+    # fault_event: crash-point seams for the randomized fuzz harness
+    # (resilience/faults.py kill_event; no-ops without a plan).  A
+    # kill between two collectives on ONE host is exactly the state
+    # that leaves peers blocked inside the next allgather - the pod
+    # supervisor's coordinated stop must reap them.
+    fault_event("resume_gate")
+    all_sigs = multihost_utils.process_allgather(my_sig)
+    fault_event("resume_gate_post")
+    agree = my_iter >= 0 and bool(np.all(all_sigs == my_sig[None, :]))
+    if agree:
+        meta = loaded[1]
+        if meta.get("state_only"):
+            window = (num_saved_draws(run.total_iters, run.burnin,
+                                      run.thin)
+                      - num_saved_draws(my_iter, run.burnin, run.thin))
+            # Sidecar preference (checkpoint_full_every), collective
+            # with TWO unanimity gates.  Gate 1: every process
+            # evaluates the sidecar deterministically
+            # (_sidecar_eligibility - the same rule as single-process)
+            # and the switch is considered only if ALL processes saw
+            # the SAME, more-draw-preserving source (a partially
+            # visible, torn, or absent sidecar on ANY process keeps
+            # the agreed light resume everywhere).  Gate 2: the
+            # PAYLOAD load must succeed on every process before any
+            # commits - a truncated shard file on one host must not
+            # leave it raising while peers enter the chain (that
+            # would deadlock the first collective); on any failure
+            # all processes fall back to the already-loaded light
+            # carry.  The sidecar load transiently holds both carries
+            # (same 2x-accumulator class as the snapshot transient).
+            # The signature includes acc_start (4th element): two
+            # hosts could agree on iteration/kind/count yet hold
+            # sidecars whose accumulation windows started at
+            # different iterations (e.g. mixed stale files after
+            # repeated light resumes) - committing those would
+            # silently divide by inconsistent n_saved divisors.
+            elig = _sidecar_eligibility(ctx, max(window, 0))
+            e_sig = sidecar_esig(elig)
+            fault_event("sidecar_gate")
+            all_e = multihost_utils.process_allgather(e_sig)
+            if (e_sig[0] >= 0
+                    and bool(np.all(all_e == e_sig[None, :]))):
+                fault_event("sidecar_load")
+                s_carry = smeta2 = None
+                try:
+                    s_carry, smeta2 = load_checkpoint_multiprocess(
+                        cfg.checkpoint_path + ".full", template,
+                        source=elig[0])
+                    s_ok = 1
+                except Exception:  # dcfm: ignore[DCFM601] - failure becomes s_ok=0, surfaced via the collective gate
+                    s_ok = 0
+                fault_event("sidecar_commit")
+                all_ok = multihost_utils.process_allgather(
+                    np.asarray([s_ok], np.int64))
+                fault_event("sidecar_commit_post")
+                if bool(np.all(all_ok == 1)):
+                    jax.tree.map(
+                        lambda a: (a.delete()
+                                   if isinstance(a, jax.Array)
+                                   else None), loaded[0])
+                    return (s_carry, int(smeta2["iteration"]),
+                            int(smeta2.get("acc_start", 0)))
+                if s_carry is not None:   # a peer failed: fall back
+                    jax.tree.map(
+                        lambda a: (a.delete()
+                                   if isinstance(a, jax.Array)
+                                   else None), s_carry)
+            if window > 0:
+                return loaded[0], my_iter, my_iter
+            # light checkpoint with an empty restart window and no
+            # unanimously better sidecar: nothing would be
+            # accumulated (see resume_state); raising here is safe -
+            # every process agreed on the source, so all raise
+            # identically
+            if not auto:
+                raise ValueError(
+                    "resuming a state-only (light) checkpoint at "
+                    f"iteration {my_iter}: no further draws would be "
+                    "saved and its covariance accumulators were not "
+                    "stored - extend run.mcmc, or use "
+                    "checkpoint_full_every so a .full sidecar exists")
+        else:
+            return loaded[0], my_iter, int(meta.get("acc_start", 0))
+    if cfg.resume and not auto and not agree:
+        raise ValueError(
+            failure or "resume=True but the per-process checkpoints "
+            "disagree on the resume source "
+            f"({all_sigs.tolist()} as [iteration, kind, count, "
+            "state_only] rows) - "
+            "a crash between two processes' saves, or mixed stale "
+            "files; delete the files or use resume='auto' to restart "
+            "fresh")
+    if loaded is not None:
+        # discarding the load (disagreement, or auto-mode finished-light
+        # fallthrough): free its device buffers BEFORE re-init - the
+        # loader materialized full-size accumulator leaves, and holding
+        # them across init_fn would double the device peak
+        jax.tree.map(
+            lambda a: a.delete() if isinstance(a, jax.Array) else None,
+            loaded[0])
+    if carry0 is None:   # init was freed for a load that was discarded
+        carry0 = init_fn(ctx.k_init, Yd)
+    return carry0, 0, 0
+
+
+def rewind_source(ctx: ResumeContext, template):
+    """Newest compatible, CRC-clean checkpoint among the retained
+    generations (checkpoint_keep_last) - the sentinel's rewind
+    target.  Returns (host carry, iteration, acc_start) or None."""
+    cfg = ctx.cfg
+    for p in retained_checkpoints(cfg.checkpoint_path):
+        try:
+            r_meta = read_checkpoint_meta(p)
+            if checkpoint_compatible(r_meta, cfg, ctx.fingerprint):
+                continue
+            c, r_meta = load_checkpoint(p, template)
+            r_it = int(r_meta["iteration"])
+            if r_meta.get("state_only"):
+                # light file: accumulation restarts at its iteration
+                return c, r_it, r_it
+            return c, r_it, int(r_meta.get("acc_start", 0))
+        except Exception:  # dcfm: ignore[DCFM601] - walk the retention chain: next generation is the handling
+            continue    # corrupt/unreadable generation: try the next
+    return None

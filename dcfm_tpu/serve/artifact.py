@@ -306,6 +306,116 @@ def _write_panels(path: str, name: str, q: np.ndarray) -> None:
         np.ascontiguousarray(q, np.int8).tofile(f)
 
 
+def _build_maps(pre: PreprocessResult, mean_scale, sd_scale) -> dict:
+    """The maps.npz payload - shared by the post-hoc and streamed
+    export paths so both write identical O(p) metadata."""
+    maps = dict(
+        mean_scale=np.asarray(mean_scale, np.float32),
+        col_scale=np.asarray(pre.col_scale, np.float32),
+        col_mean=np.asarray(pre.col_mean, np.float32),
+        perm=np.asarray(pre.perm, np.int64),
+        inv_perm=np.asarray(pre.inv_perm, np.int64),
+        kept_cols=np.asarray(pre.kept_cols, np.int64),
+    )
+    if sd_scale is not None:
+        maps["sd_scale"] = np.asarray(sd_scale, np.float32)
+    return maps
+
+
+def _write_meta_last(path: str, meta: dict) -> None:
+    """meta.json is written LAST and atomically: every partially-written
+    artifact state is unopenable, never garbage behind healthy
+    metadata."""
+    tmp = os.path.join(path, META_FILE + ".tmp")
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(meta, f, indent=1)
+    os.replace(tmp, os.path.join(path, META_FILE))
+
+
+def begin_streamed_artifact(path: str, *, g: int, P: int,
+                            has_sd: bool = False):
+    """Open the panel files of a STREAMED export as writable memmaps -
+    the landing buffers the runtime pipeline's double-buffered drain
+    (runtime/pipeline.StreamingFetcher) commits boundary snapshots
+    into.  Any existing ``meta.json`` is invalidated FIRST, so a crash
+    mid-stream (or an abandoned fit) leaves a directory
+    :meth:`PosteriorArtifact.open` refuses cleanly.  Returns
+    ``(mean_memmap, sd_memmap_or_None)``; pass the landed panels to
+    :func:`finalize_streamed_artifact` once the final snapshot is in.
+    """
+    n_pairs = _num_pairs(g)
+    os.makedirs(path, exist_ok=True)
+    meta_path = os.path.join(path, META_FILE)
+    if os.path.exists(meta_path):
+        os.unlink(meta_path)
+    sd_path = os.path.join(path, SD_PANELS_FILE)
+    if os.path.exists(sd_path):
+        os.unlink(sd_path)     # stale from a prior export, or recreated below
+    mean_path = os.path.join(path, MEAN_PANELS_FILE)
+    if os.path.exists(mean_path):
+        # unlink, never truncate-in-place: a prior streamed FitResult may
+        # still hold a memmap of this inode, and "w+" on the same inode
+        # would rewrite run-1's posterior bytes underneath it.  A fresh
+        # inode leaves the orphaned one alive exactly as long as its
+        # mappings are.
+        os.unlink(mean_path)
+    mean_mm = np.memmap(mean_path,
+                        dtype=np.int8, mode="w+", shape=(n_pairs, P, P))
+    sd_mm = (np.memmap(sd_path, dtype=np.int8, mode="w+",
+                       shape=(n_pairs, P, P)) if has_sd else None)
+    return mean_mm, sd_mm
+
+
+def finalize_streamed_artifact(
+    path: str,
+    *,
+    mean_mm: np.ndarray,
+    mean_scale: np.ndarray,
+    pre: PreprocessResult,
+    sd_mm: Optional[np.ndarray] = None,
+    sd_scale: Optional[np.ndarray] = None,
+    provenance: Optional[dict] = None,
+) -> PosteriorArtifact:
+    """Complete a streamed export: flush the panel memmaps, record the
+    per-panel CRC32s of the landed bytes, and write maps + metadata
+    (meta last, exactly like :func:`write_artifact`).  The panel bytes
+    were landed by the stream, so this costs one O(p) metadata write +
+    a CRC pass - the "fit -> export is free" half of the streaming
+    pipeline.  The resulting artifact is bitwise-identical to a
+    post-hoc ``export_fit_result`` of the same chain (same int8 bits,
+    same scales, same maps)."""
+    n_pairs, P, _ = np.shape(mean_mm)
+    g = pre.num_shards
+    if n_pairs != _num_pairs(g) or g * P != pre.p_used:
+        raise ValueError(
+            f"streamed panels {np.shape(mean_mm)} do not match g={g}, "
+            f"p_used={pre.p_used}")
+    if np.shape(mean_scale) != (n_pairs,):
+        raise ValueError(f"mean_scale must be ({n_pairs},), got "
+                         f"{np.shape(mean_scale)}")
+    if (sd_mm is None) != (sd_scale is None):
+        raise ValueError("sd_mm and sd_scale must be passed together")
+    mean_mm.flush()
+    crc = {"mean": [int(panel_crc32(q)) for q in mean_mm]}
+    if sd_mm is not None:
+        sd_mm.flush()
+        crc["sd"] = [int(panel_crc32(q)) for q in sd_mm]
+    np.savez(os.path.join(path, MAPS_FILE),
+             **_build_maps(pre, mean_scale, sd_scale))
+    _write_meta_last(path, {
+        "format": ARTIFACT_FORMAT,
+        "version": ARTIFACT_VERSION,
+        "g": int(g),
+        "P": int(P),
+        "p_original": int(pre.p_original),
+        "n_pad": int(pre.n_pad),
+        "has_sd": sd_mm is not None,
+        "panel_crc": crc,
+        "provenance": provenance or {},
+    })
+    return PosteriorArtifact.open(path)
+
+
 def write_artifact(
     path: str,
     *,
@@ -365,22 +475,14 @@ def write_artifact(
     if plan:
         plan.after_replace("artifact", os.path.join(path, MEAN_PANELS_FILE),
                            count)
-    maps = dict(
-        mean_scale=np.asarray(mean_scale, np.float32),
-        col_scale=np.asarray(pre.col_scale, np.float32),
-        col_mean=np.asarray(pre.col_mean, np.float32),
-        perm=np.asarray(pre.perm, np.int64),
-        inv_perm=np.asarray(pre.inv_perm, np.int64),
-        kept_cols=np.asarray(pre.kept_cols, np.int64),
-    )
     if sd_q8 is not None:
         if np.shape(sd_q8) != (n_pairs, P, P):
             raise ValueError(f"sd panels {np.shape(sd_q8)} != mean panels "
                              f"({n_pairs}, {P}, {P})")
         _write_panels(path, SD_PANELS_FILE, sd_q8)
-        maps["sd_scale"] = np.asarray(sd_scale, np.float32)
-    np.savez(os.path.join(path, MAPS_FILE), **maps)
-    meta = {
+    np.savez(os.path.join(path, MAPS_FILE),
+             **_build_maps(pre, mean_scale, sd_scale))
+    _write_meta_last(path, {
         "format": ARTIFACT_FORMAT,
         "version": ARTIFACT_VERSION,
         "g": int(g),
@@ -392,11 +494,7 @@ def write_artifact(
         # verified lazily on first touch by the query engine
         "panel_crc": crc,
         "provenance": provenance or {},
-    }
-    tmp = os.path.join(path, META_FILE + ".tmp")
-    with open(tmp, "w", encoding="utf-8") as f:
-        json.dump(meta, f, indent=1)
-    os.replace(tmp, os.path.join(path, META_FILE))
+    })
     return PosteriorArtifact.open(path)
 
 

@@ -213,6 +213,7 @@ def _config_from_json(d: dict) -> FitConfig:
         checkpoint_keep_last=d.get("checkpoint_keep_last", 1),
         sentinel=d.get("sentinel", "auto"),
         sentinel_max_rewinds=d.get("sentinel_max_rewinds", 3),
+        stream_artifact=d.get("stream_artifact"),
     )
 
 
@@ -834,7 +835,7 @@ def load_checkpoint_multiprocess(path: str, carry_like: Any,
                 "to rewrite it in the packed v6 layout")
     if kind == "plain" or found[0] != jax.process_count() or legacy_full:
         if kind == "local-set":
-            # api._resume_state_multiproc fabricates this kind when only
+            # runtime.resume.resume_state_multiproc fabricates this kind when only
             # this process's own file is visible (per-host local disks);
             # the other N-1 paths in it were never verified to exist, so
             # resharding from it would crash on missing files.  The count

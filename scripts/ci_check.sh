@@ -26,6 +26,12 @@ python -m dcfm_tpu.analysis dcfm_tpu/serve/ || exit 1
 echo "== dcfm-lint: resilience subsystem (DCFM6xx robustness) =="
 python -m dcfm_tpu.analysis dcfm_tpu/resilience/ || exit 1
 
+# The runtime pipeline is the async-first chunk loop: a blocking host
+# fetch HERE silently serializes the chain behind the device->host link
+# - the exact wall the streamed double buffer exists to hide (DCFM801).
+echo "== dcfm-lint: runtime pipeline (DCFM801 async-fetch discipline) =="
+python -m dcfm_tpu.analysis dcfm_tpu/runtime/ || exit 1
+
 # Serve tests always run through the crash-isolated lane IN ADDITION to
 # their in-process tier-1 run below: they exercise native assembly +
 # sockets + thread storms, so a native-level abort here must fail ONE
@@ -37,9 +43,14 @@ python -m dcfm_tpu.analysis dcfm_tpu/resilience/ || exit 1
 # crash points through the real supervised CLI, fixed seed - the fuzz
 # harness itself is exercised on every CI run); the full >= 50-point
 # 2-process pod sweep is slow-marked in test_multihost.py.
+# test_runtime_stream.py rides the same lane: its streaming pipeline
+# tests run real background drain threads plus a supervised SIGKILL
+# inside the stream window - a runaway child or a hung drain must fail
+# ONE file with its signal named, not wedge the suite.
 echo "== serve + chaos tests incl. crash-fuzz smoke (crash-isolated lane) =="
 for f in tests/test_serve_artifact.py tests/test_serve_engine.py \
-         tests/test_serve_server.py tests/test_resilience.py; do
+         tests/test_serve_server.py tests/test_resilience.py \
+         tests/test_runtime_stream.py; do
     JAX_PLATFORMS=cpu python -m dcfm_tpu.analysis.isolate "$f" \
         -- -q -m 'not slow' --continue-on-collection-errors \
         -p no:cacheprovider -p no:xdist -p no:randomly || exit 1

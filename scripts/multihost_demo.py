@@ -171,7 +171,7 @@ def child_light(process_id: int) -> None:
     """Multi-host light checkpointing with the .full sidecar: a crash
     after a later LIGHT save must resume from the earlier FULL sidecar
     set (the unanimity-gated collective preference in
-    api._resume_state_multiproc) whenever the sidecar preserves more
+    runtime/resume.resume_state_multiproc) whenever the sidecar preserves more
     saved draws, reproducing the uninterrupted run bit for bit."""
     os.environ["XLA_FLAGS"] = (
         f"--xla_force_host_platform_device_count={DEVS_PER_PROC}")
@@ -203,8 +203,11 @@ def child_light(process_id: int) -> None:
                                backend=BackendConfig(mesh_devices=0)))
 
     # Synchronous writer so the kill lands at a deterministic boundary
-    # (_SupSyncWriter; shared with the esig children).
-    api.AsyncCheckpointWriter = _SupSyncWriter
+    # (_SupSyncWriter; shared with the esig children).  The chunk loop
+    # instantiates the writer from runtime.pipeline's globals, so patch
+    # there (api no longer re-exports it).
+    import dcfm_tpu.runtime.pipeline as pipeline
+    pipeline.AsyncCheckpointWriter = _SupSyncWriter
     # light@2, FULL@4 (sidecar), light@6, then the simulated kill
     restore = _crash_after_nth_save("save_checkpoint_multiprocess", nth=3)
     try:
@@ -267,11 +270,14 @@ def child_sup(process_id: int) -> None:
 
 
 def _crash_after_nth_save(attr: str, nth: int = 1):
-    """Monkeypatch api.<attr> so the nth checkpoint save completes and
-    then raises - the shared crash simulation for every recovery demo.
+    """Monkeypatch runtime.pipeline.<attr> so the nth checkpoint save
+    completes and then raises - the shared crash simulation for every
+    recovery demo.  The chunk loop resolves save_fn from pipeline's own
+    module globals (the PR-6 runtime/ carve-out moved it out of api),
+    so that module is the only effective patch point.
     Returns a restore() callable."""
-    import dcfm_tpu.api as api
-    real = getattr(api, attr)
+    import dcfm_tpu.runtime.pipeline as pipeline
+    real = getattr(pipeline, attr)
     calls = {"n": 0}
 
     def killing(*a, **k):
@@ -280,8 +286,8 @@ def _crash_after_nth_save(attr: str, nth: int = 1):
         if calls["n"] == nth:
             raise RuntimeError("simulated crash mid-chain")
 
-    setattr(api, attr, killing)
-    return lambda: setattr(api, attr, real)
+    setattr(pipeline, attr, killing)
+    return lambda: setattr(pipeline, attr, real)
 
 
 def _child_env() -> dict:
@@ -808,7 +814,8 @@ def child_esig(process_id: int) -> None:
                     checkpoint_path=_esig_ckpath(process_id),
                     checkpoint_mode="light",
                     checkpoint_every_chunks=1, checkpoint_full_every=2)
-    api.AsyncCheckpointWriter = _SupSyncWriter
+    import dcfm_tpu.runtime.pipeline as pipeline
+    pipeline.AsyncCheckpointWriter = _SupSyncWriter
     ref = api.fit(Y, FitConfig(model=model, run=run,
                                backend=BackendConfig(mesh_devices=0)))
     np.save(os.path.join(os.environ["MULTIHOST_DEMO_DIR"],

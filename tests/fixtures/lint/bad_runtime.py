@@ -1,0 +1,20 @@
+"""Known-bad runtime pipeline module (the basename puts it in DCFM801
+scope): blocking host fetches with no preceding copy_to_host_async."""
+
+
+import jax
+import numpy as np
+
+
+def drain_boundary(q_dev, scale_dev):
+    # DCFM801: synchronous materialization - the chain behind this call
+    # is serialized on the device->host link
+    scales = np.asarray(scale_dev)
+    panels = jax.device_get(q_dev)
+    return panels, scales
+
+
+def fetch_after_chunk(carry):
+    # DCFM801: device_get on an attribute, still no async dispatched
+    acc = jax.device_get(carry.sigma_acc)
+    return np.array(acc)

@@ -186,7 +186,7 @@ def test_rank_adapt_mesh_matches_vmap():
 def test_rank_adapt_checkpoint_resume(tmp_path, monkeypatch):
     """The mask is chain state: a run killed mid-chain resumes to a bitwise
     identical result, adaptation decisions included."""
-    import dcfm_tpu.api as api
+    import dcfm_tpu.runtime.pipeline as pipeline
 
     Y, _ = make_synthetic(50, 24, 2, seed=37)
     m = ModelConfig(num_shards=2, factors_per_shard=3, rho=0.6,
@@ -197,7 +197,7 @@ def test_rank_adapt_checkpoint_resume(tmp_path, monkeypatch):
 
     ck = str(tmp_path / "adapt.npz")
     cfg_ck = FitConfig(model=m, run=run, checkpoint_path=ck)
-    real_save = api.save_checkpoint
+    real_save = pipeline.save_checkpoint
     calls = {"n": 0}
 
     def killing_save(*args, **kwargs):
@@ -206,10 +206,10 @@ def test_rank_adapt_checkpoint_resume(tmp_path, monkeypatch):
         if calls["n"] == 1:
             raise RuntimeError("simulated crash mid-chain")
 
-    monkeypatch.setattr(api, "save_checkpoint", killing_save)
+    monkeypatch.setattr(pipeline, "save_checkpoint", killing_save)
     with pytest.raises(RuntimeError, match="simulated crash"):
         fit(Y, cfg_ck)
-    monkeypatch.setattr(api, "save_checkpoint", real_save)
+    monkeypatch.setattr(pipeline, "save_checkpoint", real_save)
 
     resumed = fit(Y, dataclasses.replace(cfg_ck, resume=True))
     np.testing.assert_array_equal(np.asarray(full.state.active),

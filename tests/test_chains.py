@@ -138,7 +138,7 @@ def test_multichain_checkpoint_resume(tmp_path, monkeypatch):
     refused."""
     import dataclasses
 
-    import dcfm_tpu.api as api
+    import dcfm_tpu.runtime.pipeline as pipeline
 
     Y, _ = make_synthetic(40, 24, 2, seed=53)
     m = ModelConfig(num_shards=2, factors_per_shard=2, rho=0.6)
@@ -148,7 +148,7 @@ def test_multichain_checkpoint_resume(tmp_path, monkeypatch):
 
     ck = str(tmp_path / "chains.npz")
     cfg_ck = FitConfig(model=m, run=run, checkpoint_path=ck)
-    real_save = api.save_checkpoint
+    real_save = pipeline.save_checkpoint
     calls = {"n": 0}
 
     def killing_save(*args, **kwargs):
@@ -157,10 +157,10 @@ def test_multichain_checkpoint_resume(tmp_path, monkeypatch):
         if calls["n"] == 1:
             raise RuntimeError("boom")
 
-    monkeypatch.setattr(api, "save_checkpoint", killing_save)
+    monkeypatch.setattr(pipeline, "save_checkpoint", killing_save)
     with pytest.raises(RuntimeError, match="boom"):
         fit(Y, cfg_ck)
-    monkeypatch.setattr(api, "save_checkpoint", real_save)
+    monkeypatch.setattr(pipeline, "save_checkpoint", real_save)
 
     resumed = fit(Y, dataclasses.replace(cfg_ck, resume=True))
     np.testing.assert_array_equal(full.sigma_blocks, resumed.sigma_blocks)

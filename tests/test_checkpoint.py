@@ -60,14 +60,14 @@ class _SyncWriter:
 
 
 def _use_sync_writer(monkeypatch):
-    import dcfm_tpu.api as api
-    monkeypatch.setattr(api, "AsyncCheckpointWriter", _SyncWriter)
+    import dcfm_tpu.runtime.pipeline as pipeline
+    monkeypatch.setattr(pipeline, "AsyncCheckpointWriter", _SyncWriter)
 
 
 def test_kill_and_resume_bitwise_identical(tmp_path, monkeypatch, data):
     """Interrupt after 2 of 4 chunks; the resumed run must reproduce the
     uninterrupted run's accumulator bit for bit."""
-    import dcfm_tpu.api as api
+    import dcfm_tpu.runtime.pipeline as pipeline
 
     res_full = fit(data, _cfg())
 
@@ -80,7 +80,7 @@ def test_kill_and_resume_bitwise_identical(tmp_path, monkeypatch, data):
                                  checkpoint_every_chunks=1)
     _use_sync_writer(monkeypatch)
 
-    real_save = api.save_checkpoint
+    real_save = pipeline.save_checkpoint
     calls = {"n": 0}
 
     def killing_save(*args, **kwargs):
@@ -89,10 +89,10 @@ def test_kill_and_resume_bitwise_identical(tmp_path, monkeypatch, data):
         if calls["n"] == 2:
             raise Killed("simulated crash mid-chain")
 
-    monkeypatch.setattr(api, "save_checkpoint", killing_save)
+    monkeypatch.setattr(pipeline, "save_checkpoint", killing_save)
     with pytest.raises(Killed):
         fit(data, cfg_ck)
-    monkeypatch.setattr(api, "save_checkpoint", real_save)
+    monkeypatch.setattr(pipeline, "save_checkpoint", real_save)
 
     # the checkpoint on disk is from iteration 16 of 32
     _, meta = load_checkpoint_meta(ck)
@@ -195,11 +195,11 @@ def test_mesh_resume_matches_mesh_uninterrupted(tmp_path, monkeypatch, data):
     # with the same schedule metadata.  Sync writer + cadence 1: the kill
     # must land at a deterministic boundary (the async writer's deferral
     # and last-boundary warning-downgrade make the raise timing-dependent).
-    import dcfm_tpu.api as api
+    import dcfm_tpu.runtime.pipeline as pipeline
 
     _use_sync_writer(monkeypatch)
     calls = {"n": 0}
-    real_save = api.save_checkpoint
+    real_save = pipeline.save_checkpoint
 
     def killing_save(*args, **kwargs):
         real_save(*args, **kwargs)
@@ -207,12 +207,12 @@ def test_mesh_resume_matches_mesh_uninterrupted(tmp_path, monkeypatch, data):
         if calls["n"] == 2:
             raise Killed()
 
-    api.save_checkpoint = killing_save
+    pipeline.save_checkpoint = killing_save
     try:
         with pytest.raises(Killed):
             fit(Y, cfg_ck)
     finally:
-        api.save_checkpoint = real_save
+        pipeline.save_checkpoint = real_save
 
     res_resumed = fit(Y, dataclasses.replace(cfg_ck, resume=True))
     np.testing.assert_array_equal(
@@ -273,7 +273,7 @@ def test_resume_auto_elastic_recovery(tmp_path, monkeypatch, data):
     """resume="auto": a re-launched crashed job picks up from its own
     checkpoint; with no checkpoint (first launch) or an incompatible one it
     starts fresh instead of refusing - the elastic-recovery contract."""
-    import dcfm_tpu.api as api
+    import dcfm_tpu.runtime.pipeline as pipeline
 
     ck = str(tmp_path / "auto.npz")
     cfg_auto = dataclasses.replace(_cfg(), checkpoint_path=ck, resume="auto")
@@ -285,7 +285,7 @@ def test_resume_auto_elastic_recovery(tmp_path, monkeypatch, data):
                                   res_full.sigma_blocks)
 
     # crash mid-run, re-launch with the SAME config -> resumes
-    real_save = api.save_checkpoint
+    real_save = pipeline.save_checkpoint
     calls = {"n": 0}
 
     def killing_save(*args, **kwargs):
@@ -300,10 +300,10 @@ def test_resume_auto_elastic_recovery(tmp_path, monkeypatch, data):
     # sync writer: the kill must surface at its own boundary, not drift to
     # the last one (where a save failure is by design only a warning)
     _use_sync_writer(monkeypatch)
-    monkeypatch.setattr(api, "save_checkpoint", killing_save)
+    monkeypatch.setattr(pipeline, "save_checkpoint", killing_save)
     with pytest.raises(Killed):
         fit(data, cfg_auto)
-    monkeypatch.setattr(api, "save_checkpoint", real_save)
+    monkeypatch.setattr(pipeline, "save_checkpoint", real_save)
     _, meta = load_checkpoint_meta(ck)
     assert meta["iteration"] == 8
     res_resumed = fit(data, cfg_auto)
@@ -581,16 +581,16 @@ def test_single_process_resume_from_proc_set(tmp_path, data):
 def test_checkpoint_cadence(tmp_path, monkeypatch, data):
     """checkpoint_every_chunks saves every k-th boundary plus the final
     chunk, and the finished file still supports the no-op resume."""
-    import dcfm_tpu.api as api
+    import dcfm_tpu.runtime.pipeline as pipeline
 
     calls = {"n": 0}
-    real = api.save_checkpoint
+    real = pipeline.save_checkpoint
 
     def counting(*a, **k):
         calls["n"] += 1
         real(*a, **k)
 
-    monkeypatch.setattr(api, "save_checkpoint", counting)
+    monkeypatch.setattr(pipeline, "save_checkpoint", counting)
     _use_sync_writer(monkeypatch)
     ck = str(tmp_path / "cadence.npz")
     cfg = dataclasses.replace(_cfg(), checkpoint_path=ck,
@@ -745,7 +745,7 @@ def test_light_crash_resume_restarts_accumulation_exactly(
     the accumulator window restarts (same seed: the chain trajectory is
     identical because per-iteration keys derive from the global iteration,
     and thin=2 keeps the saved-draw grid aligned)."""
-    import dcfm_tpu.api as api
+    import dcfm_tpu.runtime.pipeline as pipeline
 
     ck = str(tmp_path / "light.npz")
     cfg_ck = dataclasses.replace(
@@ -753,7 +753,7 @@ def test_light_crash_resume_restarts_accumulation_exactly(
         checkpoint_every_chunks=1)
     _use_sync_writer(monkeypatch)
 
-    real_save = api.save_checkpoint
+    real_save = pipeline.save_checkpoint
     calls = {"n": 0}
 
     def killing_save(*args, **kwargs):
@@ -762,10 +762,10 @@ def test_light_crash_resume_restarts_accumulation_exactly(
         if calls["n"] == 3:              # checkpoint at iteration 24 of 32
             raise Killed("simulated crash mid-chain")
 
-    monkeypatch.setattr(api, "save_checkpoint", killing_save)
+    monkeypatch.setattr(pipeline, "save_checkpoint", killing_save)
     with pytest.raises(Killed):
         fit(data, cfg_ck)
-    monkeypatch.setattr(api, "save_checkpoint", real_save)
+    monkeypatch.setattr(pipeline, "save_checkpoint", real_save)
 
     _, meta = load_checkpoint_meta(ck)
     assert meta["iteration"] == 24 and meta["state_only"] is True
@@ -840,18 +840,18 @@ def test_checkpoint_full_every_sidecar_in_light_mode(
     uninterrupted run's accumulator bit for bit."""
     import os
 
-    import dcfm_tpu.api as api
+    import dcfm_tpu.runtime.pipeline as pipeline
 
     res_full = fit(data, _cfg())
 
     seen = []
-    real = api.save_checkpoint
+    real = pipeline.save_checkpoint
 
     def recording(path, *a, **k):
         seen.append((os.path.basename(path), bool(k.get("state_only"))))
         real(path, *a, **k)
 
-    monkeypatch.setattr(api, "save_checkpoint", recording)
+    monkeypatch.setattr(pipeline, "save_checkpoint", recording)
     _use_sync_writer(monkeypatch)
     ck = str(tmp_path / "hybrid.npz")
     cfg = dataclasses.replace(
@@ -865,7 +865,7 @@ def test_checkpoint_full_every_sidecar_in_light_mode(
     # the main path ends as a FINISHED light checkpoint (iteration 32, no
     # accumulators); resume falls back to the full sidecar (iteration 24),
     # re-runs 24..32, and lands exactly on the uninterrupted run
-    monkeypatch.setattr(api, "save_checkpoint", real)
+    monkeypatch.setattr(pipeline, "save_checkpoint", real)
     res = fit(data, dataclasses.replace(cfg, resume=True))
     assert res.iters_per_sec > 0                 # ran the 24..32 tail
     np.testing.assert_array_equal(res.sigma_blocks, res_full.sigma_blocks)
@@ -878,7 +878,7 @@ def test_midrun_crash_prefers_sidecar_over_light(tmp_path, monkeypatch, data):
     bit (without the preference, the crash would lose every draw before
     the last light save even though a full snapshot sat right next to
     it)."""
-    import dcfm_tpu.api as api
+    import dcfm_tpu.runtime.pipeline as pipeline
 
     res_full = fit(data, _cfg())
 
@@ -888,7 +888,7 @@ def test_midrun_crash_prefers_sidecar_over_light(tmp_path, monkeypatch, data):
         checkpoint_every_chunks=1, checkpoint_full_every=2)
     _use_sync_writer(monkeypatch)
 
-    real = api.save_checkpoint
+    real = pipeline.save_checkpoint
     calls = {"n": 0}
 
     def killing_save(*a, **k):
@@ -897,10 +897,10 @@ def test_midrun_crash_prefers_sidecar_over_light(tmp_path, monkeypatch, data):
         if calls["n"] == 3:     # light@8, FULL@16 (sidecar), light@24, kill
             raise Killed("crash after the light save at 24")
 
-    monkeypatch.setattr(api, "save_checkpoint", killing_save)
+    monkeypatch.setattr(pipeline, "save_checkpoint", killing_save)
     with pytest.raises(Killed):
         fit(data, cfg)
-    monkeypatch.setattr(api, "save_checkpoint", real)
+    monkeypatch.setattr(pipeline, "save_checkpoint", real)
     import os
     assert os.path.exists(ck + ".full")
     _, meta = load_checkpoint_meta(ck)
@@ -920,18 +920,18 @@ def test_final_full_due_save_goes_to_main_path(tmp_path, monkeypatch, data):
     silently report a window-only Sigma)."""
     import os
 
-    import dcfm_tpu.api as api
+    import dcfm_tpu.runtime.pipeline as pipeline
 
     res_full = fit(data, _cfg())
 
     seen = []
-    real = api.save_checkpoint
+    real = pipeline.save_checkpoint
 
     def recording(path, *a, **k):
         seen.append((os.path.basename(path), bool(k.get("state_only"))))
         real(path, *a, **k)
 
-    monkeypatch.setattr(api, "save_checkpoint", recording)
+    monkeypatch.setattr(pipeline, "save_checkpoint", recording)
     _use_sync_writer(monkeypatch)
     ck = str(tmp_path / "final.npz")
     cfg = dataclasses.replace(
@@ -943,7 +943,7 @@ def test_final_full_due_save_goes_to_main_path(tmp_path, monkeypatch, data):
                     ("final.npz", True), ("final.npz", False)]
     _, meta = load_checkpoint_meta(ck)
     assert meta["iteration"] == 32 and meta["state_only"] is False
-    monkeypatch.setattr(api, "save_checkpoint", real)
+    monkeypatch.setattr(pipeline, "save_checkpoint", real)
     res = fit(data, dataclasses.replace(cfg, resume=True))
     assert res.iters_per_sec == 0.0       # finished full file: no-op resume
     np.testing.assert_array_equal(res.sigma_blocks, res_full.sigma_blocks)

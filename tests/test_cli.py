@@ -124,7 +124,8 @@ def test_cli_transfer_and_combine_knobs(tmp_path, capsys, data_npy):
     assert np.isfinite(np.load(out)).all()
     assert set(meta["phase_seconds"]) == {"preprocess_s", "upload_s",
                                           "init_s", "chain_s", "fetch_s",
-                                          "assemble_s", "checkpoint_s"}
+                                          "exposed_fetch_s", "assemble_s",
+                                          "checkpoint_s"}
 
 
 def test_cli_no_permute_keeps_feature_order(tmp_path, capsys, data_npy):

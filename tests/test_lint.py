@@ -74,6 +74,51 @@ def test_bad_multihost_fires_701():
     assert _rules_fired("bad_multihost.py") == {"DCFM701"}
 
 
+def test_bad_runtime_fires_801():
+    assert _rules_fired("bad_runtime.py") == {"DCFM801"}
+
+
+def test_bad_runtime_flags_every_fetch_shape():
+    findings = lint_file(os.path.join(FIXTURES, "bad_runtime.py"))
+    msgs = [f.message for f in findings if f.rule == "DCFM801"]
+    # device_get AND the asarray/array shapes all fire
+    assert any("device_get" in m for m in msgs)
+    assert any("asarray" in m for m in msgs)
+    assert len(msgs) == 4
+
+
+def test_runtime_rule_is_path_scoped():
+    """DCFM801 fires only for runtime pipeline modules: the same source
+    is flagged under dcfm_tpu/runtime/ and silent under api.py."""
+    src = ("import numpy as np\n"
+           "def f(x):\n"
+           "    return np.asarray(x)\n")
+    assert any(f.rule == "DCFM801"
+               for f in lint_source(src, "dcfm_tpu/runtime/pipeline.py"))
+    assert not any(f.rule == "DCFM801"
+                   for f in lint_source(src, "dcfm_tpu/api.py"))
+
+
+def test_runtime_rule_preceding_async_sanctions_the_drain():
+    """The drain half of an async pair is sanctioned by line order: a
+    fetch AFTER the function's first copy_to_host_async is quiet, one
+    BEFORE it still fires."""
+    ok = ("import numpy as np\n"
+          "def f(x):\n"
+          "    x.copy_to_host_async()\n"
+          "    return np.asarray(x)\n")
+    bad = ("import numpy as np\n"
+           "def f(x, y):\n"
+           "    a = np.asarray(y)\n"
+           "    x.copy_to_host_async()\n"
+           "    return a, np.asarray(x)\n")
+    assert not any(f.rule == "DCFM801"
+                   for f in lint_source(ok, "dcfm_tpu/runtime/m.py"))
+    flagged = [f for f in lint_source(bad, "dcfm_tpu/runtime/m.py")
+               if f.rule == "DCFM801"]
+    assert [f.line for f in flagged] == [3]
+
+
 def test_bad_multihost_flags_both_fetch_shapes():
     findings = lint_file(os.path.join(FIXTURES, "bad_multihost.py"))
     msgs = [f.message for f in findings if f.rule == "DCFM701"]
@@ -117,7 +162,7 @@ def test_every_rule_family_has_a_firing_fixture():
 @pytest.mark.parametrize("name", [
     "good_rng.py", "good_jit.py", "good_dtype.py", "good_ffi.py",
     "good_thread.py", "good_server.py", "good_robust.py",
-    "good_multihost.py"])
+    "good_multihost.py", "good_runtime.py"])
 def test_good_fixture_is_clean(name):
     findings = lint_file(os.path.join(FIXTURES, name))
     assert findings == [], [str(f) for f in findings]

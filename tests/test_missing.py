@@ -96,7 +96,7 @@ def test_missing_mesh_matches_vmap():
 def test_missing_checkpoint_resume_bitwise(tmp_path, monkeypatch):
     """Kill/resume on missing data reproduces the uninterrupted run - the
     imputation draws derive from the global iteration key."""
-    import dcfm_tpu.api as api
+    import dcfm_tpu.runtime.pipeline as pipeline
 
     Y, _ = make_synthetic(50, 24, 2, seed=57)
     Ym, _ = _mcar(Y, 0.1, seed=3)
@@ -113,7 +113,7 @@ def test_missing_checkpoint_resume_bitwise(tmp_path, monkeypatch):
     ck = str(tmp_path / "miss.npz")
     cfg_ck = dataclasses.replace(base, checkpoint_path=ck,
                                  checkpoint_every_chunks=1)
-    real = api.save_checkpoint
+    real = pipeline.save_checkpoint
     calls = {"n": 0}
 
     def killing(*a, **k):
@@ -122,10 +122,10 @@ def test_missing_checkpoint_resume_bitwise(tmp_path, monkeypatch):
         if calls["n"] == 2:
             raise RuntimeError("boom")
 
-    monkeypatch.setattr(api, "save_checkpoint", killing)
+    monkeypatch.setattr(pipeline, "save_checkpoint", killing)
     with pytest.raises(RuntimeError, match="boom"):
         fit(Ym, cfg_ck)
-    monkeypatch.setattr(api, "save_checkpoint", real)
+    monkeypatch.setattr(pipeline, "save_checkpoint", real)
     resumed = fit(Ym, dataclasses.replace(cfg_ck, resume=True))
     np.testing.assert_array_equal(full.sigma_blocks, resumed.sigma_blocks)
 

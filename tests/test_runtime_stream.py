@@ -1,0 +1,397 @@
+"""Streamed accumulator fetch (runtime/pipeline.StreamingFetcher).
+
+The contract under test: the double-buffered per-boundary snapshot
+stream is a PURE overlap optimization - every bit of the result
+(quant8 panels, per-panel scales, posterior-SD panels, assembled
+Sigma, exported artifact) is identical to the post-hoc fetch, under
+every pipeline disturbance the runtime supports:
+
+* plain chunked runs, single-device and mesh layouts;
+* bounded-buffer SKIPS (both in-flight slots busy -> the boundary's
+  snapshot is dropped, never blocking the chain);
+* light-checkpoint resume (acc_start > 0 window divisors);
+* a sentinel rewind mid-run (the window moves; stale queued snapshots
+  must be superseded, never summed);
+* a real SIGKILL inside the streaming window + supervised resume
+  (the PR 4/5 fault seams, via the new ``stream_submit`` event);
+* drain commits through OWNED host copies (the PR-1/PR-5
+  use-after-free class: deleting the device-side snapshot after the
+  drain must not perturb the landed bytes).
+
+Plus the free fit->export path: panels streamed straight into the
+serve artifact's memmap layout are bitwise the post-hoc export.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from tests.conftest import make_synthetic
+
+from dcfm_tpu import BackendConfig, FitConfig, ModelConfig, RunConfig, fit
+from dcfm_tpu.config import validate
+from dcfm_tpu.resilience import faults
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clear_fault_plan():
+    yield
+    faults.install(None)
+
+
+@pytest.fixture(scope="module")
+def data():
+    Y, _ = make_synthetic(n=60, p=96, k_true=3, seed=0)
+    return Y
+
+
+def _cfg(stream="auto", *, posterior_sd=False, mesh=0, chunk=30,
+         mcmc=40, **kw):
+    return FitConfig(
+        model=ModelConfig(num_shards=8, factors_per_shard=3, rho=0.8,
+                          posterior_sd=posterior_sd),
+        run=RunConfig(burnin=40, mcmc=mcmc, thin=2, seed=0,
+                      chunk_size=chunk),
+        backend=BackendConfig(fetch_dtype="quant8", fetch_stream=stream,
+                              mesh_devices=mesh),
+        **kw)
+
+
+def _assert_bitwise(r_on, r_off, sd=False):
+    assert r_on.stream_stats is not None and r_on.stream_stats["streamed"]
+    assert r_off.stream_stats is None
+    np.testing.assert_array_equal(r_on._q8_panels, r_off._q8_panels)
+    np.testing.assert_array_equal(r_on._q8_scales, r_off._q8_scales)
+    np.testing.assert_array_equal(r_on.Sigma, r_off.Sigma)
+    if sd:
+        np.testing.assert_array_equal(r_on._sd_q8_panels,
+                                      r_off._sd_q8_panels)
+        np.testing.assert_array_equal(r_on._sd_q8_scales,
+                                      r_off._sd_q8_scales)
+        np.testing.assert_array_equal(r_on.Sigma_sd, r_off.Sigma_sd)
+
+
+# ---------------------------------------------------------------------------
+# bitwise identity: streamed == post-hoc
+# ---------------------------------------------------------------------------
+
+def test_streamed_bitwise_single_device_with_sd(data):
+    r_on = fit(data, _cfg("auto", posterior_sd=True))
+    r_off = fit(data, _cfg("off", posterior_sd=True))
+    _assert_bitwise(r_on, r_off, sd=True)
+    # burn-in boundaries (no saved draws) are skipped, not streamed:
+    # chunks of 30 over 40+40 iters -> boundaries at 30 (burn-in), 60, 80
+    assert r_on.stream_stats["snapshots"] == 2
+    # telemetry shape: one drain sample per snapshot, exposed recorded
+    assert len(r_on.stream_stats["chunk_fetch_s"]) == 2
+    assert r_on.phase_seconds["exposed_fetch_s"] >= 0.0
+    # post-hoc runs expose their whole fetch by definition
+    assert (r_off.phase_seconds["exposed_fetch_s"]
+            == r_off.phase_seconds["fetch_s"])
+
+
+def test_streamed_bitwise_mesh(data):
+    r_on = fit(data, _cfg("auto", mesh=2))
+    r_off = fit(data, _cfg("off", mesh=2))
+    _assert_bitwise(r_on, r_off)
+
+
+def test_streamed_single_chunk_schedule(data):
+    # chunk_size=0 -> one chunk; the only boundary is final and streams
+    r_on = fit(data, _cfg("auto", chunk=0))
+    r_off = fit(data, _cfg("off", chunk=0))
+    _assert_bitwise(r_on, r_off)
+    assert r_on.stream_stats["snapshots"] == 1
+
+
+def test_bounded_buffer_skips_but_stays_bitwise(data, monkeypatch):
+    """Both double-buffer slots busy -> the boundary snapshot is
+    SKIPPED (the chain is never blocked), and the final result is still
+    bitwise the post-hoc fetch.  Forced by slowing the drain."""
+    import dcfm_tpu.runtime.pipeline as pl
+    real = pl.quant8_drain
+
+    def slow_drain(slices, shape, out=None):
+        time.sleep(0.2)
+        return real(slices, shape, out)
+
+    monkeypatch.setattr(pl, "quant8_drain", slow_drain)
+    r_on = fit(data, _cfg("auto", chunk=10))   # 8 boundaries, 4 streamable
+    monkeypatch.setattr(pl, "quant8_drain", real)
+    r_off = fit(data, _cfg("off", chunk=10))
+    assert r_on.stream_stats["skipped"] >= 1
+    _assert_bitwise(r_on, r_off)
+
+
+def test_streamed_light_resume_window(data, tmp_path):
+    """acc_start > 0: a light-checkpoint resume restarts accumulation
+    mid-chain, so the streamed window divisor differs from 1/num_saved -
+    streamed and post-hoc resumes must still agree bitwise."""
+    results = {}
+    for stream in ("auto", "off"):
+        ck = str(tmp_path / f"light_{stream}.npz")
+        fit(data, _cfg("off", mcmc=20, checkpoint_path=ck,
+                       checkpoint_mode="light"))
+        results[stream] = fit(
+            data, _cfg(stream, mcmc=40, checkpoint_path=ck,
+                       checkpoint_mode="light", resume=True))
+    _assert_bitwise(results["auto"], results["off"])
+
+
+def test_noop_finished_resume_does_not_stream(data, tmp_path):
+    """Resuming a FINISHED checkpoint executes zero chunks: the streamer
+    never engages and the post-hoc fetch serves the materialization
+    (this is exactly how supervise() materializes its FitResult).  A
+    requested stream_artifact still lands - via the post-hoc export
+    fallback - and is bitwise the streamed one."""
+    ck = str(tmp_path / "done.npz")
+    a1 = str(tmp_path / "a_run")
+    a2 = str(tmp_path / "a_noop")
+    ref = fit(data, _cfg("auto", checkpoint_path=ck, stream_artifact=a1))
+    noop = fit(data, _cfg("auto", checkpoint_path=ck, resume=True,
+                          stream_artifact=a2))
+    assert noop.stream_stats is None
+    assert noop.artifact_path == a2
+    np.testing.assert_array_equal(ref.Sigma, noop.Sigma)
+    with open(os.path.join(a1, "mean_q8.bin"), "rb") as f:
+        b1 = f.read()
+    with open(os.path.join(a2, "mean_q8.bin"), "rb") as f:
+        b2 = f.read()
+    assert b1 == b2
+
+
+def test_sentinel_rewind_resets_stream_window(data, tmp_path):
+    """A mid-run divergence rewind moves acc_start; the streamer's
+    window must follow and stale queued snapshots must be superseded.
+    Injected via the deterministic poison_state fault under identical
+    plans, so streamed and post-hoc rewound runs are comparable
+    bitwise."""
+    results = {}
+    for stream in ("auto", "off"):
+        ck = str(tmp_path / f"rw_{stream}.npz")
+        faults.install({"faults": [
+            {"op": "poison_state", "at_iteration": 60}]})
+        try:
+            results[stream] = fit(
+                data, _cfg(stream, chunk=10, checkpoint_path=ck,
+                           checkpoint_every_chunks=1,
+                           checkpoint_keep_last=2, sentinel="rewind"))
+        finally:
+            faults.install(None)
+        assert results[stream].sentinel_rewinds == 1
+    _assert_bitwise(results["auto"], results["off"])
+
+
+# ---------------------------------------------------------------------------
+# streamed serve artifact: fit -> export is free and bitwise
+# ---------------------------------------------------------------------------
+
+def test_stream_artifact_bitwise_vs_posthoc_export(data, tmp_path):
+    a_stream = str(tmp_path / "streamed")
+    a_posthoc = str(tmp_path / "posthoc")
+    r_on = fit(data, _cfg("auto", posterior_sd=True,
+                          stream_artifact=a_stream))
+    r_off = fit(data, _cfg("off", posterior_sd=True))
+    art_off = r_off.export_artifact(a_posthoc)
+    assert r_on.artifact_path == a_stream
+
+    # identical panel BYTES on disk, identical scales, CRCs, assembly
+    for fname in ("mean_q8.bin", "sd_q8.bin"):
+        with open(os.path.join(a_stream, fname), "rb") as f:
+            b_stream = f.read()
+        with open(os.path.join(a_posthoc, fname), "rb") as f:
+            b_posthoc = f.read()
+        assert b_stream == b_posthoc, f"{fname} bytes differ"
+    from dcfm_tpu.serve.artifact import PosteriorArtifact
+    art_on = PosteriorArtifact.open(a_stream)
+    np.testing.assert_array_equal(art_on.mean_scale, art_off.mean_scale)
+    np.testing.assert_array_equal(art_on.sd_scale, art_off.sd_scale)
+    assert art_on.meta["panel_crc"] == art_off.meta["panel_crc"]
+    np.testing.assert_array_equal(art_on.assemble(), art_off.assemble())
+    np.testing.assert_array_equal(art_on.assemble(kind="sd"),
+                                  art_off.assemble(kind="sd"))
+
+
+def test_stream_artifact_export_is_free(data, tmp_path):
+    """export_artifact to the streamed path must OPEN, not rewrite: the
+    panel file's mtime is untouched."""
+    a = str(tmp_path / "art")
+    res = fit(data, _cfg("auto", stream_artifact=a))
+    panel = os.path.join(a, "mean_q8.bin")
+    before = os.stat(panel).st_mtime_ns
+    art = res.export_artifact(a)
+    assert os.stat(panel).st_mtime_ns == before
+    assert art.g == 8
+    # a DIFFERENT path still exports the classic way
+    art2 = res.export_artifact(str(tmp_path / "other"))
+    np.testing.assert_array_equal(np.asarray(art.mean_panels),
+                                  np.asarray(art2.mean_panels))
+
+
+def test_stream_artifact_result_survives_re_stream(data, tmp_path):
+    """The FitResult must not alias the artifact's WRITABLE landing
+    memmaps: its panels are rebound to the finalized artifact's
+    read-only maps (mutation cannot corrupt the CRC'd bytes), and a
+    second stream to the same path creates a fresh inode, so the first
+    result's lazy panel views keep the first posterior's bytes."""
+    a = str(tmp_path / "art")
+    r1 = fit(data, _cfg("auto", stream_artifact=a))
+    assert r1._q8_panels is not None
+    assert not r1._q8_panels.flags.writeable
+    panels_before = np.array(r1._q8_panels, copy=True)
+    sigma_before = np.array(r1.Sigma, copy=True)
+    # different data -> different posterior bytes land at the SAME path
+    Y2, _ = make_synthetic(n=60, p=96, k_true=3, seed=1)
+    r2 = fit(Y2, _cfg("auto", stream_artifact=a))
+    assert not np.array_equal(np.asarray(r2._q8_panels), panels_before)
+    np.testing.assert_array_equal(np.asarray(r1._q8_panels), panels_before)
+    np.testing.assert_array_equal(r1.Sigma, sigma_before)
+    with pytest.raises(ValueError):
+        r1._q8_panels[0, 0, 0] = 0
+
+
+def test_interrupted_stream_artifact_refuses_to_open(data, tmp_path):
+    """A crash mid-stream leaves panel bytes but no meta.json: the
+    artifact must refuse cleanly (meta is invalidated at stream begin,
+    written only at finalize)."""
+    from dcfm_tpu.serve.artifact import (
+        ArtifactError, PosteriorArtifact, begin_streamed_artifact)
+    a = str(tmp_path / "torn")
+    res = fit(data, _cfg("auto", stream_artifact=a))
+    assert res.artifact_path == a
+    # simulate the next fit crashing mid-stream: begin invalidates meta
+    begin_streamed_artifact(a, g=8, P=12, has_sd=False)
+    with pytest.raises(ArtifactError, match="no meta.json"):
+        PosteriorArtifact.open(a)
+
+
+# ---------------------------------------------------------------------------
+# ownership: the drain commits owned copies (PR-1/PR-5 UAF class)
+# ---------------------------------------------------------------------------
+
+def test_drain_commits_owned_copies_sources_deleted():
+    """Pin the owned-copy discipline: delete the device-side source
+    right after submit; the landed panels must be unperturbed, and the
+    landing buffers must OWN their memory (no aliasing of any jax
+    buffer that a later delete()/donation could invalidate)."""
+    import jax.numpy as jnp
+
+    from dcfm_tpu.models.state import num_padded_pairs, num_upper_pairs
+    from dcfm_tpu.runtime.fetch import fetch_jit
+    from dcfm_tpu.runtime.pipeline import StreamingFetcher
+    from dcfm_tpu.serve.artifact import quantize_panels
+
+    g, P = 3, 4
+    rng = np.random.default_rng(3)
+    acc_host = rng.standard_normal(
+        (num_padded_pairs(g), P, P)).astype(np.float32)
+    n_pairs = num_upper_pairs(g)
+    # host-side twin quantizer: bitwise the device fetch (the pinned
+    # serve/artifact contract), so the expectation is source-independent
+    expect_q, expect_s = quantize_panels(acc_host[:n_pairs])
+
+    acc = jnp.asarray(acc_host)
+    sf = StreamingFetcher(
+        fetch_jit(g, 1, "quant8"),
+        lambda a0: (np.float32(1.0), np.float32(1.0)),
+        (n_pairs, P, P), 0)
+    assert sf.submit(acc, final=True)
+    acc.delete()                      # source dies while the drain runs
+    res = sf.finish()
+    assert res["final_landed"]
+    np.testing.assert_array_equal(res["q8"], expect_q)
+    np.testing.assert_array_equal(res["scales"], expect_s)
+    # owned host memory: no view into anything jax can free
+    assert res["q8"].flags.owndata and res["q8"].base is None
+    assert res["scales"].flags.owndata and res["scales"].base is None
+
+
+def test_streamer_abort_joins_worker():
+    """abort() must stop the background worker even with nothing queued
+    (a blocked non-daemon drain would hang interpreter shutdown)."""
+    from dcfm_tpu.runtime.fetch import fetch_jit
+    from dcfm_tpu.runtime.pipeline import StreamingFetcher
+
+    sf = StreamingFetcher(
+        fetch_jit(3, 1, "quant8"),
+        lambda a0: (np.float32(1.0), np.float32(1.0)), (6, 4, 4), 0)
+    sf.abort()
+    assert not sf._worker.is_alive()
+    sf.abort()                        # idempotent
+
+
+# ---------------------------------------------------------------------------
+# config validation
+# ---------------------------------------------------------------------------
+
+def test_validate_rejects_bad_stream_config():
+    base = _cfg()
+    bad = FitConfig(model=base.model, run=base.run,
+                    backend=BackendConfig(fetch_dtype="float32",
+                                          fetch_stream="on"))
+    with pytest.raises(ValueError, match="fetch_stream"):
+        validate(bad, 60, 96)
+    bad2 = FitConfig(model=base.model, run=base.run,
+                     backend=BackendConfig(fetch_dtype="float32"),
+                     stream_artifact="/tmp/x")
+    with pytest.raises(ValueError, match="stream_artifact"):
+        validate(bad2, 60, 96)
+    bad3 = FitConfig(model=base.model, run=base.run,
+                     backend=BackendConfig(fetch_dtype="quant8",
+                                           fetch_stream="nope"))
+    with pytest.raises(ValueError, match="fetch_stream"):
+        validate(bad3, 60, 96)
+    bad4 = FitConfig(model=base.model, run=base.run,
+                     backend=BackendConfig(fetch_dtype="quant8",
+                                           fetch_stream="off"),
+                     stream_artifact="/tmp/x")
+    with pytest.raises(ValueError, match="stream_artifact"):
+        validate(bad4, 60, 96)
+
+
+# ---------------------------------------------------------------------------
+# mid-stream SIGKILL + supervised resume (the PR 4/5 fault seams)
+# ---------------------------------------------------------------------------
+
+def test_midstream_sigkill_supervised_resume_bit_exact(tmp_path,
+                                                       monkeypatch):
+    """A kill_event lands INSIDE the streaming window (the new
+    ``stream_submit`` seam fires at the chunk boundary right as the
+    snapshot is dispatched); the supervisor relaunches, the resumed
+    child re-streams, and the final Sigma is BIT-IDENTICAL to an
+    uninterrupted streamed run."""
+    from dcfm_tpu.resilience import supervise
+
+    Y, _ = make_synthetic(n=40, p=24, k_true=3, seed=7)
+    small = dict(model=ModelConfig(num_shards=2, factors_per_shard=3,
+                                   rho=0.8),
+                 run=RunConfig(burnin=16, mcmc=16, thin=2, seed=3,
+                               chunk_size=8),
+                 backend=BackendConfig(fetch_dtype="quant8",
+                                       fetch_stream="auto"))
+    ref = fit(Y, FitConfig(**small))
+    assert ref.stream_stats is not None          # the reference streamed
+
+    ck = str(tmp_path / "stream.ck.npz")
+    cfg = FitConfig(**small, checkpoint_path=ck,
+                    checkpoint_every_chunks=1, checkpoint_keep_last=2)
+    # children inherit the env plan; the shared compile cache keeps the
+    # relaunches cheap
+    monkeypatch.setenv("JAX_COMPILATION_CACHE_DIR",
+                       os.path.join(REPO, ".jax_cache"))
+    monkeypatch.setenv(faults.ENV_VAR, json.dumps({"faults": [
+        {"op": "kill_event", "event": "stream_submit",
+         "at_occurrence": 1, "at_launch": 1}]}))
+    # the PARENT must not execute the plan (its no-op resume would die
+    # at its own stream seam): neutralize it in-process
+    faults.install({"faults": []})
+    res = supervise(Y, cfg, backoff_base=0.05)
+    assert res.supervise_report.launches == 2
+    assert res.supervise_report.deaths[0][0] == -9   # a real SIGKILL
+    np.testing.assert_array_equal(res.Sigma, ref.Sigma)
+    np.testing.assert_array_equal(res.sigma_blocks, ref.sigma_blocks)
