@@ -17,6 +17,7 @@ sampler.
 import json
 import os
 import sys
+import tempfile
 import time
 
 import numpy as np
@@ -113,6 +114,17 @@ def main():
 
     from dcfm_tpu import BackendConfig, FitConfig, ModelConfig, RunConfig, fit
 
+    # Default-on observability for the bench: the flight recorder runs in
+    # every timed fit (so the headline seconds INCLUDE recording cost -
+    # the <2%-overhead budget is enforced by the same seconds gate), and
+    # the run's event log + stream overlap land in the JSON artifact.
+    # An explicit DCFM_OBS_DIR (a durable bench archive) wins; the temp
+    # dir is only created when one is actually needed.
+    obs_dir = os.environ.get("DCFM_OBS_DIR")
+    if not obs_dir:
+        obs_dir = tempfile.mkdtemp(prefix="dcfm-bench-obs-")
+        os.environ["DCFM_OBS_DIR"] = obs_dir
+
     rng = np.random.default_rng(0)
     # true rank must be coverable per shard: each shard sees all k_true
     # factors, so factors_per_shard (= BENCH_K/BENCH_G) must be >= k_true.
@@ -197,11 +209,12 @@ def main():
     for _ in range(3 if default_shape else 1):
         t0 = time.perf_counter()
         r = fit(Y, cfg)
-        runs.append((time.perf_counter() - t0, r.phase_seconds))
+        runs.append((time.perf_counter() - t0, r.phase_seconds,
+                     r.stream_stats))
         if res is None:
             res = r
         del r
-    seconds_samples = [s for s, _ in runs]
+    seconds_samples = [s for s, _, _ in runs]
     seconds = float(np.median(seconds_samples))
 
     err = float(np.linalg.norm(res.Sigma - Sigma_true)
@@ -219,7 +232,7 @@ def main():
     # on identical binaries - README "Performance" - which is what the
     # median absorbs from the other side.)
     chain_budget_s = 2.5
-    chain_samples = [ph["chain_s"] for _, ph in runs]
+    chain_samples = [ph["chain_s"] for _, ph, _ in runs]
     chain_s_med = float(np.median(chain_samples))
 
     # Streamed-fetch overlap accounting (FitResult.stream_stats /
@@ -230,8 +243,16 @@ def main():
     # Per-chunk drain samples make a degrading link visible per
     # boundary, not just in aggregate.
     exposed_samples = [ph.get("exposed_fetch_s", ph["fetch_s"])
-                       for _, ph in runs]
+                       for _, ph, _ in runs]
     stream = res.stream_stats or {}
+    # Stream overlap fraction (drain time hidden behind compute / total
+    # drain time) per timed run; the median is gated below at the
+    # north-star shape - "the stream engaged" must mean "the drains
+    # actually hid", not just "snapshots were dispatched".
+    overlap_samples = [ss["overlap_fraction"] for _, _, ss in runs
+                       if ss and "overlap_fraction" in ss]
+    overlap_med = (float(np.median(overlap_samples))
+                   if overlap_samples else None)
 
     # Serve-phase probe: the READ path gets a perf trajectory like the
     # fit path has.  Export the timed run's posterior to a fresh memmap
@@ -298,6 +319,16 @@ def main():
                           for s in stream.get("chunk_fetch_s", [])],
         "stream_snapshots": stream.get("snapshots", 0),
         "stream_skipped": stream.get("skipped", 0),
+        # drain-hidden-behind-compute fraction, median over the timed
+        # runs (every sample recorded); gated > 0.5 at the default shape
+        "overlap_fraction": (round(overlap_med, 4)
+                             if overlap_med is not None else None),
+        "overlap_fraction_samples": [round(s, 4)
+                                     for s in overlap_samples],
+        # flight-recorder run directory of the timed fits (FitConfig.obs
+        # via DCFM_OBS_DIR): `dcfm-tpu events <dir>` summarizes it,
+        # `--trace` exports the Chrome/Perfetto trace of the overlap
+        "events_path": res.events_path,
         "assemble_s": round(res.phase_seconds["assemble_s"], 2),
         "checkpoint_s": round(res.phase_seconds["checkpoint_s"], 2),
         "preprocess_s": round(res.phase_seconds["preprocess_s"], 2),
@@ -343,6 +374,20 @@ def main():
               f"(tunnel-independent budget, samples "
               f"{[round(s, 2) for s in chain_samples]})",
               file=sys.stderr)
+        status = 1
+    # * overlap_fraction: when the streamed fetch engaged, the drains
+    #   must actually hide behind compute - a stream whose exposed join
+    #   wall is most of the drain time is overhead, not overlap
+    #   (measured 0.54 on this box at PR 6's numbers: exposed 0.274 s of
+    #   0.59 s total drain).  Skipped when the stream never engaged
+    #   (multi-process, non-quant8, or a no-op resume).
+    if (default_shape and stream.get("snapshots", 0) > 0
+            and overlap_med is not None and overlap_med <= 0.5):
+        print(f"STREAM OVERLAP REGRESSION: median overlap_fraction "
+              f"{overlap_med:.3f} <= 0.5 with the stream engaged "
+              f"(samples {[round(s, 3) for s in overlap_samples]}; "
+              f"drains are no longer hidden behind compute - see "
+              f"`dcfm-tpu events {obs_dir}`)", file=sys.stderr)
         status = 1
     return status
 

@@ -121,7 +121,7 @@ def main(argv=None) -> int:
     else:
         files = [args.tests]
     if not files:
-        print(f"no test files under {args.tests}", file=sys.stderr)
+        print(f"no test files under {args.tests}", file=sys.stderr)  # dcfm: ignore[DCFM901] - the test-isolated CLI's own usage error
         return 2
     return run_isolated(files, passthrough, timeout=args.timeout)
 

@@ -1112,6 +1112,53 @@ def _check_pipeline(mod: _Module, rep: _Reporter) -> None:
 
 
 # =====================================================================
+# DCFM9xx - telemetry discipline
+# =====================================================================
+
+# modules whose JOB is console output: the CLI surfaces (argparse
+# protocols, stdout/stderr JSON lines) - everything else in the library
+# routes telemetry through dcfm_tpu.obs
+_OBS_EXEMPT_BASENAMES = {"cli.py", "__main__.py"}
+
+
+def _check_obs(mod: _Module, rep: _Reporter) -> None:
+    """DCFM901: bare ``print`` / ``sys.std{out,err}.write`` in library
+    modules.  "Bare" means console-bound: a ``print`` with no ``file=``
+    keyword, or one whose ``file=`` resolves to ``sys.stdout`` /
+    ``sys.stderr``.  ``print(..., file=<some handle variable>)`` is
+    parameterized output (the isolate runner's ``out`` parameter) and
+    stays quiet - the rule hunts telemetry that bypasses the flight
+    recorder, not functions that write where their caller pointed."""
+    if os.path.basename(mod.path) in _OBS_EXEMPT_BASENAMES:
+        return
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        full = mod.resolve(node.func)
+        if full in {"sys.stdout.write", "sys.stderr.write"}:
+            rep.emit("DCFM901", node,
+                     f"{full}() in a library module - console output is "
+                     "invisible to the flight recorder; emit through "
+                     "dcfm_tpu.obs (recorder.record), or annotate a "
+                     "deliberate protocol line")
+            continue
+        if not (isinstance(node.func, ast.Name)
+                and node.func.id == "print"):
+            continue
+        file_kw = next((k for k in node.keywords if k.arg == "file"),
+                       None)
+        if file_kw is not None and mod.resolve(file_kw.value) not in {
+                "sys.stdout", "sys.stderr"}:
+            continue    # parameterized handle: caller decides the sink
+        rep.emit("DCFM901", node,
+                 "bare print() in a library module - console output is "
+                 "invisible to the flight recorder and unscrapable by "
+                 "metrics; emit through dcfm_tpu.obs (recorder.record / "
+                 "a registry metric), or annotate a deliberate CLI "
+                 "protocol line")
+
+
+# =====================================================================
 # driver
 # =====================================================================
 
@@ -1132,6 +1179,7 @@ def lint_source(source: str, path: str = "<string>") -> list:
     _check_robustness(mod, rep)
     _check_multihost(mod, rep)
     _check_pipeline(mod, rep)
+    _check_obs(mod, rep)
     rep.findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return rep.findings
 

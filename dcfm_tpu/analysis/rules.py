@@ -120,6 +120,18 @@ RULES = {r.id: r for r in [
          "per-leaf addressable shards, or guard on "
          "leaf.is_fully_addressable",
          library_only=True),
+    # ---- DCFM9xx: telemetry discipline -------------------------------
+    Rule("DCFM901", "print-bypasses-telemetry", "obs",
+         "bare print() (no file=, or file=sys.stdout/sys.stderr) or "
+         "sys.stdout/sys.stderr.write() in a dcfm_tpu library module - "
+         "ad-hoc console output is invisible to the flight recorder "
+         "and unscrapable by metrics; emit through dcfm_tpu.obs "
+         "(recorder.record / a registry metric) instead.  CLI entry "
+         "modules (cli.py, __main__.py) are exempt, print(..., "
+         "file=<handle parameter>) is parameterized output and fine, "
+         "and deliberate console protocol lines carry an inline "
+         "`# dcfm: ignore[DCFM901] - <why>`",
+         library_only=True),
     # ---- DCFM8xx: runtime pipeline discipline ------------------------
     Rule("DCFM801", "pipeline-blocking-host-fetch", "pipeline",
          "blocking host fetch (jax.device_get on an array variable, or "

@@ -37,7 +37,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from dcfm_tpu.config import (
-    BackendConfig, FitConfig, ModelConfig, RunConfig, validate)
+    BackendConfig, FitConfig, ModelConfig, RunConfig, validate,
+    validate_obs)
 from dcfm_tpu.models.priors import make_prior
 from dcfm_tpu.models.sampler import (
     TRACE_SUMMARIES, ChainStats, chain_keys, effective_ranks, init_chain,
@@ -172,6 +173,12 @@ class FitResult:
     # (FitConfig.stream_artifact), already finalized and openable; None
     # otherwise.  export_artifact() to the same path just opens it.
     artifact_path: Optional[str] = None
+    # Flight-recorder run directory (FitConfig.obs; dcfm_tpu/obs): the
+    # append-only JSONL event log of this fit - chunk boundaries, stream
+    # snapshots/drains, checkpoint saves, sentinel rewinds, resume
+    # decisions.  `dcfm-tpu events <dir>` summarizes it; `--trace`
+    # exports a Chrome/Perfetto trace.  None when recording was off.
+    events_path: Optional[str] = None
     # Backing storage for the lazy .upper_panels property: exactly one of
     # _upper_f32 (full-precision fetch paths) or the (_q8_panels,
     # _q8_scales) pair (default quant8 fetch) is set.  Keeping the int8
@@ -381,6 +388,28 @@ def _resolve_devices(backend: BackendConfig):
     return jax.devices(platform)
 
 
+def _resolve_obs_dir(cfg: FitConfig) -> Optional[str]:
+    """FitConfig.obs -> flight-recorder directory, or None (off).
+
+    "auto" records only when a destination is already configured: the
+    ``DCFM_OBS_DIR`` environment variable (the supervisor exports it so
+    every launch of a supervised run lands in one directory), else
+    ``<checkpoint_path>.obs`` when checkpointing is on - so plain
+    throwaway fits stay file-free while anything durable enough to
+    checkpoint also keeps its story."""
+    validate_obs(cfg.obs)
+    if cfg.obs == "off":
+        return None
+    if cfg.obs != "auto":
+        return cfg.obs
+    env = os.environ.get("DCFM_OBS_DIR")
+    if env:
+        return env
+    if cfg.checkpoint_path:
+        return cfg.checkpoint_path + ".obs"
+    return None
+
+
 def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
     """Fit the divide-and-conquer Bayesian factor model to (n, p) data.
 
@@ -408,7 +437,55 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
     persisted at every chunk boundary; ``resume=True`` continues a
     compatible run bitwise-identically, ``resume="auto"`` is the elastic
     mode (resume if compatible, fresh start otherwise).
+
+    Observability (``FitConfig.obs``; dcfm_tpu/obs): the fit keeps a
+    flight-recorder event log - chunk boundaries, stream snapshots and
+    drains, checkpoint saves, sentinel rewinds, the resume decision -
+    reported in :attr:`FitResult.events_path` and summarized by
+    ``dcfm-tpu events``.  Recording is host-side only (never inside
+    jit); ``obs="off"`` is bitwise-identical to recording, minus the
+    event files.
     """
+    obs_dir = _resolve_obs_dir(cfg)
+    if obs_dir is None:
+        return _fit(Y, cfg)
+    from dcfm_tpu.obs import recorder as obs_recorder
+    rec = obs_recorder.FlightRecorder(
+        obs_dir, process_index=jax.process_index())
+    obs_recorder.install(rec)
+    try:
+        rec.emit("fit_start", shards=cfg.model.num_shards,
+                 factors_per_shard=cfg.model.factors_per_shard,
+                 total_iters=cfg.run.total_iters,
+                 burnin=cfg.run.burnin, thin=cfg.run.thin,
+                 chunk_size=cfg.run.chunk_size, seed=cfg.run.seed,
+                 num_chains=cfg.run.num_chains,
+                 fetch_dtype=cfg.backend.fetch_dtype,
+                 checkpoint=bool(cfg.checkpoint_path),
+                 resume=str(cfg.resume))
+        try:
+            res = _fit(Y, cfg)
+        except BaseException as e:
+            # a crash-shaped exit (SIGKILL) never reaches here - the
+            # per-line writes already landed; this covers raised errors
+            rec.emit("fit_failed", error=repr(e))
+            rec.flush(fsync=True)
+            raise
+        ph = res.phase_seconds or {}
+        rec.emit("fit_done", seconds=round(res.seconds, 4),
+                 phases={k: round(v, 4) for k, v in ph.items()},
+                 stream=res.stream_stats,
+                 sentinel_rewinds=res.sentinel_rewinds,
+                 checkpoint_error=res.checkpoint_error)
+        res.events_path = rec.directory
+        return res
+    finally:
+        obs_recorder.uninstall(rec)
+        rec.close()
+
+
+def _fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
+    """The fit body (``fit`` wraps it with the flight-recorder session)."""
     Y = np.asarray(Y)  # dcfm: ignore[DCFM701] - Y is the caller's host matrix, never a global array
     if Y.ndim != 2:
         raise ValueError(f"Y must be an (n, p) matrix, got shape {Y.shape}")
@@ -789,13 +866,20 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
         # the final submit's blocked slot wait happened inside the chunk
         # loop - exposed fetch time the join wall above cannot see
         phase["exposed_fetch_s"] += float(streamed["final_wait_s"])
-        phase["fetch_s"] += float(sum(streamed["chunk_fetch_s"]))
+        total_drain = float(sum(streamed["chunk_fetch_s"]))
+        phase["fetch_s"] += total_drain
         stream_stats = {
             "streamed": True,
             "snapshots": streamed["snapshots"],
             "skipped": streamed["skipped"],
             "exposed_fetch_s": phase["exposed_fetch_s"],
             "chunk_fetch_s": [float(s) for s in streamed["chunk_fetch_s"]],
+            # drain time hidden behind other work / total drain time -
+            # the stream's whole point quantified (bench gates it at the
+            # north-star shape; obs/spans.py draws it)
+            "overlap_fraction": (
+                max(0.0, min(1.0, 1.0 - phase["exposed_fetch_s"]
+                             / total_drain)) if total_drain > 0 else 0.0),
         }
         q8_panels, q8_scales = streamed["q8"], streamed["scales"]
         t_as = time.perf_counter()

@@ -53,6 +53,14 @@ def build_parser() -> argparse.ArgumentParser:
              "poison-iteration abort; --pod N coordinates an N-process "
              "SPMD fit with stop-and-relaunch-all on any host death); "
              "see `dcfm-tpu supervise --help`")
+    sub.add_parser(
+        "events", add_help=False,
+        help="summarize a run's flight-recorder event log "
+             "(FitResult.events_path / <checkpoint>.obs): launches, "
+             "deaths, promoted generations, resume decisions, rewinds, "
+             "injected faults, per-phase walls, stream overlap; "
+             "--trace exports a Chrome/Perfetto trace; see "
+             "`dcfm-tpu events --help`")
 
     # Posterior-serving subsystem (dcfm_tpu/serve; README "Serving the
     # posterior"): export a completed fit to a memory-mapped artifact,
@@ -272,6 +280,11 @@ def main(argv=None) -> int:
     if raw and raw[0] == "supervise":
         from dcfm_tpu.resilience.supervisor import supervise_cli
         return supervise_cli(raw[1:])
+    if raw and raw[0] == "events":
+        # post-mortem tooling is jax-free by construction: it reads the
+        # JSONL event log only, never a checkpoint payload
+        from dcfm_tpu.obs.cli import events_main
+        return events_main(raw[1:])
     args = build_parser().parse_args(argv)
     if args.command == "fit" and args.supervise:
         # Supervised mode re-runs THIS CLI (minus the supervise flags,

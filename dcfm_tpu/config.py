@@ -357,6 +357,22 @@ class FitConfig:
     # ChainDivergedError instead of looping (each rewind escalates the
     # ridge jitter 10x, so the budget also caps the jitter).
     sentinel_max_rewinds: int = 3
+    # Observability (dcfm_tpu/obs): flight-recorder event log + span
+    # telemetry for this fit.
+    #   "auto" (default) - record when a destination is configured:
+    #            the DCFM_OBS_DIR environment variable (the supervisor
+    #            exports it so every launch of a supervised run lands
+    #            in one place), else "<checkpoint_path>.obs" when
+    #            checkpointing is on, else recording stays off;
+    #   "off"  - never record; pinned bitwise-identical to the
+    #            pre-obs code (recording is host-side only and never
+    #            touches RNG or device programs, so "off" vs a
+    #            directory differ only in the event files written);
+    #   any other string - record into that directory.
+    # The run's directory is reported in FitResult.events_path;
+    # `dcfm-tpu events <dir>` summarizes it, `--trace` exports a
+    # Chrome/Perfetto trace.
+    obs: str = "auto"
     # If set, the streamed fetch lands the quantized posterior panels
     # DIRECTLY into a serve artifact directory at this path (the int8
     # ``mean_q8.bin`` / ``sd_q8.bin`` memmaps of serve/artifact.py);
@@ -368,6 +384,16 @@ class FitConfig:
     # not "off").  The artifact's bytes are bitwise-identical to a
     # post-hoc ``res.export_artifact`` of the same chain.
     stream_artifact: Optional[str] = None
+
+
+def validate_obs(obs) -> None:
+    """The ONE home of the obs-knob validation: shared by
+    :func:`validate` and by ``api._resolve_obs_dir`` (which runs before
+    the full validate, at recorder setup)."""
+    if not isinstance(obs, str) or not obs:
+        raise ValueError(
+            f"obs must be 'auto', 'off', or a directory path, got "
+            f"{obs!r}")
 
 
 def validate(cfg: FitConfig, n: int, p: int) -> None:
@@ -465,6 +491,7 @@ def validate(cfg: FitConfig, n: int, p: int) -> None:
     if m.ridge_jitter < 0:
         raise ValueError(
             f"ridge_jitter must be >= 0, got {m.ridge_jitter}")
+    validate_obs(cfg.obs)
     if cfg.backend.fetch_dtype not in ("float32", "bfloat16", "float16",
                                        "quant8"):
         raise ValueError(

@@ -1,0 +1,52 @@
+"""Unified observability: flight recorder, span traces, metrics.
+
+The reference MATLAB script's only instrumentation is a single tic/toc
+(SURVEY ``divideconquer.m:29,:200-201``).  The rebuilt system is a
+streamed runtime pipeline under a pod supervisor behind an HTTP serving
+layer - three subsystems whose behavior used to be reconstructed after
+the fact from stderr lines and checkpoint-metadata walks.  This package
+is the one durable, structured record of what a run actually did:
+
+* :mod:`dcfm_tpu.obs.recorder` - the **flight recorder**: a per-run,
+  per-process append-only JSONL event log (crash-safe: line-buffered,
+  fsync'd at chunk boundaries, a torn final line is tolerated on
+  replay).  Typed events are emitted from the seams that already
+  exist - chunk boundaries, stream snapshots/skips/drains, checkpoint
+  saves/promotes/demotes, sentinel rewinds, resume-gate decisions,
+  supervisor launches/deaths, injected faults - so a post-mortem reads
+  the log instead of re-deriving the story from checkpoint files.
+* :mod:`dcfm_tpu.obs.spans` - host-side **span traces** derived from
+  the same events, exported as Chrome trace-event JSON (loadable in
+  Perfetto / ``chrome://tracing``) so the double-buffered fetch
+  overlap, the checkpoint writer, and supervisor relaunches are
+  *visible*, plus the overlap-fraction summary (drain time hidden
+  behind compute / total drain time).
+* :mod:`dcfm_tpu.obs.metrics` - the **unified metrics registry**:
+  counters / gauges / fixed-bucket histograms with a lock-guarded
+  snapshot and Prometheus text exposition.  The serve layer's latency
+  histograms live on it (``GET /metrics?format=prometheus``), and the
+  fit loop publishes iteration / chunk-seconds / stream-skip /
+  sentinel-rewind / checkpoint-generation gauges into the process
+  default registry.
+
+Everything here is stdlib + numpy-free and jax-free: the supervisor
+parent (which must never touch an accelerator) and the serving layer
+both use it.  Recording is host-side only, never inside jit, and
+``FitConfig.obs="off"`` is pinned bitwise-identical to not having the
+subsystem at all.
+"""
+
+from dcfm_tpu.obs.recorder import (  # noqa: F401
+    FlightRecorder, active, install, read_events, record, record_sync,
+    run_events, tail_events, uninstall)
+from dcfm_tpu.obs.metrics import (  # noqa: F401
+    MetricsRegistry, default_registry, render_prometheus)
+from dcfm_tpu.obs.spans import (  # noqa: F401
+    chrome_trace, overlap_fraction)
+
+__all__ = [
+    "FlightRecorder", "active", "install", "uninstall", "record",
+    "record_sync", "read_events", "run_events", "tail_events",
+    "MetricsRegistry", "default_registry", "render_prometheus",
+    "chrome_trace", "overlap_fraction",
+]

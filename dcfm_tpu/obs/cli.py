@@ -1,0 +1,198 @@
+"""``dcfm-tpu events <run_dir>``: summarize / export a flight-recorder log.
+
+Reads ONLY the JSONL event files (never a checkpoint payload), so a
+post-mortem works on a machine with nothing but the run directory:
+
+    dcfm-tpu events ck.npz.obs                 # human summary
+    dcfm-tpu events ck.npz.obs --json          # machine summary
+    dcfm-tpu events ck.npz.obs --tail 20       # last 20 events
+    dcfm-tpu events ck.npz.obs --trace t.json  # Chrome trace (Perfetto)
+
+The summary covers: launches and deaths (exit codes + checkpoint
+iterations), promoted/demoted/orphaned checkpoint generations, resume
+decisions per launch, sentinel rewinds, injected faults, per-phase
+walls of the newest completed fit, and the stream overlap fraction.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+from typing import List
+
+from dcfm_tpu.obs.recorder import event_files, run_events_with_stats
+from dcfm_tpu.obs.spans import overlap_fraction, write_chrome_trace
+
+
+def _fmt_event(e: dict) -> str:
+    skip = {"t", "mono", "run", "role", "seq", "event"}
+    fields = " ".join(f"{k}={v}" for k, v in e.items() if k not in skip)
+    return f"{e.get('t', 0.0):.3f} {e.get('role', '?'):>14} " \
+           f"{e.get('event', '?')}" + (f"  {fields}" if fields else "")
+
+
+def summarize(run_dir: str, events=None, torn: int = 0) -> dict:
+    """Machine-readable run summary from the event log alone.  Pass
+    ``events``/``torn`` (from ``run_events_with_stats``) to reuse an
+    already-parsed stream; without them one parse happens here."""
+    if events is None:
+        events, torn = run_events_with_stats(run_dir)
+    by = {}
+    for e in events:
+        by.setdefault(e.get("event"), []).append(e)
+
+    launches = [{"attempt": e.get("attempt"),
+                 "checkpoint_iteration": e.get("checkpoint_iteration")}
+                for e in by.get("supervisor_launch", [])]
+    deaths = [{"exit": e.get("exit"), "iteration": e.get("iteration"),
+               "launch": e.get("launch")}
+              for e in by.get("supervisor_death", [])]
+    promotions = [{"iteration": e.get("iteration"), "slot": e.get("slot")}
+                  for e in by.get("checkpoint_promote", [])]
+    resumes = [{"role": e.get("role"), "decision": e.get("decision"),
+                "iteration": e.get("iteration"),
+                "acc_start": e.get("acc_start")}
+               for e in by.get("resume_decision", [])]
+    faults = [{k: v for k, v in e.items()
+               if k in ("op", "when", "event_name", "at_iteration",
+                        "iteration", "target", "path", "write", "role")}
+              for e in by.get("fault", [])]
+    rewinds = [{"iteration": e.get("iteration"),
+                "to_iteration": e.get("to_iteration"),
+                "acc_start": e.get("acc_start")}
+               for e in by.get("sentinel_rewind", [])]
+    # "newest fit" must mean the newest REAL run: supervise()'s no-op
+    # materialization resume (role "materialize", zero chunks) records
+    # its own fit_done last, and its ~0 phase walls would otherwise
+    # shadow the supervised chain's actual timings
+    fit_done = [e for e in by.get("fit_done", [])
+                if e.get("role") != "materialize"] \
+        or by.get("fit_done", [])
+    phases = fit_done[-1].get("phases") if fit_done else None
+    stream = fit_done[-1].get("stream") if fit_done else None
+    chunks = by.get("chunk", [])
+    saves = by.get("checkpoint_save", [])
+    return {
+        "run_dir": run_dir,
+        "events": len(events),
+        "files": len(event_files(run_dir)),
+        "torn_lines": torn,
+        "runs": sorted({e.get("run") for e in events if e.get("run")}),
+        "launches": launches,
+        "deaths": deaths,
+        "checkpoint_promotions": promotions,
+        "checkpoint_demotions": len(by.get("checkpoint_demote", [])),
+        "checkpoint_orphans": len(by.get("checkpoint_orphan", [])),
+        "checkpoint_saves": len(saves),
+        "last_checkpoint_iteration": (saves[-1].get("iteration")
+                                      if saves else None),
+        "resume_decisions": resumes,
+        "sentinel_rewinds": rewinds,
+        "faults_injected": faults,
+        "chunks": len(chunks),
+        "chain_s": round(sum(float(e.get("dur_s", 0.0))
+                             for e in chunks), 3),
+        "phases": phases,
+        "stream": stream,
+        "overlap_fraction": overlap_fraction(events),
+    }
+
+
+def _print_summary(s: dict, out: List[str]) -> None:
+    out.append(f"flight recorder: {s['run_dir']}  "
+               f"({s['files']} file(s), {s['events']} events"
+               + (f", {s['torn_lines']} torn line(s) tolerated"
+                  if s["torn_lines"] else "") + ")")
+    if s["launches"]:
+        out.append(f"launches: {len(s['launches'])}")
+        for l in s["launches"]:
+            out.append(f"  launch #{l['attempt']} from checkpoint "
+                       f"iteration {l['checkpoint_iteration']}")
+    if s["deaths"]:
+        out.append(f"deaths: {len(s['deaths'])}")
+        for d in s["deaths"]:
+            out.append(f"  death (exit {d['exit']}) at checkpoint "
+                       f"iteration {d['iteration']} "
+                       f"(launch {d['launch']})")
+    if s["checkpoint_promotions"]:
+        for p in s["checkpoint_promotions"]:
+            out.append(f"promoted generation: iteration "
+                       f"{p['iteration']} -> {p['slot']}")
+    if s["checkpoint_demotions"]:
+        out.append(f"demoted corrupt generations: "
+                   f"{s['checkpoint_demotions']}")
+    if s["checkpoint_orphans"]:
+        out.append(f"orphaned slots: {s['checkpoint_orphans']}")
+    for r in s["resume_decisions"]:
+        out.append(f"resume decision [{r['role']}]: {r['decision']} at "
+                   f"iteration {r['iteration']} "
+                   f"(acc_start {r['acc_start']})")
+    for r in s["sentinel_rewinds"]:
+        out.append(f"sentinel rewind: iteration {r['iteration']} -> "
+                   f"{r['to_iteration']}")
+    for f in s["faults_injected"]:
+        out.append("fault injected: " + " ".join(
+            f"{k}={v}" for k, v in f.items()))
+    out.append(f"chunks: {s['chunks']}  chain wall: {s['chain_s']}s  "
+               f"checkpoint saves: {s['checkpoint_saves']}"
+               + (f" (last at iteration "
+                  f"{s['last_checkpoint_iteration']})"
+                  if s["last_checkpoint_iteration"] is not None else ""))
+    if s["phases"]:
+        out.append("phases (newest fit): " + "  ".join(
+            f"{k}={v}" for k, v in s["phases"].items()))
+    if s["stream"]:
+        st = s["stream"]
+        out.append(f"stream: snapshots={st.get('snapshots')} "
+                   f"skipped={st.get('skipped')} "
+                   f"exposed_fetch_s={st.get('exposed_fetch_s')}")
+    if s["overlap_fraction"] is not None:
+        out.append(f"overlap fraction (drain hidden behind compute): "
+                   f"{s['overlap_fraction']:.3f}")
+
+
+def events_main(argv=None) -> int:
+    try:
+        return _events_main(argv)
+    except BrokenPipeError:
+        # `dcfm-tpu events ... | head` closing the pipe is not an error
+        return 0
+
+
+def _events_main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="dcfm-tpu events", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("run_dir",
+                   help="flight-recorder run directory (FitResult."
+                        "events_path; <checkpoint>.obs for supervised "
+                        "runs)")
+    p.add_argument("--json", action="store_true",
+                   help="print the summary as one JSON object")
+    p.add_argument("--tail", type=int, default=0, metavar="N",
+                   help="print the last N raw events instead of the "
+                        "summary")
+    p.add_argument("--trace", default=None, metavar="OUT.json",
+                   help="also write a Chrome trace-event file (open in "
+                        "Perfetto / chrome://tracing)")
+    args = p.parse_args(argv)
+    if not event_files(args.run_dir):
+        print(f"no events-*.jsonl files under {args.run_dir}")
+        return 2
+    # ONE parse of the log feeds every output mode
+    events, torn = run_events_with_stats(args.run_dir)
+    if args.trace:
+        write_chrome_trace(events, args.trace)
+        print(f"chrome trace: {args.trace} ({len(events)} events)")
+    if args.tail:
+        for e in events[-args.tail:]:
+            print(_fmt_event(e))
+        return 0
+    s = summarize(args.run_dir, events=events, torn=torn)
+    if args.json:
+        print(json.dumps(s))
+        return 0
+    lines: List[str] = []
+    _print_summary(s, lines)
+    print("\n".join(lines))
+    return 0

@@ -22,8 +22,9 @@ import numpy as np
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     if len(argv) != 2:
-        print("usage: python -m dcfm_tpu.resilience._child cfg.json Y.npy",
-              file=sys.stderr)
+        print(  # dcfm: ignore[DCFM901] - __main__-style usage line of the child runner
+            "usage: python -m dcfm_tpu.resilience._child cfg.json Y.npy",
+            file=sys.stderr)
         return 2
     cfg_path, data_path = argv
     from dcfm_tpu.utils.checkpoint import (

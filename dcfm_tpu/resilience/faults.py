@@ -102,6 +102,8 @@ from typing import Optional
 
 import numpy as np
 
+from dcfm_tpu.obs.recorder import record, record_sync
+
 ENV_VAR = "DCFM_FAULT_PLAN"
 FUZZ_ENV_VAR = "DCFM_FAULT_FUZZ"
 PROCESS_ENV_VAR = "DCFM_FAULT_PROCESS"
@@ -215,6 +217,11 @@ class FaultPlan:
         ``phase`` is "pre_save" or "post_save"."""
         f = self._boundary_due("kill", phase, iteration, start_iteration)
         if f is not None:
+            # the log must name the kill that is about to happen: emit +
+            # fsync BEFORE the signal (the process never runs another line)
+            record_sync("fault", op="kill", when=phase,
+                        at_iteration=int(f["at_iteration"]),
+                        iteration=iteration)
             os.kill(os.getpid(), signal.SIGKILL)
 
     def poison_due(self, iteration: int, start_iteration: int) -> bool:
@@ -238,6 +245,8 @@ class FaultPlan:
             if not self._gates_open(f):
                 continue
             self._fired.add((i, "kill_event"))
+            record_sync("fault", op="kill_event", event_name=event,
+                        occurrence=count)
             os.kill(os.getpid(), signal.SIGKILL)
 
     # -- write faults --------------------------------------------------
@@ -264,8 +273,13 @@ class FaultPlan:
         self._writes[target] = count
         for f in self._write_faults(target, path, count):
             if f["op"] == "io_delay":
+                record("fault", op="io_delay", target=target,
+                       path=os.path.basename(path), write=count,
+                       seconds=float(f.get("seconds", 0.1)))
                 time.sleep(float(f.get("seconds", 0.1)))
             elif f["op"] == "io_error":
+                record_sync("fault", op="io_error", target=target,
+                            path=os.path.basename(path), write=count)
                 raise OSError(
                     f"injected I/O failure (DCFM_FAULT_PLAN: write "
                     f"#{count} to {target} at {path})")
@@ -293,6 +307,8 @@ class FaultPlan:
             flat = arr.view(np.uint8).reshape(-1)
             flat[0] ^= 1
             out[leaf] = arr
+            record("fault", op="bit_flip", target=target,
+                   path=os.path.basename(path), write=count, leaf=leaf)
         return out
 
     def after_replace(self, target: str, path: str, count: int) -> None:
@@ -306,6 +322,9 @@ class FaultPlan:
             keep = int(size * float(f.get("keep_fraction", 0.5)))
             with open(path, "r+b") as fh:
                 fh.truncate(keep)
+            record("fault", op="torn_write", target=target,
+                   path=os.path.basename(path), write=count,
+                   kept_bytes=keep, size_bytes=size)
 
 
 _ACTIVE: Optional[FaultPlan] = None

@@ -62,9 +62,16 @@ import tempfile
 import time
 from typing import Callable, Optional
 
+from dcfm_tpu.obs.recorder import (
+    OBS_DIR_ENV_VAR, RUN_ID_ENV_VAR, FlightRecorder, record, tail_events)
+from dcfm_tpu.obs.recorder import install as _obs_install
+from dcfm_tpu.obs.recorder import uninstall as _obs_uninstall
+
 # NOTE: dcfm_tpu.utils.checkpoint is imported lazily inside functions:
 # checkpoint.py itself imports resilience.faults (the chaos seam), so a
 # module-level import here would be circular through the package init.
+# obs.recorder is stdlib-only and jax-free, so the supervising parent
+# can import it without grabbing the child's accelerator.
 
 
 class PoisonedRunError(RuntimeError):
@@ -103,10 +110,40 @@ class SuperviseReport:
     corrupt_fallbacks: int = 0     # CRC-demoted checkpoints
     final_iteration: int = -1
     elapsed_s: float = 0.0
+    # flight-recorder identity of the run: every launch's events (and
+    # supervise()'s materialization fit) share this id in the obs dir
+    run_id: str = ""
 
 
 def _log(msg: str) -> None:
-    print(f"[supervise] {msg}", file=sys.stderr, flush=True)
+    # the flight recorder's stderr MIRROR: structured telemetry lives in
+    # the event log; this line keeps the operator-visible trail
+    print(f"[supervise] {msg}", file=sys.stderr, flush=True)  # dcfm: ignore[DCFM901] - the supervisor's documented stderr mirror
+
+
+def _postmortem(obs_dir: Optional[str], launch: int) -> str:
+    """Last-events suffix for the typed supervision errors: a poison or
+    hang report should name the flight-recorder path and what the dead
+    launch last did, so triage starts from evidence instead of from a
+    checkpoint-payload walk."""
+    if not obs_dir:
+        return ""
+    suffix = f"; flight recorder: {obs_dir}"
+    try:
+        evs = tail_events(obs_dir, 5, launch=launch)
+    except Exception:  # dcfm: ignore[DCFM601] - an unreadable log must not mask the typed error it decorates
+        return suffix
+    if not evs:
+        return suffix
+    brief = []
+    for e in evs:
+        s = str(e.get("event"))
+        it = e.get("iteration", e.get("end"))
+        if it is not None:
+            s += f"@it{it}"
+        brief.append(s)
+    return (f"{suffix} (last {len(evs)} events of launch {launch}: "
+            + ", ".join(brief) + ")")
 
 
 def _checkpoint_slots(path: str) -> list:
@@ -235,6 +272,7 @@ def _watchdog_progress(path: str, num_processes: int) -> int:
 def _demote(p: str, err, report: SuperviseReport,
             log: Callable[[str], None]) -> None:
     log(f"checkpoint {p} unusable ({err}); demoting")
+    record("checkpoint_demote", path=os.path.basename(p), error=str(err))
     report.corrupt_fallbacks += 1
     try:
         os.replace(p, p + ".corrupt")
@@ -293,6 +331,8 @@ def _ensure_slot(slot: str, report: SuperviseReport,
             _promote(p, slot)
             log(f"promoted retained checkpoint {p} -> {slot} "
                 f"(iteration {it})")
+            record("checkpoint_promote", src=os.path.basename(p),
+                   slot=os.path.basename(slot), iteration=it)
         return it
     return -1
 
@@ -355,11 +395,15 @@ def _ensure_unanimous_checkpoint(path: str, num_processes: int,
                 log(f"promoted retained checkpoint {src} -> {slot} "
                     f"(iteration {it_star}, unanimous over "
                     f"{num_processes} processes)")
+                record("checkpoint_promote", src=os.path.basename(src),
+                       slot=os.path.basename(slot), iteration=it_star,
+                       unanimous=True)
     else:
         for slot in slots:
             if os.path.exists(slot):
                 log(f"no unanimously-held generation; setting aside "
                     f"{slot}")
+                record("checkpoint_orphan", slot=os.path.basename(slot))
                 try:
                     os.replace(slot, slot + ".orphan")
                 except OSError:
@@ -468,12 +512,61 @@ def _run_supervision(
     grace: float = 5.0,
     log: Callable[[str], None] = _log,
 ) -> SuperviseReport:
+    """Obs session around the one supervision loop: open the run's
+    flight recorder (``DCFM_OBS_DIR``, defaulting to
+    ``<checkpoint>.obs`` - the SAME directory the children's
+    ``FitConfig.obs="auto"`` resolves to, so one run = one directory)
+    and export ``DCFM_OBS_DIR`` / ``DCFM_RUN_ID`` so every launch of
+    every child records into it; the loop's ``log`` lines remain the
+    operator-visible stderr trail beside the structured events.  The
+    previous environment is restored on the way out."""
+    obs_dir = os.environ.get(OBS_DIR_ENV_VAR) or (checkpoint_path + ".obs")
+    rec = FlightRecorder(obs_dir, role="supervisor")
+    prev_env = {k: os.environ.get(k)
+                for k in (OBS_DIR_ENV_VAR, RUN_ID_ENV_VAR)}
+    os.environ[OBS_DIR_ENV_VAR] = obs_dir
+    os.environ[RUN_ID_ENV_VAR] = rec.run_id
+    _obs_install(rec)
+    try:
+        return _supervision_loop(
+            spawn, checkpoint_path=checkpoint_path,
+            num_processes=num_processes, max_retries=max_retries,
+            backoff_base=backoff_base, backoff_max=backoff_max,
+            poison_deaths=poison_deaths, launch_timeout=launch_timeout,
+            grace=grace, log=log, rec=rec, obs_dir=obs_dir)
+    finally:
+        _obs_uninstall(rec)
+        rec.close()
+        for k, v in prev_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def _supervision_loop(
+    spawn: Callable[[int], list],
+    *,
+    checkpoint_path: str,
+    num_processes: int,
+    max_retries: int,
+    backoff_base: float,
+    backoff_max: float,
+    poison_deaths: int,
+    launch_timeout: Optional[float],
+    grace: float,
+    log: Callable[[str], None],
+    rec: FlightRecorder,
+    obs_dir: str,
+) -> SuperviseReport:
     """The one supervision loop under every mode.  ``spawn(attempt)``
     (1-based) starts the attempt's process(es) and returns their
     ``subprocess.Popen`` handles; everything else - integrity pre-pass,
     death accounting, poison detection, backoff, watchdog - is shared
-    between the single-host and pod paths."""
-    report = SuperviseReport()
+    between the single-host and pod paths.  Every decision lands in the
+    flight recorder (the typed failures quote the dead launch's last
+    events), with ``log`` as the stderr mirror."""
+    report = SuperviseReport(run_id=rec.run_id)
     t0 = time.perf_counter()
     prev_death_iter: Optional[int] = None
     same_iter_deaths = 0
@@ -487,16 +580,28 @@ def _run_supervision(
     while True:
         it_before = _pre_pass()
         report.launches += 1
+        rec.emit("supervisor_launch", attempt=report.launches,
+                 checkpoint_iteration=it_before,
+                 num_processes=num_processes)
+        rec.flush(fsync=True)
         log(f"launch #{report.launches} (checkpoint at iteration "
             f"{it_before})")
         procs = spawn(report.launches)
         # the watchdog's liveness probe: cheap meta-only reads (no CRC
         # scan - that is the relaunch pre-pass's job), so polling it at
         # the coarse _await_pod cadence costs nothing
-        rc = _await_pod(
-            procs, launch_timeout, grace, log,
-            progress_fn=lambda: _watchdog_progress(checkpoint_path,
-                                                   num_processes))
+        try:
+            rc = _await_pod(
+                procs, launch_timeout, grace, log,
+                progress_fn=lambda: _watchdog_progress(checkpoint_path,
+                                                       num_processes))
+        except PodHangError as e:
+            report.elapsed_s = time.perf_counter() - t0
+            rec.emit("supervisor_hang", launch=report.launches,
+                     watchdog_s=launch_timeout)
+            rec.flush(fsync=True)
+            raise PodHangError(
+                str(e) + _postmortem(obs_dir, report.launches)) from None
         if rc == 0:
             # leave the live slot VERIFIED on the way out too: the final
             # save itself can be the corrupt one (observed under chaos
@@ -505,6 +610,10 @@ def _run_supervision(
             # promoted, not trip over bad bytes
             report.final_iteration = _pre_pass()
             report.elapsed_s = time.perf_counter() - t0
+            rec.emit("supervisor_done", launches=report.launches,
+                     corrupt_fallbacks=report.corrupt_fallbacks,
+                     final_iteration=report.final_iteration,
+                     dur_s=report.elapsed_s)
             log(f"child finished after {report.launches} launch(es), "
                 f"{report.corrupt_fallbacks} corrupt fallback(s)")
             return report
@@ -512,6 +621,9 @@ def _run_supervision(
                    if num_processes > 1
                    else _progress_iteration(checkpoint_path))
         report.deaths.append((rc, it_died))
+        rec.emit("supervisor_death", exit=rc, iteration=it_died,
+                 launch=report.launches)
+        rec.flush(fsync=True)
         log(f"child died (exit {rc}) at checkpoint "
             f"iteration {it_died}")
         # Poison = the same iteration killed the child ``poison_deaths``
@@ -528,20 +640,30 @@ def _run_supervision(
             same_iter_deaths = 1
         if same_iter_deaths >= poison_deaths:
             report.elapsed_s = time.perf_counter() - t0
+            rec.emit("supervisor_poisoned", iteration=it_died,
+                     deaths=same_iter_deaths, exit=rc)
+            rec.flush(fsync=True)
             raise PoisonedRunError(
                 f"iteration {it_died} killed the child {same_iter_deaths} "
                 f"times in a row (exit {rc}) - the failure "
                 "is deterministic, not environmental; inspect the run at "
-                f"the offending checkpoint: {checkpoint_path}",
+                f"the offending checkpoint: {checkpoint_path}"
+                + _postmortem(obs_dir, report.launches),
                 checkpoint_path=checkpoint_path, iteration=it_died)
         prev_death_iter = it_died
         retries = report.launches  # deaths so far == launches (none exited 0)
         if retries > max_retries:
             report.elapsed_s = time.perf_counter() - t0
+            rec.emit("supervisor_retries_exhausted", retries=retries,
+                     exit=rc, iteration=it_died)
+            rec.flush(fsync=True)
             raise RetriesExhaustedError(
                 f"child died {retries} times (retry budget {max_retries}); "
-                f"last exit {rc} at iteration {it_died}")
+                f"last exit {rc} at iteration {it_died}"
+                + _postmortem(obs_dir, report.launches))
         delay = min(backoff_max, backoff_base * (2.0 ** (retries - 1)))
+        rec.emit("supervisor_backoff", seconds=delay,
+                 next_attempt=report.launches + 1)
         log(f"backing off {delay:.2f}s before relaunch")
         time.sleep(delay)
 
@@ -591,6 +713,13 @@ def supervise_command(
     def spawn(attempt: int) -> list:
         child_env = dict(full_env)
         child_env["DCFM_FAULT_LAUNCH"] = str(attempt)
+        # the obs session (one run directory + run id for every launch)
+        # is exported by _run_supervision AFTER full_env was snapshotted
+        for k in (OBS_DIR_ENV_VAR, RUN_ID_ENV_VAR):
+            if k in os.environ:
+                child_env[k] = os.environ[k]
+        # children ARE launches: never inherit a role override
+        child_env.pop("DCFM_OBS_ROLE", None)
         return [subprocess.Popen(argv, env=child_env)]
 
     return _run_supervision(
@@ -702,8 +831,27 @@ def supervise(Y, cfg, *, max_retries: int = 5, backoff_base: float = 1.0,
     # zero iterations, fetches + assembles) - with the supervision
     # telemetry attached (FitResult.supervise_report), so API callers see
     # the launches/deaths/fallbacks, not just the CLI's stderr JSON.
+    # The materialization fit records under its OWN flight-recorder role:
+    # without the override it would default to L1.p0 and append a second,
+    # differently-id'd run into the launch-1 child's event file.
     from dcfm_tpu.api import fit
-    res = fit(np.asarray(Y), dataclasses.replace(cfg, resume=True))
+    from dcfm_tpu.obs.recorder import OBS_ROLE_ENV_VAR
+    # ... and under the supervised run's run id (the loop restored the
+    # env on exit; the report carries the id), so ONE logical run keeps
+    # ONE id across every launch plus this materialization segment.
+    prev = {k: os.environ.get(k)
+            for k in (OBS_ROLE_ENV_VAR, RUN_ID_ENV_VAR)}
+    os.environ[OBS_ROLE_ENV_VAR] = "materialize"
+    if report.run_id:
+        os.environ[RUN_ID_ENV_VAR] = report.run_id
+    try:
+        res = fit(np.asarray(Y), dataclasses.replace(cfg, resume=True))
+    finally:
+        for k, v in prev.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
     return dataclasses.replace(res, supervise_report=report)
 
 
@@ -732,6 +880,7 @@ def run_supervised_cli(child_argv: list, *, checkpoint: str,
                 procs = []
                 for i in range(pod):
                     env = dict(os.environ)
+                    env.pop("DCFM_OBS_ROLE", None)  # children ARE launches
                     env["DCFM_COORDINATOR"] = (
                         f"127.0.0.1:{port_base + attempt}")
                     env["DCFM_NUM_PROCESSES"] = str(pod)
@@ -753,13 +902,13 @@ def run_supervised_cli(child_argv: list, *, checkpoint: str,
                 poison_deaths=poison_deaths,
                 launch_timeout=launch_timeout)
     except (PoisonedRunError, RetriesExhaustedError, PodHangError) as e:
-        print(json.dumps({
+        print(json.dumps({  # dcfm: ignore[DCFM901] - the CLI's documented stderr JSON protocol
             "error": type(e).__name__, "message": str(e),
             "checkpoint": getattr(e, "checkpoint_path", None),
             "iteration": getattr(e, "iteration", None),
         }), file=sys.stderr)
         return 3
-    print(json.dumps({
+    print(json.dumps({  # dcfm: ignore[DCFM901] - the CLI's documented stderr JSON protocol
         "supervised": True, "launches": report.launches,
         "deaths": report.deaths,
         "corrupt_fallbacks": report.corrupt_fallbacks,

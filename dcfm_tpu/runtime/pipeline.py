@@ -66,6 +66,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from dcfm_tpu.models.sampler import num_saved_draws
+from dcfm_tpu.obs import metrics as obs_metrics
+from dcfm_tpu.obs.recorder import active as obs_active, record
 from dcfm_tpu.resilience.faults import fault_event, fault_plan
 from dcfm_tpu.resilience.sentinel import (
     ChainDivergedError, DivergenceSentinel)
@@ -74,6 +76,30 @@ from dcfm_tpu.runtime.resume import (
     ResumeContext, resume_state, resume_state_multiproc, rewind_source)
 from dcfm_tpu.utils.checkpoint import (
     AsyncCheckpointWriter, save_checkpoint, save_checkpoint_multiprocess)
+
+
+# Fit-side progress gauges in the process default metrics registry
+# (obs/metrics.py): updated at every chunk boundary - host-side dict
+# writes, never device work - and exposed by any in-process serve
+# layer's `GET /metrics?format=prometheus` alongside its own metrics.
+_REG = obs_metrics.default_registry()
+_G_ITER = _REG.gauge(
+    "dcfm_fit_iteration",
+    "global Gibbs iteration at the last completed chunk boundary")
+_G_CHUNK_S = _REG.gauge(
+    "dcfm_fit_chunk_seconds",
+    "wall-clock seconds of the last completed chunk")
+_G_STREAM_SKIPS = _REG.gauge(
+    "dcfm_fit_stream_skips",
+    "chunk boundaries skipped by the streamed fetch (both double-buffer "
+    "slots busy)")
+_G_REWINDS = _REG.gauge(
+    "dcfm_fit_sentinel_rewinds",
+    "divergence-sentinel rewinds performed by the current fit")
+_G_CK_GEN = _REG.gauge(
+    "dcfm_fit_checkpoint_generation",
+    "checkpoint saves completed by the current fit (the write-behind "
+    "generation counter)")
 
 
 def chunk_schedule(num_iters: int, chunk: int) -> list:
@@ -154,6 +180,14 @@ class StreamingFetcher:
         self._worker.start()
 
     # -- main-thread side --------------------------------------------
+
+    @property
+    def failed(self) -> bool:
+        """True once the drain worker stored a failure: every later
+        submit refuses (finish() surfaces the error).  Distinct from a
+        busy-slot skip so telemetry never reads a dead stream as
+        double-buffer saturation."""
+        return self._error is not None
 
     def reset_window(self, acc_start: int) -> None:
         """Sentinel rewind moved the accumulation window: recompute the
@@ -258,7 +292,13 @@ class StreamingFetcher:
             self.sd_scale = np.array(sd_scale_dev, np.float32, copy=True)  # dcfm: ignore[DCFM801] - drain half: async was dispatched in submit/quant8_start
         if job.final:
             self.final_landed = True
-        self.chunk_fetch_s.append(time.perf_counter() - t0)
+        dur = time.perf_counter() - t0
+        self.chunk_fetch_s.append(dur)
+        # flight-recorder span: the drain slice this worker just spent on
+        # the link (obs/spans.py draws it overlapping the chain's chunk
+        # slices - the picture of "the fetch hides behind compute")
+        record("stream_drain", final=bool(job.final), dur_s=dur,
+               with_sd=job.sd_started is not None)
 
 
 @dataclasses.dataclass
@@ -440,7 +480,20 @@ def run_chain(*, cfg, model, run, sched, phase: dict, multiproc: bool,
             it_now += ni
             traces.append((it_now - ni, trace_host))
             last = qi == len(queue_)
+            # flight recorder + progress gauges: one event and a few
+            # gauge writes per boundary (host-side only; a no-op stays
+            # one global read when nothing is installed)
+            record("chunk", start=it_now - ni, end=it_now, iters=ni,
+                   dur_s=chunk_secs[-1], final=last)
+            _G_ITER.set(it_now)
+            _G_CHUNK_S.set(chunk_secs[-1])
+            if streamer is not None:
+                _G_STREAM_SKIPS.set(streamer.skipped)
+            if sentinel is not None:
+                _G_REWINDS.set(sentinel.rewinds)
             if sentinel is not None and sentinel.tripped(stats):
+                record("sentinel_trip", iteration=it_now,
+                       mode=sentinel.mode)
                 reloaded = None
                 if sentinel.mode == "rewind":
                     if writer is not None:
@@ -453,6 +506,8 @@ def run_chain(*, cfg, model, run, sched, phase: dict, multiproc: bool,
                                                          Yd)
                     reloaded = rewind_source(rctx, rewind_template)
                 if reloaded is None:
+                    record("chain_diverged", iteration=it_now,
+                           mode=sentinel.mode, rewinds=sentinel.rewinds)
                     raise ChainDivergedError(
                         "chain produced non-finite values in the chunk "
                         f"ending at iteration {it_now}"
@@ -460,9 +515,18 @@ def run_chain(*, cfg, model, run, sched, phase: dict, multiproc: bool,
                            if sentinel.mode == "rewind"
                            else " (sentinel mode 'abort')"),
                         iteration=it_now, rewinds=sentinel.rewinds)
-                sentinel.record_rewind(it_now)   # raises past the budget
+                try:
+                    sentinel.record_rewind(it_now)  # raises past the budget
+                except ChainDivergedError:
+                    record("chain_diverged", iteration=it_now,
+                           mode=sentinel.mode, rewinds=sentinel.rewinds)
+                    raise
                 bad = carry
+                it_tripped = it_now
                 carry, it_now, acc_start = reloaded
+                record("sentinel_rewind", iteration=it_tripped,
+                       to_iteration=it_now, acc_start=acc_start,
+                       rewinds=sentinel.rewinds)
                 trace0 = min(trace0, it_now)
                 jax.tree.map(
                     lambda a: a.delete() if isinstance(a, jax.Array)
@@ -496,8 +560,19 @@ def run_chain(*, cfg, model, run, sched, phase: dict, multiproc: bool,
                 if last or draws_so_far > 0:
                     fault_event("stream_submit")
                     try:
-                        streamer.submit(carry.sigma_acc,
-                                        carry.sigma_sq_acc, final=last)
+                        if streamer.submit(carry.sigma_acc,
+                                           carry.sigma_sq_acc,
+                                           final=last):
+                            record("stream_snapshot", iteration=it_now,
+                                   final=last)
+                        elif streamer.failed:
+                            # the drain worker died: refusals from here
+                            # on are NOT busy-slot skips - a post-mortem
+                            # must read "stream dead since k", never
+                            # "double buffer saturated"
+                            record("stream_refused", iteration=it_now)
+                        else:
+                            record("stream_skip", iteration=it_now)
                     except Exception as e:
                         # the stream is an overlap OPTIMIZATION: a
                         # dispatch failure must never kill an otherwise
@@ -515,6 +590,9 @@ def run_chain(*, cfg, model, run, sched, phase: dict, multiproc: bool,
                         streamer = None
                     fault_event("stream_submit_post")
             if writer is None:
+                rec = obs_active()
+                if rec is not None:
+                    rec.flush(fsync=True)   # boundary durability point
                 if plan is not None:
                     plan.maybe_kill(it_now, done, "pre_save")
                     plan.maybe_kill(it_now, done, "post_save")
@@ -593,6 +671,13 @@ def run_chain(*, cfg, model, run, sched, phase: dict, multiproc: bool,
                 phase["checkpoint_s"] += time.perf_counter() - t_ck
                 since_save = 0
                 saves_done += 1
+                _G_CK_GEN.set(saves_done)
+            # chunk-boundary durability point for the flight recorder:
+            # everything up to this boundary survives a kill (the
+            # injected ones fsync for themselves before firing)
+            rec = obs_active()
+            if rec is not None:
+                rec.flush(fsync=True)
             if plan is not None:
                 # chaos determinism: a "post_save" kill must observe a
                 # DURABLE save, so it only arms at a boundary whose save

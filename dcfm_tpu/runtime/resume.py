@@ -32,6 +32,7 @@ import numpy as np
 
 from dcfm_tpu.config import FitConfig
 from dcfm_tpu.models.sampler import num_saved_draws
+from dcfm_tpu.obs.recorder import record
 from dcfm_tpu.resilience.faults import fault_event
 from dcfm_tpu.utils.checkpoint import (
     checkpoint_compatible, discover_checkpoint, load_checkpoint,
@@ -218,6 +219,8 @@ def resume_state(ctx: ResumeContext, init_fn, Yd):
                     side = _try_full_sidecar(ctx, template,
                                              max(window, 0))
                     if side is not None:
+                        record("resume_decision", decision="sidecar",
+                               iteration=side[1], acc_start=side[2])
                         return side
                     if window <= 0:
                         raise ValueError(
@@ -230,8 +233,13 @@ def resume_state(ctx: ResumeContext, init_fn, Yd):
                             "checkpoint_mode='full' / "
                             "checkpoint_full_every for recoverable "
                             "accumulators")
+                    record("resume_decision", decision="light",
+                           kind=kind, iteration=it, acc_start=it)
                     return carry, it, it
-                return carry, it, int(meta.get("acc_start", 0))
+                acc0 = int(meta.get("acc_start", 0))
+                record("resume_decision", decision="resume", kind=kind,
+                       iteration=it, acc_start=acc0)
+                return carry, it, acc0
             except Exception:
                 if not auto:
                     raise
@@ -239,6 +247,7 @@ def resume_state(ctx: ResumeContext, init_fn, Yd):
         raise FileNotFoundError(
             f"resume=True but no checkpoint at {cfg.checkpoint_path} "
             "(or any .procK-of-N set)")
+    record("resume_decision", decision="fresh", iteration=0, acc_start=0)
     return init_fn(ctx.k_init, Yd), 0, 0
 
 
@@ -399,6 +408,10 @@ def resume_state_multiproc(ctx: ResumeContext, init_fn, Yd):
                         lambda a: (a.delete()
                                    if isinstance(a, jax.Array)
                                    else None), loaded[0])
+                    record("resume_decision", decision="sidecar",
+                           agree=True,
+                           iteration=int(smeta2["iteration"]),
+                           acc_start=int(smeta2.get("acc_start", 0)))
                     return (s_carry, int(smeta2["iteration"]),
                             int(smeta2.get("acc_start", 0)))
                 if s_carry is not None:   # a peer failed: fall back
@@ -407,6 +420,8 @@ def resume_state_multiproc(ctx: ResumeContext, init_fn, Yd):
                                    if isinstance(a, jax.Array)
                                    else None), s_carry)
             if window > 0:
+                record("resume_decision", decision="light", agree=True,
+                       iteration=my_iter, acc_start=my_iter)
                 return loaded[0], my_iter, my_iter
             # light checkpoint with an empty restart window and no
             # unanimously better sidecar: nothing would be
@@ -421,8 +436,14 @@ def resume_state_multiproc(ctx: ResumeContext, init_fn, Yd):
                     "stored - extend run.mcmc, or use "
                     "checkpoint_full_every so a .full sidecar exists")
         else:
+            record("resume_decision", decision="resume", agree=True,
+                   kind=("plain" if kind_code == 0 else "set"),
+                   iteration=my_iter,
+                   acc_start=int(meta.get("acc_start", 0)))
             return loaded[0], my_iter, int(meta.get("acc_start", 0))
     if cfg.resume and not auto and not agree:
+        record("resume_decision", decision="refused",
+               iteration=my_iter, signatures=all_sigs.tolist())
         raise ValueError(
             failure or "resume=True but the per-process checkpoints "
             "disagree on the resume source "
@@ -441,6 +462,7 @@ def resume_state_multiproc(ctx: ResumeContext, init_fn, Yd):
             loaded[0])
     if carry0 is None:   # init was freed for a load that was discarded
         carry0 = init_fn(ctx.k_init, Yd)
+    record("resume_decision", decision="fresh", iteration=0, acc_start=0)
     return carry0, 0, 0
 
 

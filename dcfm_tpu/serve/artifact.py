@@ -54,6 +54,7 @@ from typing import Optional
 
 import numpy as np
 
+from dcfm_tpu.obs.recorder import record
 from dcfm_tpu.resilience.faults import fault_plan
 from dcfm_tpu.utils.preprocess import PreprocessResult
 
@@ -101,6 +102,35 @@ def panel_crc32(panel: np.ndarray) -> int:
 
 def _num_pairs(g: int) -> int:
     return g * (g + 1) // 2
+
+
+def artifact_fingerprint(meta: dict) -> str:
+    """Stable content fingerprint of an artifact from its metadata
+    alone: shape fields + provenance + the per-panel CRC32s (which pin
+    the payload bytes).  Exports record it in ``meta.json``;
+    :class:`PosteriorArtifact` re-derives it for older artifacts, so
+    ``/healthz`` and ``/metrics`` can always name WHICH posterior a
+    replica is serving - the identity half of generation-tagged
+    hot-swap (ROADMAP item 2).
+
+    Artifacts with NO recorded panel CRCs (pre-integrity exports,
+    synthesized sparse artifacts) cannot have their bytes pinned from
+    metadata; their fingerprint is prefixed ``weak-`` so a fleet
+    comparing fingerprints across a hot-swap can never mistake a
+    shape+provenance match for a byte-level identity."""
+    import hashlib
+    crc = meta.get("panel_crc") or {}
+    basis = {
+        "g": meta.get("g"), "P": meta.get("P"),
+        "p_original": meta.get("p_original"),
+        "n_pad": meta.get("n_pad"), "has_sd": meta.get("has_sd"),
+        "provenance": meta.get("provenance") or {},
+        "panel_crc": crc,
+    }
+    digest = hashlib.sha256(
+        json.dumps(basis, sort_keys=True, default=str).encode()
+    ).hexdigest()[:16]
+    return digest if crc else f"weak-{digest}"
 
 
 def quantize_panels(upper: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
@@ -157,6 +187,13 @@ class PosteriorArtifact:
     @property
     def p_used(self) -> int:
         return self.g * self.P
+
+    @property
+    def fingerprint(self) -> str:
+        """The artifact's content fingerprint: recorded in meta.json by
+        current exports, re-derived from the metadata for older ones."""
+        return (self.meta.get("fingerprint")
+                or artifact_fingerprint(self.meta))
 
     @classmethod
     def open(cls, path: str) -> "PosteriorArtifact":
@@ -402,7 +439,7 @@ def finalize_streamed_artifact(
         crc["sd"] = [int(panel_crc32(q)) for q in sd_mm]
     np.savez(os.path.join(path, MAPS_FILE),
              **_build_maps(pre, mean_scale, sd_scale))
-    _write_meta_last(path, {
+    meta = {
         "format": ARTIFACT_FORMAT,
         "version": ARTIFACT_VERSION,
         "g": int(g),
@@ -412,7 +449,11 @@ def finalize_streamed_artifact(
         "has_sd": sd_mm is not None,
         "panel_crc": crc,
         "provenance": provenance or {},
-    })
+    }
+    meta["fingerprint"] = artifact_fingerprint(meta)
+    _write_meta_last(path, meta)
+    record("artifact_write", path=os.path.basename(path),
+           source="stream", fingerprint=meta["fingerprint"])
     return PosteriorArtifact.open(path)
 
 
@@ -482,7 +523,7 @@ def write_artifact(
         _write_panels(path, SD_PANELS_FILE, sd_q8)
     np.savez(os.path.join(path, MAPS_FILE),
              **_build_maps(pre, mean_scale, sd_scale))
-    _write_meta_last(path, {
+    meta = {
         "format": ARTIFACT_FORMAT,
         "version": ARTIFACT_VERSION,
         "g": int(g),
@@ -494,7 +535,11 @@ def write_artifact(
         # verified lazily on first touch by the query engine
         "panel_crc": crc,
         "provenance": provenance or {},
-    })
+    }
+    meta["fingerprint"] = artifact_fingerprint(meta)
+    _write_meta_last(path, meta)
+    record("artifact_write", path=os.path.basename(path),
+           source="export", fingerprint=meta["fingerprint"])
     return PosteriorArtifact.open(path)
 
 
@@ -531,6 +576,7 @@ def create_sparse_artifact(path: str, *, g: int, P: int,
         "g": int(g), "P": int(P), "p_original": int(p_used), "n_pad": 0,
         "has_sd": bool(has_sd), "provenance": {"source": "synthesized"},
     }
+    meta["fingerprint"] = artifact_fingerprint(meta)
     with open(os.path.join(path, META_FILE), "w", encoding="utf-8") as f:
         json.dump(meta, f, indent=1)
     return path
@@ -726,7 +772,7 @@ def export_main(args) -> int:
     size = sum(
         os.path.getsize(os.path.join(args.out, f))
         for f in os.listdir(args.out))
-    print(json.dumps({
+    print(json.dumps({  # dcfm: ignore[DCFM901] - the export CLI's stdout JSON protocol
         "out": args.out, "g": art.g, "P": art.P, "p": art.p_original,
         "has_sd": art.has_sd, "bytes": int(size),
         "source": art.meta["provenance"].get("source"),

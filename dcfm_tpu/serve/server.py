@@ -17,7 +17,19 @@ dependency the container doesn't already have.  Endpoints:
   degraded mode; the flag exists so a fleet can see it.  ``draining``
   once shutdown began.
 * ``GET /metrics`` - per-endpoint latency histograms (p50/p99 + bucket
-  counts), panel-cache hit/miss/eviction counters, batcher queue stats.
+  counts), panel-cache hit/miss/eviction counters, batcher queue stats,
+  and the served artifact's fingerprint + generation tag.
+* ``GET /metrics?format=prometheus`` - the same metrics in Prometheus
+  text exposition format (0.0.4), rendered from the unified registry
+  (``dcfm_tpu/obs/metrics.py``) the latency histograms live on - plus
+  the process default registry, so an embedded fit's progress gauges
+  (iteration, chunk seconds, stream skips, sentinel rewinds,
+  checkpoint generation) ride the same scrape.
+
+Every query response additionally carries the
+``X-DCFM-Artifact-Generation`` header - the tag a zero-downtime
+hot-swap (ROADMAP item 2) will bump on artifact promotion so clients
+can observe which posterior generation answered.
 
 Shutdown discipline (dcfm-lint DCFM503): ``shutdown()`` +
 ``server_close()`` always run on the exit path - ``run()`` installs
@@ -35,6 +47,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlsplit
 
+from dcfm_tpu.obs import metrics as obs_metrics
 from dcfm_tpu.serve.artifact import (
     ArtifactCorruptError, ArtifactError, PosteriorArtifact)
 from dcfm_tpu.serve.batcher import DeadlineExceeded, Overloaded, QueryBatcher
@@ -52,47 +65,32 @@ _BUCKET_BOUNDS_MS = (0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0,
 
 
 class LatencyHistogram:
-    """Fixed-bucket latency histogram with percentile readout."""
+    """Per-route latency view over the unified metrics registry
+    (obs/metrics.Histogram).  The storage moved to the registry - which
+    is what Prometheus exposition renders - while this class keeps the
+    HISTORICAL JSON ``/metrics`` readout byte-for-byte: same keys, same
+    rounding, same bucket-upper-bound percentile rule."""
 
-    def __init__(self):
-        self._counts = [0] * len(_BUCKET_BOUNDS_MS)
-        self._n = 0
-        self._sum_ms = 0.0
-        self._lock = threading.Lock()
+    def __init__(self, hist: obs_metrics.Histogram, route: str):
+        self._hist = hist
+        self._route = route
 
     def record(self, ms: float) -> None:
-        with self._lock:
-            for k, bound in enumerate(_BUCKET_BOUNDS_MS):
-                if ms <= bound:
-                    self._counts[k] += 1
-                    break
-            self._n += 1
-            self._sum_ms += ms
-
-    def _percentile(self, q: float) -> float:
-        """Upper bucket bound containing quantile q (inf -> last finite)."""
-        target = q * self._n
-        seen = 0
-        for k, bound in enumerate(_BUCKET_BOUNDS_MS):
-            seen += self._counts[k]
-            if seen >= target:
-                return bound if bound != float("inf") \
-                    else _BUCKET_BOUNDS_MS[-2]
-        return _BUCKET_BOUNDS_MS[-2]
+        self._hist.observe(ms, route=self._route)
 
     def snapshot(self) -> dict:
-        with self._lock:
-            if self._n == 0:
-                return {"count": 0}
-            return {
-                "count": self._n,
-                "mean_ms": round(self._sum_ms / self._n, 4),
-                "p50_ms": self._percentile(0.50),
-                "p99_ms": self._percentile(0.99),
-                "buckets_ms": {
-                    ("inf" if b == float("inf") else str(b)): c
-                    for b, c in zip(_BUCKET_BOUNDS_MS, self._counts)},
-            }
+        counts, n, sum_ms = self._hist.data(route=self._route)
+        if n == 0:
+            return {"count": 0}
+        return {
+            "count": n,
+            "mean_ms": round(sum_ms / n, 4),
+            "p50_ms": self._hist.percentile(0.50, route=self._route),
+            "p99_ms": self._hist.percentile(0.99, route=self._route),
+            "buckets_ms": {
+                ("inf" if b == float("inf") else str(b)): c
+                for b, c in zip(_BUCKET_BOUNDS_MS, counts)},
+        }
 
 
 def _parse_indices(spec: str, p: int) -> list:
@@ -138,10 +136,20 @@ class _Handler(BaseHTTPRequestHandler):
                                               parse_qs(parts.query))
         app.observe(parts.path, status,
                     (time.perf_counter() - t0) * 1e3)
-        body = json.dumps(payload).encode()
+        if isinstance(payload, str):
+            # Prometheus text exposition (format 0.0.4), not JSON
+            body = payload.encode()
+            ctype = "text/plain; version=0.0.4; charset=utf-8"
+        else:
+            body = json.dumps(payload).encode()
+            ctype = "application/json"
         self.send_response(status)
-        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
+        # generation-tagged responses: which posterior generation
+        # answered (bumped on artifact hot-swap - ROADMAP item 2)
+        self.send_header("X-DCFM-Artifact-Generation",
+                         str(app.generation))
         for k, v in headers.items():
             self.send_header(k, v)
         self.end_headers()
@@ -187,7 +195,59 @@ class PosteriorServer:
         self._closed = False
         self._hist: dict = {}
         self._hist_lock = threading.Lock()
-        self._status_counts: dict = {}
+        # Unified metrics registry (dcfm_tpu/obs/metrics.py): the
+        # latency histograms live HERE (LatencyHistogram is a per-route
+        # JSON view over one labeled histogram), per-status response
+        # counts ride a counter, and the cache/batcher/artifact stats
+        # are pull gauges sampled at scrape time.  One registry PER
+        # SERVER (two servers in one process never collide); the
+        # Prometheus renderer appends the process default registry so
+        # an embedded fit's progress gauges ride the same scrape.
+        self.generation = 0    # bumped on artifact hot-swap (ROADMAP 2)
+        self.metrics = obs_metrics.MetricsRegistry()
+        self._lat_hist = self.metrics.histogram(
+            "dcfm_serve_request_latency_ms", _BUCKET_BOUNDS_MS,
+            "request latency per route, milliseconds", labels=("route",))
+        self._responses = self.metrics.counter(
+            "dcfm_serve_responses_total",
+            "responses by HTTP status", labels=("status",))
+        g = self.metrics.gauge
+        g("dcfm_serve_uptime_seconds", "seconds since server start"
+          ).set_function(lambda: time.monotonic() - self._t0)
+        g("dcfm_serve_artifact_generation",
+          "generation tag of the served artifact (bumped on hot-swap)"
+          ).set_function(lambda: self.generation)
+        # one stats() sample is shared by every per-stat series of a
+        # scrape (the registry reads series sequentially): without the
+        # short-lived memo each exposition would call engine.stats() /
+        # batcher.stats() once PER stat, and sibling stats (hits vs
+        # misses, submitted vs served) could come from different instants
+        def _memo(fn, ttl=0.05):
+            state = {"t": -1.0, "v": None}
+
+            def get():
+                now = time.monotonic()
+                if state["v"] is None or now - state["t"] > ttl:
+                    state["v"] = fn()
+                    state["t"] = now
+                return state["v"]
+            return get
+
+        cache_stats = _memo(lambda: self.engine.stats())
+        cache_g = g("dcfm_serve_cache", "panel-cache stats",
+                    labels=("stat",))
+        for stat in ("hits", "misses", "evictions", "panels", "bytes",
+                     "budget_bytes"):
+            cache_g.set_function(
+                lambda s=stat: float(cache_stats().get(s, 0)), stat=stat)
+        batch_stats = _memo(lambda: self.batcher.stats())
+        batch_g = g("dcfm_serve_batcher", "microbatcher stats",
+                    labels=("stat",))
+        for stat in ("submitted", "served", "rejected", "expired",
+                     "batches", "max_batch_seen", "queue_depth",
+                     "queue_capacity"):
+            batch_g.set_function(
+                lambda s=stat: float(batch_stats().get(s, 0)), stat=stat)
 
     _ROUTES = ("/healthz", "/metrics", "/v1/entry", "/v1/block",
                "/v1/interval")
@@ -201,10 +261,18 @@ class PosteriorServer:
         with self._hist_lock:
             h = self._hist.get(key)
             if h is None:
-                h = self._hist[key] = LatencyHistogram()
-            self._status_counts[status] = \
-                self._status_counts.get(status, 0) + 1
+                h = self._hist[key] = LatencyHistogram(self._lat_hist,
+                                                       key)
+        # per-status counts live on the registry counter ONLY; the JSON
+        # /metrics "statuses" dict is derived from it at read time
+        self._responses.inc(status=str(status))
         h.record(ms)
+
+    def status_counts(self) -> dict:
+        """{status: count} derived from the registry counter - the one
+        home of the per-status bookkeeping."""
+        return {lab["status"]: int(self._responses.value(**lab))
+                for lab, _child in self._responses.series()}
 
     # -- routing -------------------------------------------------------
     def handle(self, path: str, q: dict) -> tuple:
@@ -213,6 +281,8 @@ class PosteriorServer:
             if path == "/healthz":
                 return 200, self._healthz(), {}
             if path == "/metrics":
+                if q.get("format", [""])[0] == "prometheus":
+                    return 200, self._metrics_prometheus(), {}
                 return 200, self._metrics(), {}
             if path == "/v1/entry":
                 return self._entry(q)
@@ -302,20 +372,40 @@ class PosteriorServer:
                        else "ok" if native.available() else "degraded"),
             "native": native.available(),
             "p": a.p_original, "g": a.g, "P": a.P, "has_sd": a.has_sd,
+            # identity + generation of the served posterior: the pair a
+            # fleet checks before/after an artifact hot-swap (a replica
+            # still answering under the old fingerprint is stale)
+            "artifact_fingerprint": a.fingerprint,
+            "artifact_generation": self.generation,
             "uptime_s": round(time.monotonic() - self._t0, 3),
         }
 
     def _metrics(self):
         with self._hist_lock:
             hists = {p: h.snapshot() for p, h in self._hist.items()}
-            statuses = dict(self._status_counts)
+        statuses = self.status_counts()
         return {
             "latency": hists,
             "statuses": statuses,
             "cache": self.engine.stats(),
             "batcher": self.batcher.stats(),
+            "artifact": {"fingerprint": self.artifact.fingerprint,
+                         "generation": self.generation},
             "uptime_s": round(time.monotonic() - self._t0, 3),
         }
+
+    def _metrics_prometheus(self) -> str:
+        """Prometheus text exposition: this server's registry first,
+        then the process default registry (an embedded fit's progress
+        gauges; empty otherwise).  The served artifact's fingerprint
+        rides as an info-style labeled gauge."""
+        info = self.metrics.gauge(
+            "dcfm_serve_artifact_info",
+            "served artifact identity (fingerprint label); value is "
+            "always 1", labels=("fingerprint",))
+        info.set(1, fingerprint=self.artifact.fingerprint)
+        return obs_metrics.render_prometheus(
+            self.metrics, obs_metrics.default_registry())
 
     # -- lifecycle -----------------------------------------------------
     def start(self) -> tuple:
@@ -370,11 +460,11 @@ def serve_main(args) -> int:
         cache_bytes=int(args.cache_mb) << 20, max_queue=args.max_queue,
         max_batch=args.max_batch, request_timeout=args.request_timeout)
     host, port = server.address
-    print(json.dumps({"serving": f"http://{host}:{port}",
+    print(json.dumps({"serving": f"http://{host}:{port}",  # dcfm: ignore[DCFM901] - the serve CLI's stdout protocol
                       "artifact": args.artifact,
                       "p": server.artifact.p_original,
                       "has_sd": server.artifact.has_sd}), flush=True)
     server.run()
-    print(json.dumps({"drained": True,
-                      "statuses": server._status_counts}), flush=True)
+    print(json.dumps({"drained": True,  # dcfm: ignore[DCFM901] - the serve CLI's stdout protocol
+                      "statuses": server.status_counts()}), flush=True)
     return 0

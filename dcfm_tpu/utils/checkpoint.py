@@ -39,6 +39,7 @@ import numpy as np
 from dcfm_tpu.config import (
     AdaptConfig, BackendConfig, DLConfig, FitConfig, HorseshoeConfig,
     MGPConfig, ModelConfig, RunConfig)
+from dcfm_tpu.obs.recorder import record
 from dcfm_tpu.resilience.faults import fault_plan
 
 
@@ -213,6 +214,7 @@ def _config_from_json(d: dict) -> FitConfig:
         checkpoint_keep_last=d.get("checkpoint_keep_last", 1),
         sentinel=d.get("sentinel", "auto"),
         sentinel_max_rewinds=d.get("sentinel_max_rewinds", 3),
+        obs=d.get("obs", "auto"),
         stream_artifact=d.get("stream_artifact"),
     )
 
@@ -321,8 +323,10 @@ def _atomic_savez(target: str, meta: dict, payload: dict, *,
       the write, bit-flips after the CRCs are computed (the exact silent
       corruption the CRCs exist to catch), torn writes after the rename.
     """
+    import time as _time
     d = os.path.dirname(os.path.abspath(target)) or "."
     os.makedirs(d, exist_ok=True)
+    t0 = _time.perf_counter()
     plan = fault_plan()
     count = plan.on_write(fault_target, target) if plan else 0
     meta = dict(meta)
@@ -346,6 +350,14 @@ def _atomic_savez(target: str, meta: dict, payload: dict, *,
     finally:
         if os.path.exists(tmp):
             os.unlink(tmp)
+    # flight-recorder seam (obs/recorder.py): one event per durable
+    # save - this is THE one home of the write, so every caller
+    # (direct, write-behind, multiprocess, sidecar, strip) is covered
+    record("checkpoint_save", path=os.path.basename(target),
+           target=fault_target, iteration=meta.get("iteration", -1),
+           state_only=bool(meta.get("state_only")),
+           acc_start=meta.get("acc_start", 0),
+           dur_s=_time.perf_counter() - t0)
 
 
 def verify_checkpoint(path: str) -> dict:

@@ -32,6 +32,13 @@ python -m dcfm_tpu.analysis dcfm_tpu/resilience/ || exit 1
 echo "== dcfm-lint: runtime pipeline (DCFM801 async-fetch discipline) =="
 python -m dcfm_tpu.analysis dcfm_tpu/runtime/ || exit 1
 
+# The observability subsystem is what every other subsystem's
+# post-mortem depends on: a telemetry bypass (bare print, DCFM901) or a
+# swallowed failure in the recorder itself defeats the flight-recorder
+# contract.
+echo "== dcfm-lint: observability subsystem (DCFM901 telemetry) =="
+python -m dcfm_tpu.analysis dcfm_tpu/obs/ || exit 1
+
 # Serve tests always run through the crash-isolated lane IN ADDITION to
 # their in-process tier-1 run below: they exercise native assembly +
 # sockets + thread storms, so a native-level abort here must fail ONE
@@ -47,10 +54,13 @@ python -m dcfm_tpu.analysis dcfm_tpu/runtime/ || exit 1
 # tests run real background drain threads plus a supervised SIGKILL
 # inside the stream window - a runaway child or a hung drain must fail
 # ONE file with its signal named, not wedge the suite.
+# test_obs.py rides it too: the flight-recorder crash lane SIGKILLs
+# real supervised children and replays their (possibly torn) event
+# logs - a runaway child must fail one file with its signal named.
 echo "== serve + chaos tests incl. crash-fuzz smoke (crash-isolated lane) =="
 for f in tests/test_serve_artifact.py tests/test_serve_engine.py \
          tests/test_serve_server.py tests/test_resilience.py \
-         tests/test_runtime_stream.py; do
+         tests/test_runtime_stream.py tests/test_obs.py; do
     JAX_PLATFORMS=cpu python -m dcfm_tpu.analysis.isolate "$f" \
         -- -q -m 'not slow' --continue-on-collection-errors \
         -p no:cacheprovider -p no:xdist -p no:randomly || exit 1

@@ -1,0 +1,21 @@
+"""Known-bad fixture for DCFM9xx: telemetry bypassing the obs layer."""
+import sys
+
+
+def report_progress(iteration):
+    # bare print: invisible to the flight recorder (DCFM901)
+    print(f"iteration {iteration}")
+
+
+def report_to_stderr(msg):
+    # explicit console handle is still console output (DCFM901)
+    print(msg, file=sys.stderr)
+
+
+def raw_stream_write(msg):
+    # sys.stderr.write is the same bypass in stream form (DCFM901)
+    sys.stderr.write(msg + "\n")
+
+
+def raw_stdout_write(msg):
+    sys.stdout.write(msg)

@@ -1,0 +1,23 @@
+"""Known-good fixture for DCFM9xx: sanctioned output shapes."""
+import sys
+import warnings
+
+
+def parameterized_sink(msg, out):
+    # the caller decides the sink: parameterized output, not console
+    # telemetry (the isolate runner's `out` parameter shape)
+    print(msg, file=out)
+
+
+def warned_failure(e):
+    # warnings / logging are surfaced failures, not telemetry bypass
+    warnings.warn(f"failed: {e!r}", RuntimeWarning)
+
+
+def annotated_protocol_line(payload):
+    print(payload, file=sys.stderr)  # dcfm: ignore[DCFM901] - documented stderr JSON protocol
+
+
+def recorded(record, iteration):
+    # the sanctioned path: emit through the obs recorder
+    record("chunk", iteration=iteration)

@@ -78,6 +78,40 @@ def test_bad_runtime_fires_801():
     assert _rules_fired("bad_runtime.py") == {"DCFM801"}
 
 
+def test_bad_obs_fires_901():
+    assert _rules_fired("bad_obs.py") == {"DCFM901"}
+
+
+def test_bad_obs_flags_every_output_shape():
+    findings = lint_file(os.path.join(FIXTURES, "bad_obs.py"))
+    msgs = [f.message for f in findings if f.rule == "DCFM901"]
+    # bare print, print(file=sys.stderr), and both raw stream writes
+    assert len(msgs) == 4
+    assert any("print()" in m for m in msgs)
+    assert any("sys.stderr.write" in m for m in msgs)
+    assert any("sys.stdout.write" in m for m in msgs)
+
+
+def test_obs_rule_exempts_cli_and_main_modules():
+    src = "print('hello')\n"
+    assert any(f.rule == "DCFM901" for f in lint_source(src, "mod.py"))
+    assert not any(f.rule == "DCFM901"
+                   for f in lint_source(src, "dcfm_tpu/cli.py"))
+    assert not any(f.rule == "DCFM901"
+                   for f in lint_source(src,
+                                        "dcfm_tpu/analysis/__main__.py"))
+    # obs/cli.py (the events subcommand) is exempt by basename too
+    assert not any(f.rule == "DCFM901"
+                   for f in lint_source(src, "dcfm_tpu/obs/cli.py"))
+
+
+def test_obs_rule_parameterized_file_handle_is_quiet():
+    src = ("def f(msg, out):\n"
+           "    print(msg, file=out)\n")
+    assert not any(f.rule == "DCFM901"
+                   for f in lint_source(src, "mod.py"))
+
+
 def test_bad_runtime_flags_every_fetch_shape():
     findings = lint_file(os.path.join(FIXTURES, "bad_runtime.py"))
     msgs = [f.message for f in findings if f.rule == "DCFM801"]
@@ -162,7 +196,7 @@ def test_every_rule_family_has_a_firing_fixture():
 @pytest.mark.parametrize("name", [
     "good_rng.py", "good_jit.py", "good_dtype.py", "good_ffi.py",
     "good_thread.py", "good_server.py", "good_robust.py",
-    "good_multihost.py", "good_runtime.py"])
+    "good_multihost.py", "good_runtime.py", "good_obs.py"])
 def test_good_fixture_is_clean(name):
     findings = lint_file(os.path.join(FIXTURES, name))
     assert findings == [], [str(f) for f in findings]

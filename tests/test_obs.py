@@ -1,0 +1,661 @@
+"""Observability subsystem (dcfm_tpu/obs): flight recorder, spans, metrics.
+
+Three layers of coverage:
+
+* units - recorder crash-safety (torn final line tolerated on replay,
+  thread-safe concurrent emit), the metrics registry (snapshot,
+  legacy-percentile rule, Prometheus text exposition checked against a
+  minimal grammar parser - no new deps), span/trace derivation;
+* fit integration - a recorded fit emits the typed event sequence,
+  ``obs="off"`` is bitwise-identical, checkpointed fits auto-record
+  into ``<checkpoint>.obs`` and resumed fits log their resume decision;
+* the crash lane - a REAL supervised SIGKILL leaves a flight-recorder
+  log that replays cleanly and from which ``dcfm-tpu events`` reports
+  the death, the launches, and the resume decision WITHOUT reading any
+  checkpoint payload; one seeded ``DCFM_FAULT_FUZZ`` point replays with
+  the injected fault named in the log (the fuzz-failure post-mortem
+  story, end to end).
+"""
+
+import json
+import os
+import re
+import subprocess
+import sys
+import threading
+import tempfile
+
+import numpy as np
+import pytest
+
+from tests.conftest import make_synthetic
+
+from dcfm_tpu import BackendConfig, FitConfig, ModelConfig, RunConfig, fit
+from dcfm_tpu.obs import metrics as obs_metrics
+from dcfm_tpu.obs import recorder as obs_recorder
+from dcfm_tpu.obs.cli import summarize
+from dcfm_tpu.obs.recorder import (
+    FlightRecorder, read_events, read_events_with_stats, run_events,
+    tail_events)
+from dcfm_tpu.obs.spans import chrome_trace, overlap_fraction
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# recorder units
+# ---------------------------------------------------------------------------
+
+def test_recorder_roundtrip(tmp_path):
+    rec = FlightRecorder(str(tmp_path), role="L1.p0", run_id="abc")
+    rec.emit("chunk", start=0, end=8, dur_s=0.5)
+    rec.emit("checkpoint_save", iteration=8)
+    rec.flush(fsync=True)
+    rec.close()
+    evs = read_events(rec.path)
+    assert [e["event"] for e in evs] == ["chunk", "checkpoint_save"]
+    assert [e["seq"] for e in evs] == [0, 1]
+    assert all(e["run"] == "abc" and e["role"] == "L1.p0" for e in evs)
+    assert evs[0]["dur_s"] == 0.5 and evs[1]["iteration"] == 8
+
+
+def test_recorder_torn_final_line_tolerated(tmp_path):
+    """The one write a SIGKILL can land inside must not poison replay."""
+    rec = FlightRecorder(str(tmp_path), role="L1.p0")
+    rec.emit("chunk", start=0, end=8)
+    rec.close()
+    with open(rec.path, "a", encoding="utf-8") as f:
+        f.write('{"event": "chunk", "t": 1.0, "trunca')   # torn mid-line
+    evs, skipped = read_events_with_stats(rec.path)
+    assert [e["event"] for e in evs] == ["chunk"]
+    assert skipped == 1
+    # the merged-run reader tolerates it too
+    assert [e["event"] for e in run_events(str(tmp_path))] == ["chunk"]
+
+
+def test_recorder_concurrent_emit_is_line_atomic(tmp_path):
+    rec = FlightRecorder(str(tmp_path), role="L1.p0")
+    n_threads, per = 4, 50
+
+    def worker(k):
+        for i in range(per):
+            rec.emit("tick", thread=k, i=i)
+
+    ts = [threading.Thread(target=worker, args=(k,))
+          for k in range(n_threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    rec.close()
+    evs, skipped = read_events_with_stats(rec.path)
+    assert skipped == 0
+    assert len(evs) == n_threads * per
+    assert sorted(e["seq"] for e in evs) == list(range(len(evs)))
+
+
+def test_record_is_noop_without_active_recorder():
+    assert obs_recorder.active() is None
+    obs_recorder.record("chunk", start=0)          # must not raise
+    obs_recorder.record_sync("fault", op="kill")   # must not raise
+
+
+def test_active_recorder_stack(tmp_path):
+    a = FlightRecorder(str(tmp_path), role="supervisor")
+    b = FlightRecorder(str(tmp_path), role="L1.p0")
+    obs_recorder.install(a)
+    obs_recorder.install(b)
+    try:
+        assert obs_recorder.active() is b
+        obs_recorder.uninstall(b)
+        assert obs_recorder.active() is a
+        obs_recorder.uninstall(b)                  # idempotent
+        assert obs_recorder.active() is a
+    finally:
+        obs_recorder.uninstall(a)
+        obs_recorder.uninstall(b)
+        a.close()
+        b.close()
+    assert obs_recorder.active() is None
+
+
+def test_tail_events_filters_by_launch(tmp_path):
+    for role, n in (("L1.p0", 3), ("L2.p0", 2), ("supervisor", 4)):
+        rec = FlightRecorder(str(tmp_path), role=role)
+        for i in range(n):
+            rec.emit("tick", i=i)
+        rec.close()
+    t = tail_events(str(tmp_path), 5, launch=2)
+    assert len(t) == 2 and all(e["role"] == "L2.p0" for e in t)
+    assert len(tail_events(str(tmp_path), 5)) == 5
+
+
+# ---------------------------------------------------------------------------
+# metrics units
+# ---------------------------------------------------------------------------
+
+def test_counter_gauge_histogram_snapshot():
+    reg = obs_metrics.MetricsRegistry()
+    c = reg.counter("c_total", "a counter", labels=("kind",))
+    c.inc(kind="x")
+    c.inc(2, kind="x")
+    c.inc(kind="y")
+    g = reg.gauge("g", "a gauge")
+    g.set(7.5)
+    gf = reg.gauge("g_pull", "a pull gauge")
+    gf.set_function(lambda: 42.0)
+    h = reg.histogram("h_ms", (1.0, 10.0), "a histogram")
+    for v in (0.5, 5.0, 100.0):
+        h.observe(v)
+    snap = reg.snapshot()
+    cx = {tuple(s["labels"].items()): s["value"]
+          for s in snap["c_total"]["series"]}
+    assert cx[(("kind", "x"),)] == 3.0 and cx[(("kind", "y"),)] == 1.0
+    assert snap["g"]["series"][0]["value"] == 7.5
+    assert snap["g_pull"]["series"][0]["value"] == 42.0
+    hs = snap["h_ms"]["series"][0]
+    assert hs["count"] == 3 and hs["counts"] == [1, 1, 1]
+    assert hs["sum"] == pytest.approx(105.5)
+    assert snap["h_ms"]["buckets"] == [1.0, 10.0, "+Inf"]
+
+
+def test_histogram_percentile_matches_legacy_rule():
+    """The serve layer's historical readout: upper bound of the bucket
+    containing the quantile; the +Inf bucket reports the last finite
+    bound."""
+    h = obs_metrics.Histogram("h", "", (1.0, 2.0, 4.0))
+    for v in (0.5, 0.6, 1.5, 3.0):
+        h.observe(v)
+    assert h.percentile(0.50) == 1.0
+    assert h.percentile(0.99) == 4.0
+    h.observe(99.0)     # lands in +Inf -> reported as the last finite
+    assert h.percentile(0.999) == 4.0
+
+
+def test_registry_kind_and_label_mismatch_raises():
+    reg = obs_metrics.MetricsRegistry()
+    reg.counter("m", "x")
+    with pytest.raises(ValueError, match="already registered"):
+        reg.gauge("m", "x")
+    with pytest.raises(ValueError, match="already registered"):
+        reg.counter("m", "x", labels=("a",))
+    # get-or-create: same signature returns the same object
+    assert reg.counter("m", "x") is reg.counter("m", "x")
+
+
+# -- minimal Prometheus text-format grammar (the acceptance parser) --------
+
+_PROM_NAME = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
+_PROM_LABELS = r'\{[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*"' \
+               r'(?:,[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*")*\}'
+_PROM_VALUE = r"(?:[+-]?(?:\d+(?:\.\d+)?(?:[eE][+-]?\d+)?|Inf)|NaN)"
+_PROM_SAMPLE_RE = re.compile(
+    rf"^({_PROM_NAME})(?:{_PROM_LABELS})? {_PROM_VALUE}$")
+
+
+def parse_prometheus(text: str) -> dict:
+    """Minimal Prometheus text-format (0.0.4) parser: validates every
+    line against the grammar and returns {metric name: type}.  Raises
+    AssertionError on any malformed line."""
+    types = {}
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("# HELP "):
+            assert re.match(rf"^# HELP {_PROM_NAME} ", line), line
+            continue
+        if line.startswith("# TYPE "):
+            m = re.match(rf"^# TYPE ({_PROM_NAME}) "
+                         r"(counter|gauge|histogram|summary|untyped)$",
+                         line)
+            assert m, line
+            types[m.group(1)] = m.group(2)
+            continue
+        assert not line.startswith("#"), f"unknown comment: {line}"
+        m = _PROM_SAMPLE_RE.match(line)
+        assert m, f"malformed sample line: {line!r}"
+    return types
+
+
+def test_render_prometheus_parses_and_histogram_invariants():
+    reg = obs_metrics.MetricsRegistry()
+    h = reg.histogram("lat_ms", (0.5, 2.5), "latency",
+                      labels=("route",))
+    for v in (0.1, 1.0, 9.0):
+        h.observe(v, route="/v1/entry")
+    reg.counter("resp_total", "responses", labels=("status",)).inc(
+        status="200")
+    reg.gauge("up", "uptime").set(1.25)
+    text = obs_metrics.render_prometheus(reg)
+    types = parse_prometheus(text)
+    assert types == {"lat_ms": "histogram", "resp_total": "counter",
+                     "up": "gauge"}
+    # histogram invariants: cumulative buckets nondecreasing, +Inf
+    # bucket equals _count
+    buckets = [int(l.rsplit(" ", 1)[1]) for l in text.splitlines()
+               if l.startswith("lat_ms_bucket")]
+    assert buckets == sorted(buckets)
+    count = int([l for l in text.splitlines()
+                 if l.startswith("lat_ms_count")][0].rsplit(" ", 1)[1])
+    assert buckets[-1] == count == 3
+    assert 'le="+Inf"' in text
+
+
+# ---------------------------------------------------------------------------
+# spans units
+# ---------------------------------------------------------------------------
+
+def _ev(event, t, role="L1.p0", **kw):
+    return {"event": event, "t": t, "mono": t, "run": "r", "role": role,
+            "seq": 0, **kw}
+
+
+def test_chrome_trace_spans_and_instants():
+    evs = [
+        _ev("chunk", 10.0, dur_s=2.0, start=0, end=8),
+        _ev("stream_drain", 9.5, dur_s=1.0, final=False),
+        _ev("fault", 9.9, op="kill"),
+        _ev("supervisor_launch", 8.0, role="supervisor", attempt=1),
+    ]
+    tr = chrome_trace(evs)
+    xs = {e["name"]: e for e in tr["traceEvents"] if e["ph"] == "X"}
+    instants = {e["name"] for e in tr["traceEvents"] if e["ph"] == "i"}
+    assert set(xs) == {"chunk", "stream_drain"}
+    assert instants == {"fault", "supervisor_launch"}
+    # the chunk span [8, 10] and the drain span [8.5, 9.5] overlap
+    c, d = xs["chunk"], xs["stream_drain"]
+    assert c["ts"] < d["ts"] + d["dur"] and d["ts"] < c["ts"] + c["dur"]
+    # same process, different tracks; supervisor on its own pid
+    assert c["pid"] == d["pid"] and c["tid"] != d["tid"]
+    sup = [e for e in tr["traceEvents"]
+           if e["ph"] == "i" and e["name"] == "supervisor_launch"][0]
+    assert sup["pid"] != c["pid"]
+    json.dumps(tr)   # serializable as-is
+
+
+def test_overlap_fraction_geometric_and_fit_done_priority():
+    evs = [
+        _ev("chunk", 10.0, dur_s=2.0),            # [8, 10]
+        _ev("stream_drain", 9.0, dur_s=1.0),      # [8, 9] fully hidden
+        _ev("stream_drain", 11.0, dur_s=1.0),     # [10, 11] fully exposed
+    ]
+    assert overlap_fraction(evs) == pytest.approx(0.5)
+    evs.append(_ev("fit_done", 12.0,
+                   stream={"overlap_fraction": 0.875}))
+    assert overlap_fraction(evs) == 0.875
+    assert overlap_fraction([_ev("chunk", 1.0, dur_s=1.0)]) is None
+
+
+# ---------------------------------------------------------------------------
+# fit integration
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def data():
+    Y, _ = make_synthetic(n=40, p=24, k_true=3, seed=11)
+    return Y
+
+
+def _cfg(**kw):
+    return FitConfig(
+        model=ModelConfig(num_shards=2, factors_per_shard=2, rho=0.7),
+        run=RunConfig(burnin=8, mcmc=8, thin=1, seed=0, chunk_size=4),
+        backend=BackendConfig(fetch_dtype="quant8"), **kw)
+
+
+def test_fit_records_event_sequence(tmp_path, data):
+    obs = str(tmp_path / "obs")
+    res = fit(data, _cfg(obs=obs))
+    assert res.events_path == os.path.abspath(obs)
+    evs = run_events(obs)
+    kinds = [e["event"] for e in evs]
+    assert kinds[0] == "fit_start"
+    assert kinds[-1] == "fit_done"
+    assert kinds.count("chunk") == 4                  # 16 iters / 4
+    assert "resume_decision" in kinds                 # fresh start
+    fresh = [e for e in evs if e["event"] == "resume_decision"][0]
+    assert fresh["decision"] == "fresh"
+    # the streamed fetch engaged (quant8 single-process): snapshots were
+    # dispatched and drained, and fit_done carries the stream summary
+    assert "stream_snapshot" in kinds and "stream_drain" in kinds
+    done = evs[-1]
+    assert done["stream"]["snapshots"] == res.stream_stats["snapshots"]
+    assert "overlap_fraction" in done["stream"]
+    # chunk events carry spans the trace can draw
+    chunk = [e for e in evs if e["event"] == "chunk"][0]
+    assert chunk["dur_s"] > 0 and chunk["end"] - chunk["start"] == 4
+    # the summarizer reads the same dir
+    s = summarize(obs)
+    assert s["chunks"] == 4 and s["phases"] is not None
+
+
+def test_obs_off_is_bitwise_identical(tmp_path, data):
+    res_rec = fit(data, _cfg(obs=str(tmp_path / "obs2")))
+    res_off = fit(data, _cfg(obs="off"))
+    np.testing.assert_array_equal(res_rec.Sigma, res_off.Sigma)
+    assert res_off.events_path is None
+
+
+def test_obs_auto_is_off_without_a_destination(data, monkeypatch):
+    monkeypatch.delenv("DCFM_OBS_DIR", raising=False)
+    res = fit(data, _cfg())          # auto, no checkpoint, no env
+    assert res.events_path is None
+
+
+def test_obs_auto_records_next_to_checkpoint_and_logs_resume(
+        tmp_path, data):
+    ck = str(tmp_path / "ck.npz")
+    cfg = _cfg(checkpoint_path=ck)
+    fit(data, cfg)
+    obs = ck + ".obs"
+    assert os.path.isdir(obs)
+    evs = run_events(obs)
+    saves = [e for e in evs if e["event"] == "checkpoint_save"]
+    assert saves and saves[-1]["iteration"] == 16
+    # a resumed (finished) run appends its own resume decision
+    fit(data, FitConfig(model=cfg.model, run=cfg.run,
+                        backend=cfg.backend, checkpoint_path=ck,
+                        resume=True))
+    evs = run_events(obs)
+    dec = [e for e in evs if e["event"] == "resume_decision"]
+    assert dec[-1]["decision"] == "resume"
+    assert dec[-1]["iteration"] == 16
+
+
+def test_fit_updates_default_registry_gauges(tmp_path, data):
+    fit(data, _cfg(obs=str(tmp_path / "obs3")))
+    reg = obs_metrics.default_registry()
+    assert reg.gauge("dcfm_fit_iteration").value() == 16.0
+    assert reg.gauge("dcfm_fit_chunk_seconds").value() > 0.0
+
+
+def test_env_obs_dir_wins_under_auto(tmp_path, data, monkeypatch):
+    env_dir = str(tmp_path / "envobs")
+    monkeypatch.setenv("DCFM_OBS_DIR", env_dir)
+    res = fit(data, _cfg())
+    assert res.events_path == os.path.abspath(env_dir)
+    assert any(e["event"] == "fit_done" for e in run_events(env_dir))
+
+
+# ---------------------------------------------------------------------------
+# serve: JSON back-compat + Prometheus exposition + identity
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def server(data, tmp_path_factory):
+    import urllib.request
+
+    from dcfm_tpu.serve.server import PosteriorServer
+
+    res = fit(data, _cfg(obs="off"))
+    art_dir = str(tmp_path_factory.mktemp("obs-serve") / "artifact")
+    art = res.export_artifact(art_dir)
+    srv = PosteriorServer(art, port=0)
+    host, port = srv.start()
+    base = f"http://{host}:{port}"
+    # prime the latency histograms
+    for i, j in ((0, 1), (2, 3)):
+        with urllib.request.urlopen(f"{base}/v1/entry?i={i}&j={j}",
+                                    timeout=30) as r:
+            json.loads(r.read())
+    yield srv, base
+    srv.close()
+
+
+def _get(base, path):
+    import urllib.request
+    with urllib.request.urlopen(base + path, timeout=30) as r:
+        return r.status, dict(r.headers), r.read()
+
+
+def test_metrics_json_keeps_legacy_shape(server):
+    srv, base = server
+    _, _, body = _get(base, "/metrics")
+    m = json.loads(body)
+    # the pre-obs keys, unchanged
+    for key in ("latency", "statuses", "cache", "batcher", "uptime_s"):
+        assert key in m
+    lat = m["latency"]["/v1/entry"]
+    assert set(lat) == {"count", "mean_ms", "p50_ms", "p99_ms",
+                        "buckets_ms"}
+    assert list(lat["buckets_ms"]) == [
+        "0.25", "0.5", "1.0", "2.5", "5.0", "10.0", "25.0", "50.0",
+        "100.0", "250.0", "1000.0", "inf"]
+    assert sum(lat["buckets_ms"].values()) == lat["count"] >= 2
+    # the new identity block rides along
+    assert m["artifact"]["fingerprint"] == srv.artifact.fingerprint
+    assert m["artifact"]["generation"] == 0
+
+
+def test_healthz_and_headers_carry_artifact_identity(server):
+    srv, base = server
+    _, headers, body = _get(base, "/healthz")
+    h = json.loads(body)
+    assert h["artifact_fingerprint"] == srv.artifact.fingerprint
+    assert h["artifact_generation"] == 0
+    assert headers["X-DCFM-Artifact-Generation"] == "0"
+    # query responses are generation-tagged too (the hot-swap prereq)
+    _, eh, _ = _get(base, "/v1/entry?i=0&j=0")
+    assert eh["X-DCFM-Artifact-Generation"] == "0"
+
+
+def test_prometheus_exposition_parses_under_minimal_grammar(server):
+    srv, base = server
+    status, headers, body = _get(base, "/metrics?format=prometheus")
+    assert status == 200
+    assert headers["Content-Type"].startswith("text/plain")
+    assert "version=0.0.4" in headers["Content-Type"]
+    text = body.decode()
+    types = parse_prometheus(text)
+    assert types["dcfm_serve_request_latency_ms"] == "histogram"
+    assert types["dcfm_serve_responses_total"] == "counter"
+    assert types["dcfm_serve_cache"] == "gauge"
+    assert types["dcfm_serve_batcher"] == "gauge"
+    assert types["dcfm_serve_artifact_generation"] == "gauge"
+    # fit-side gauges from the process default registry ride the scrape
+    assert types["dcfm_fit_iteration"] == "gauge"
+    assert f'fingerprint="{srv.artifact.fingerprint}"' in text
+    # per-route histogram series with cumulative-bucket invariants
+    entry_buckets = [
+        int(l.rsplit(" ", 1)[1]) for l in text.splitlines()
+        if l.startswith("dcfm_serve_request_latency_ms_bucket")
+        and 'route="/v1/entry"' in l]
+    assert entry_buckets == sorted(entry_buckets)
+    entry_count = [
+        int(l.rsplit(" ", 1)[1]) for l in text.splitlines()
+        if l.startswith("dcfm_serve_request_latency_ms_count")
+        and 'route="/v1/entry"' in l][0]
+    assert entry_buckets[-1] == entry_count >= 2
+
+
+# ---------------------------------------------------------------------------
+# crash lane: real supervised SIGKILL -> flight record -> events CLI
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def data_file(tmp_path_factory, data):
+    d = tmp_path_factory.mktemp("obs-crash")
+    p = str(d / "Y.npy")
+    np.save(p, data)
+    return p
+
+
+def _child_env(plan=None, fuzz=None):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["JAX_COMPILATION_CACHE_DIR"] = os.path.join(REPO, ".jax_cache")
+    for k in ("DCFM_FAULT_PLAN", "DCFM_FAULT_FUZZ", "DCFM_OBS_DIR",
+              "DCFM_RUN_ID"):
+        env.pop(k, None)
+    if plan is not None:
+        env["DCFM_FAULT_PLAN"] = json.dumps(plan)
+    if fuzz is not None:
+        env["DCFM_FAULT_FUZZ"] = fuzz
+    return env
+
+
+def _cli_fit(data_path, out, extra, env):
+    return subprocess.run(
+        [sys.executable, "-m", "dcfm_tpu.cli", "fit", data_path,
+         "--shards", "2", "--factors", "6", "--burnin", "16",
+         "--mcmc", "16", "--thin", "2", "--chunk-size", "8",
+         "--out", out] + extra,
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=600)
+
+
+def test_supervised_sigkill_leaves_replayable_flight_record(
+        tmp_path, data_file):
+    """THE post-mortem acceptance path: a real SIGKILL mid-run under
+    --supervise leaves a flight-recorder log that (a) replays cleanly
+    (torn tail tolerated), (b) names the injected fault, the death, and
+    the launch-2 resume decision, and (c) `dcfm-tpu events` summarizes
+    it - all without reading any checkpoint payload."""
+    out = str(tmp_path / "s.npy")
+    ck = str(tmp_path / "ck.npz")
+    plan = {"faults": [{"op": "kill", "at_iteration": 16,
+                        "when": "post_save"}]}
+    proc = _cli_fit(
+        data_file, out,
+        ["--checkpoint", ck, "--checkpoint-every", "1",
+         "--keep-last", "2", "--supervise",
+         "--supervise-backoff", "0.05"],
+        _child_env(plan))
+    assert proc.returncode == 0, proc.stderr
+    obs = ck + ".obs"
+    names = sorted(os.listdir(obs))
+    assert "events-supervisor.jsonl" in names
+    assert "events-L1.p0.jsonl" in names and "events-L2.p0.jsonl" in names
+    # (a) every file replays without raising - the kill landed mid-run
+    for f in names:
+        read_events_with_stats(os.path.join(obs, f))
+    evs = run_events(obs)
+    kinds = [e["event"] for e in evs]
+    # (b) the log tells the whole story: fault -> death -> relaunch ->
+    # resume -> completion
+    fault = [e for e in evs if e["event"] == "fault"][0]
+    assert fault["op"] == "kill" and fault["role"] == "L1.p0"
+    death = [e for e in evs if e["event"] == "supervisor_death"][0]
+    assert death["exit"] == -9 and death["iteration"] == 16
+    launches = [e for e in evs if e["event"] == "supervisor_launch"]
+    assert [l["attempt"] for l in launches] == [1, 2]
+    assert launches[1]["checkpoint_iteration"] == 16
+    resumes = [e for e in evs if e["event"] == "resume_decision"]
+    assert resumes[0]["decision"] == "fresh"
+    assert (resumes[-1]["decision"], resumes[-1]["iteration"]) == \
+        ("resume", 16)
+    assert "supervisor_done" in kinds and "checkpoint_save" in kinds
+    # run id is shared across the supervisor and both launches
+    assert len({e["run"] for e in evs}) == 1
+    # (c) the CLI summary, via the real entry point
+    p2 = subprocess.run(
+        [sys.executable, "-m", "dcfm_tpu.cli", "events", obs],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert p2.returncode == 0, p2.stderr
+    assert "death (exit -9) at checkpoint iteration 16" in p2.stdout
+    assert "resume at iteration 16" in p2.stdout
+    assert "launch #2 from checkpoint iteration 16" in p2.stdout
+    assert "fault injected" in p2.stdout
+    # and the Chrome trace export loads as trace-event JSON with chain
+    # spans (what Perfetto renders)
+    trace_path = str(tmp_path / "trace.json")
+    p3 = subprocess.run(
+        [sys.executable, "-m", "dcfm_tpu.cli", "events", obs,
+         "--trace", trace_path, "--json"],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert p3.returncode == 0, p3.stderr
+    with open(trace_path) as f:
+        tr = json.load(f)
+    span_names = {e["name"] for e in tr["traceEvents"]
+                  if e.get("ph") == "X"}
+    assert "chunk" in span_names and "checkpoint_save" in span_names
+    summary = json.loads(p3.stdout.strip().splitlines()[-1])
+    assert summary["deaths"][0]["exit"] == -9
+
+
+def test_fuzz_point_replay_names_fault_and_resume(tmp_path, data_file):
+    """Satellite: one seeded DCFM_FAULT_FUZZ point through the real
+    supervised CLI; the flight recorder's event sequence must name the
+    injected fault and the relaunch's resume decision - a fuzz failure
+    is triaged from the log, not by rerunning."""
+    from dcfm_tpu.resilience import faults
+
+    seed = 20260804
+    # deterministically pick the first point whose DEFAULT-knob plan
+    # (what DCFM_FAULT_FUZZ=seed:index itself expands to) is a launch-1
+    # boundary kill - guarantees a death and a launch-2 resume
+    index, planned = next(
+        (i, faults.fuzz_spec(seed, i)["faults"][0])
+        for i in range(64)
+        if [f["op"] for f in faults.fuzz_spec(seed, i)["faults"]]
+        == ["kill"])
+    out = str(tmp_path / "fz.npy")
+    ck = str(tmp_path / "fz.ck.npz")
+    env = _child_env(fuzz=f"{seed}:{index}")
+    # the point's process gate names which host the kill lands on; this
+    # single-process run plays that host
+    env["DCFM_FAULT_PROCESS"] = str(planned["process"])
+    proc = _cli_fit(
+        data_file, out,
+        ["--checkpoint", ck, "--checkpoint-every", "1",
+         "--keep-last", "2", "--supervise",
+         "--supervise-backoff", "0.05",
+         "--supervise-poison-deaths", "3"],
+        env)
+    assert proc.returncode == 0, proc.stderr
+    evs = run_events(ck + ".obs")
+    fired = [e for e in evs if e["event"] == "fault"]
+    assert fired, "the injected fault never reached the flight recorder"
+    assert fired[0]["op"] == "kill"
+    assert fired[0]["at_iteration"] == planned["at_iteration"]
+    assert fired[0]["when"] == planned["when"]
+    assert fired[0]["role"] == "L1.p0"
+    resumes = [e for e in evs if e["event"] == "resume_decision"
+               and str(e["role"]).startswith("L2.")]
+    assert resumes and resumes[-1]["decision"] in ("resume", "fresh")
+    deaths = [e for e in evs if e["event"] == "supervisor_death"]
+    assert deaths and deaths[0]["exit"] == -9
+
+
+@pytest.mark.slow
+def test_pod_supervised_kill_events_cli(tmp_path, data_file):
+    """Acceptance: a supervised 2-process pod run killed mid-stream
+    yields a flight-recorder log from which `dcfm-tpu events` reports
+    the death, the generation the relaunch resumed (promoted/unanimous),
+    and the resume decision - without reading checkpoint payloads."""
+    ck = str(tmp_path / "pod.ck.npz")
+    out = str(tmp_path / "pod.npy")
+    plan = {"faults": [{"op": "kill", "at_iteration": 16,
+                        "when": "post_save", "process": 0,
+                        "at_launch": 1}]}
+    env = _child_env(plan)
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [sys.executable, "-m", "dcfm_tpu.cli", "supervise",
+         "--backoff", "0.05", "--port-base", "29940", "--pod", "2",
+         "--watchdog", "420", "--",
+         "fit", data_file, "--shards", "2", "--factors", "6",
+         "--burnin", "16", "--mcmc", "16", "--thin", "2",
+         "--chunk-size", "8", "--checkpoint", ck, "--out", out],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=1200)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    obs = ck + ".obs"
+    evs = run_events(obs)
+    deaths = [e for e in evs if e["event"] == "supervisor_death"]
+    assert deaths and deaths[0]["exit"] == -9
+    # both hosts' launch-2 processes logged their (collective) resume
+    resumed = {e["role"] for e in evs
+               if e["event"] == "resume_decision"
+               and str(e["role"]).startswith("L2.")}
+    assert resumed == {"L2.p0", "L2.p1"}
+    p2 = subprocess.run(
+        [sys.executable, "-m", "dcfm_tpu.cli", "events", obs, "--json"],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert p2.returncode == 0, p2.stderr
+    s = json.loads(p2.stdout.strip().splitlines()[-1])
+    assert s["deaths"] and s["deaths"][0]["exit"] == -9
+    # the generation the relaunch started from is in the launch record
+    # (a checkpoint_promote event additionally appears whenever the
+    # unanimity pre-pass had to repair mixed generations)
+    assert s["launches"][-1]["checkpoint_iteration"] >= 8
+    assert any(r["decision"] in ("resume", "fresh")
+               for r in s["resume_decisions"])
