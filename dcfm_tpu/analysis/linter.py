@@ -1159,6 +1159,98 @@ def _check_obs(mod: _Module, rep: _Reporter) -> None:
 
 
 # =====================================================================
+# DCFM10xx - serving discipline
+# =====================================================================
+
+# handler base classes whose route methods run one-per-request on a
+# handler thread - the threads a single slow client can park forever
+_HANDLER_CLASSES = {
+    "BaseHTTPRequestHandler", "SimpleHTTPRequestHandler",
+    "CGIHTTPRequestHandler", "StreamRequestHandler",
+    "DatagramRequestHandler", "BaseRequestHandler",
+}
+
+_ROUTE_METHOD_RE = re.compile(r"^(do_[A-Z]\w*|handle|handle_one_request)$")
+
+# socket methods that block until the PEER acts - unbounded on a socket
+# with no timeout
+_SOCKET_BLOCKING_OPS = {"recv", "recv_into", "recvfrom", "accept",
+                        "connect"}
+
+
+def _check_handlers(mod: _Module, rep: _Reporter) -> None:
+    """DCFM1001: unbounded blocking wait inside a request-handler route
+    method.  A route method (``do_GET``/``handle``/... of a
+    ``BaseHTTPRequestHandler``/``StreamRequestHandler`` subclass) runs
+    on a per-request handler thread; a ``.join()`` or queue ``.get()``
+    with no timeout, or a blocking op on a socket the method itself
+    created and never ``settimeout``-ed, lets one slow peer park that
+    thread forever - the slow-loris hang class.  Every wait in a
+    request path must carry a deadline."""
+    for cls in ast.walk(mod.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        if not any(_last(mod.resolve(b)) in _HANDLER_CLASSES
+                   for b in cls.bases):
+            continue
+        for meth in cls.body:
+            if not isinstance(meth, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            if not _ROUTE_METHOD_RE.match(meth.name):
+                continue
+            # sockets this method creates, and which of them it bounds
+            made_sockets: set = set()
+            timed_sockets: set = set()
+            for n in ast.walk(meth):
+                if (isinstance(n, ast.Assign)
+                        and isinstance(n.value, ast.Call)
+                        and mod.resolve(n.value.func) in {
+                            "socket.socket", "socket.create_connection"}):
+                    has_timeout = any(k.arg == "timeout"
+                                      for k in n.value.keywords)
+                    for tgt in n.targets:
+                        if isinstance(tgt, ast.Name):
+                            (timed_sockets if has_timeout
+                             else made_sockets).add(tgt.id)
+                if (isinstance(n, ast.Call)
+                        and isinstance(n.func, ast.Attribute)
+                        and n.func.attr == "settimeout"
+                        and isinstance(n.func.value, ast.Name)):
+                    timed_sockets.add(n.func.value.id)
+            for n in ast.walk(meth):
+                if not (isinstance(n, ast.Call)
+                        and isinstance(n.func, ast.Attribute)):
+                    continue
+                attr = n.func.attr
+                has_timeout_kw = any(k.arg == "timeout"
+                                     for k in n.keywords)
+                if (attr == "join" and not n.args and not n.keywords):
+                    rep.emit("DCFM1001", n,
+                             f"timeout-less .join() inside handler route "
+                             f"{cls.name}.{meth.name} - one wedged "
+                             "thread parks this handler thread forever; "
+                             "join(timeout=...) and handle the miss")
+                elif (attr == "get" and not n.args
+                        and not has_timeout_kw):
+                    rep.emit("DCFM1001", n,
+                             f"timeout-less blocking .get() inside "
+                             f"handler route {cls.name}.{meth.name} - an "
+                             "empty queue parks this handler thread "
+                             "forever; get(timeout=...) and map the "
+                             "Empty to a typed 503/504")
+                elif (attr in _SOCKET_BLOCKING_OPS
+                        and isinstance(n.func.value, ast.Name)
+                        and n.func.value.id in made_sockets
+                        and n.func.value.id not in timed_sockets):
+                    rep.emit("DCFM1001", n,
+                             f".{attr}() on a timeout-less socket inside "
+                             f"handler route {cls.name}.{meth.name} - a "
+                             "silent peer blocks forever; settimeout() "
+                             "the socket the method created")
+
+
+# =====================================================================
 # driver
 # =====================================================================
 
@@ -1180,6 +1272,7 @@ def lint_source(source: str, path: str = "<string>") -> list:
     _check_multihost(mod, rep)
     _check_pipeline(mod, rep)
     _check_obs(mod, rep)
+    _check_handlers(mod, rep)
     rep.findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return rep.findings
 

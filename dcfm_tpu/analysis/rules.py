@@ -147,4 +147,15 @@ RULES = {r.id: r for r in [
          "already-dispatched async) must carry an inline "
          "`# dcfm: ignore[DCFM801] - <why>`",
          library_only=True),
+    # ---- DCFM10xx: serving discipline --------------------------------
+    Rule("DCFM1001", "handler-unbounded-blocking-wait", "serve",
+         "an HTTP/socketserver handler route method (do_GET/do_POST/"
+         "handle of a BaseHTTPRequestHandler/StreamRequestHandler "
+         "subclass) performs a blocking wait with no bound: .join() or "
+         "queue .get() with no timeout, or a socket operation "
+         "(recv/accept/connect) on a socket the method created without "
+         "settimeout.  One slow client then parks the handler thread "
+         "forever - the slow-loris hang class; every wait in a request "
+         "path must be deadline-bounded",
+         library_only=True),
 ]}

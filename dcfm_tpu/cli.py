@@ -110,6 +110,63 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--request-timeout", type=float, default=2.0,
                    help="per-request deadline (seconds); queued requests "
                         "past it fail 504 instead of being served late")
+    s.add_argument("--io-timeout", type=float, default=10.0,
+                   help="per-connection socket read/write timeout "
+                        "(seconds); bounds how long a slow-loris client "
+                        "can park a handler thread")
+    s.add_argument("--workers", type=int, default=1,
+                   help="run N supervised SO_REUSEPORT worker processes "
+                        "sharing the port (dead workers respawn with "
+                        "backoff; repeated instant deaths trip poison "
+                        "detection; SIGTERM drains the whole fleet)")
+    s.add_argument("--run-dir", default=None,
+                   help="fleet run directory (flight-recorder events, "
+                        "fleet.json liveness, worker logs); default "
+                        "$DCFM_OBS_DIR or a fresh temp dir")
+    s.add_argument("--swap-poll", type=float, default=0.5,
+                   help="seconds between promotion-pointer probes when "
+                        "the artifact path is a promotion root (a dir "
+                        "with a CURRENT pointer); SIGHUP forces a probe")
+    s.add_argument("--shed-high", type=float, default=0.75,
+                   help="batcher queue fill at which the expensive "
+                        "routes (/v1/block, /v1/interval) start "
+                        "shedding with typed 503 + Retry-After")
+    s.add_argument("--shed-low", type=float, default=0.50,
+                   help="queue fill at which shedding stops (hysteresis)")
+    s.add_argument("--fleet-backoff", type=float, default=0.5,
+                   help="base respawn backoff after an instant worker "
+                        "death (doubles per consecutive instant death)")
+    s.add_argument("--fleet-min-uptime", type=float, default=1.0,
+                   help="a worker dying faster than this counts as an "
+                        "instant death (poison candidate)")
+    s.add_argument("--fleet-poison-deaths", type=int, default=3,
+                   help="consecutive instant deaths of one worker that "
+                        "abort the fleet with a typed poison error")
+    s.add_argument("--fleet-grace", type=float, default=30.0,
+                   help="seconds SIGTERM'd workers get to drain before "
+                        "being reaped")
+    s.add_argument("--fleet-watchdog", type=float, default=0.0,
+                   help="hard bound on fleet lifetime in seconds "
+                        "(0 = unbounded); the chaos harness's no-hang "
+                        "guarantee")
+    s.add_argument("--reuse-port", action="store_true",
+                   help="bind with SO_REUSEPORT (set automatically for "
+                        "fleet workers)")
+    s.add_argument("--worker-index", type=int, default=None,
+                   help=argparse.SUPPRESS)
+
+    pr = sub.add_parser(
+        "promote", help="atomically publish an artifact to a live serving "
+        "fleet: CRC-verify the candidate, then replace the root's "
+        "CURRENT pointer (generation monotonic; workers hot-swap with "
+        "zero dropped requests)")
+    pr.add_argument("root", help="promotion root the fleet serves "
+                    "(`dcfm-tpu serve ROOT`)")
+    pr.add_argument("candidate", help="candidate artifact directory "
+                    "(inside or resolvable from the root)")
+    pr.add_argument("--no-verify", action="store_true",
+                    help="skip the full per-panel CRC sweep (workers "
+                         "still refuse a corrupt candidate at swap time)")
 
     f = sub.add_parser("fit", help="fit the model and write Sigma-hat")
     f.add_argument("data", help="observations, (n, p) .npy or .csv")
@@ -325,11 +382,22 @@ def main(argv=None) -> int:
     # existing artifact needs no accelerator stack at all, and export's
     # jax use (checkpoint template) is loaded lazily inside it.
     if args.command == "serve":
+        if getattr(args, "workers", 1) > 1:
+            from dcfm_tpu.serve.fleet import fleet_main
+            return fleet_main(args)
         from dcfm_tpu.serve.server import serve_main
         return serve_main(args)
     if args.command == "export":
         from dcfm_tpu.serve.artifact import export_main
         return export_main(args)
+    if args.command == "promote":
+        from dcfm_tpu.serve.promote import promote_artifact
+        st = promote_artifact(args.root, args.candidate,
+                              verify=not args.no_verify)
+        print(json.dumps({  # dcfm: ignore[DCFM901] - the promote CLI's stdout protocol
+            "promoted": st.target, "generation": st.generation,
+            "fingerprint": st.fingerprint}), flush=True)
+        return 0
     from dcfm_tpu.config import (
         BackendConfig, FitConfig, ModelConfig, RunConfig)
     from dcfm_tpu.api import fit

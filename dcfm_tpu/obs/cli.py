@@ -72,6 +72,25 @@ def summarize(run_dir: str, events=None, torn: int = 0) -> dict:
     stream = fit_done[-1].get("stream") if fit_done else None
     chunks = by.get("chunk", [])
     saves = by.get("checkpoint_save", [])
+    # serve-fleet events (dcfm-tpu serve --workers N run dirs)
+    worker_launches = [{"worker": e.get("worker"),
+                        "launch": e.get("launch"), "pid": e.get("pid")}
+                       for e in by.get("worker_launch", [])]
+    worker_deaths = [{"worker": e.get("worker"), "exit": e.get("exit"),
+                      "launch": e.get("launch"),
+                      "uptime_s": e.get("uptime_s")}
+                     for e in by.get("worker_death", [])]
+    swaps = [{"worker": e.get("worker"),
+              "generation": e.get("generation"),
+              "from_generation": e.get("from_generation")}
+             for e in by.get("serve_swap", [])]
+    swap_refusals = [{"worker": e.get("worker"),
+                      "reason": e.get("reason")}
+                     for e in by.get("serve_swap_refused", [])]
+    promotes = [{"target": e.get("target"),
+                 "generation": e.get("generation"),
+                 "verified": e.get("verified")}
+                for e in by.get("artifact_promote", [])]
     return {
         "run_dir": run_dir,
         "events": len(events),
@@ -95,6 +114,17 @@ def summarize(run_dir: str, events=None, torn: int = 0) -> dict:
         "phases": phases,
         "stream": stream,
         "overlap_fraction": overlap_fraction(events),
+        "worker_launches": worker_launches,
+        "worker_deaths": worker_deaths,
+        "serve_swaps": swaps,
+        "serve_swap_refusals": swap_refusals,
+        "serve_sheds": len([e for e in by.get("serve_shed", [])
+                            if e.get("active")]),
+        "serve_client_aborts": len(by.get("serve_client_abort", [])),
+        "artifact_promotions": promotes,
+        "fleet_poisoned": bool(by.get("fleet_poisoned")),
+        "fleet_watchdog_fired": bool(by.get("fleet_watchdog_fired")),
+        "fleet_drained": bool(by.get("fleet_drained")),
     }
 
 
@@ -149,6 +179,39 @@ def _print_summary(s: dict, out: List[str]) -> None:
     if s["overlap_fraction"] is not None:
         out.append(f"overlap fraction (drain hidden behind compute): "
                    f"{s['overlap_fraction']:.3f}")
+    if s["worker_launches"]:
+        out.append(f"serve workers launched: {len(s['worker_launches'])}")
+    if s["worker_deaths"]:
+        out.append(f"serve worker deaths: {len(s['worker_deaths'])}")
+        for d in s["worker_deaths"]:
+            out.append(f"  worker {d['worker']} died (exit {d['exit']}, "
+                       f"launch {d['launch']})")
+    if s["artifact_promotions"]:
+        for pr in s["artifact_promotions"]:
+            out.append(f"artifact promoted: {pr['target']} -> "
+                       f"generation {pr['generation']} "
+                       f"(verified={pr['verified']})")
+    if s["serve_swaps"]:
+        out.append(f"hot-swaps: {len(s['serve_swaps'])}")
+        for sw in s["serve_swaps"]:
+            out.append(f"  worker {sw['worker']}: generation "
+                       f"{sw['from_generation']} -> {sw['generation']}")
+    if s["serve_swap_refusals"]:
+        out.append(f"hot-swaps REFUSED (old artifact kept serving): "
+                   f"{len(s['serve_swap_refusals'])}")
+        for sw in s["serve_swap_refusals"]:
+            out.append(f"  worker {sw['worker']}: {sw['reason']}")
+    if s["serve_sheds"]:
+        out.append(f"load-shed episodes: {s['serve_sheds']}")
+    if s["serve_client_aborts"]:
+        out.append(f"client aborts/timeouts shed: "
+                   f"{s['serve_client_aborts']}")
+    if s["fleet_poisoned"]:
+        out.append("FLEET POISONED: repeated instant worker deaths")
+    if s["fleet_watchdog_fired"]:
+        out.append("FLEET WATCHDOG FIRED: supervision exceeded bound")
+    if s["fleet_drained"]:
+        out.append("fleet drained cleanly")
 
 
 def events_main(argv=None) -> int:

@@ -452,3 +452,97 @@ def fuzz_spec(seed: int, index: int, *,
                        "at_occurrence": 1, "at_launch": 2,
                        "process": rng.randrange(nproc)})
     return {"faults": faults}
+
+
+# ---------------------------------------------------------------------------
+# serve-side chaos (the serving fleet's seeded fuzz sweep)
+# ---------------------------------------------------------------------------
+
+# Events the SERVE path emits via fault_event: every request handler
+# fires ``serve_request`` before routing (a kill there is "worker
+# SIGKILLed mid-request"), and the hot-swap brackets its pointer
+# adoption with ``swap_begin`` / ``swap_commit`` (a kill inside the
+# window dies with the swap half-done - the respawned worker must come
+# up on whatever the pointer says NOW).  The promoter additionally
+# emits ``promote_pointer`` / ``promote_pointer_post`` around the
+# atomic rename (serve/promote.py).
+SERVE_FUZZ_EVENTS = ("serve_request", "swap_begin", "swap_commit")
+
+
+def serve_fuzz_spec(seed: int, index: int, *,
+                    workers: int = 2,
+                    max_requests: int = 40,
+                    io_max: int = 6) -> dict:
+    """The ``index``-th serve chaos point of a seeded deterministic
+    stream.  Same coordinates -> same spec, so a failing sweep point is
+    replayed exactly like :func:`fuzz_spec`'s.
+
+    The ``"faults"`` list is a normal fault plan the fleet exports to
+    its workers (:class:`FaultPlan` ignores the extra ``"serve"`` key);
+    ``"serve"`` carries DIRECTIVES FOR THE HARNESS itself - whether to
+    run a mid-load promotion, whether to corrupt the candidate first
+    (``promotion_fault``), and how many slow-loris clients to attach -
+    things that happen in the load generator / promoter process, not
+    inside a worker.
+
+    Five chaos shapes:
+
+    * ``worker_kill``: SIGKILL one worker at a random mid-load request
+      (``kill_event serve_request``) - the supervisor must respawn it
+      and no client request may be dropped (SO_REUSEPORT failover);
+    * ``swap_kill``: a promotion happens under load and one worker is
+      killed inside its swap window (``swap_begin``/``swap_commit``);
+    * ``torn_promotion``: the promoted candidate is corrupted first
+      (truncated file or flipped byte) - every worker must REFUSE the
+      swap and keep serving the old generation;
+    * ``io_fault``: ``io_delay`` (or, rarely, ``io_error``) on a random
+      panel dequant - requests slow down or fail TYPED, never untyped;
+    * ``slow_client``: slow-loris sockets squat on worker connections
+      while the real load runs - the per-connection io_timeout must
+      keep the fleet draining and serving.
+
+    Kills are gated ``"at_launch": 1`` for the same reason
+    :func:`fuzz_spec` gates its kills: the injected death models an
+    ENVIRONMENTAL failure, so the respawned worker (launch 2) runs
+    clean; without the gate the event counter resets per launch and the
+    kill re-fires forever, which correctly but uninterestingly ends in
+    the fleet's poison abort (poison containment has its own drill).
+    """
+    rng = random.Random(f"dcfm-serve-fuzz:{int(seed)}:{int(index)}")
+    kind = rng.choice(["worker_kill", "swap_kill", "torn_promotion",
+                       "io_fault", "slow_client"])
+    faults = []
+    serve = {"kind": kind, "promote": False, "promotion_fault": None,
+             "slow_clients": 0}
+    if kind == "worker_kill":
+        faults.append({"op": "kill_event", "event": "serve_request",
+                       "at_occurrence": rng.randint(1, max_requests),
+                       "process": rng.randrange(workers),
+                       "at_launch": 1})
+        # half the worker-kill points also promote mid-load: a death
+        # and a hot-swap racing is the interesting composition
+        serve["promote"] = rng.random() < 0.5
+    elif kind == "swap_kill":
+        faults.append({"op": "kill_event",
+                       "event": rng.choice(["swap_begin", "swap_commit"]),
+                       "at_occurrence": 1,
+                       "process": rng.randrange(workers),
+                       "at_launch": 1})
+        serve["promote"] = True
+    elif kind == "torn_promotion":
+        serve["promote"] = True
+        serve["promotion_fault"] = rng.choice(["torn", "bit_flip"])
+    elif kind == "io_fault":
+        op = "io_error" if rng.random() < 0.25 else "io_delay"
+        f = {"op": op, "target": "panel",
+             "at_write": rng.randint(1, io_max)}
+        if op == "io_delay":
+            f["seconds"] = round(rng.uniform(0.05, 0.25), 3)
+        if rng.random() < 0.5:
+            f["process"] = rng.randrange(workers)
+        faults.append(f)
+        serve["promote"] = rng.random() < 0.3
+    else:
+        serve["slow_clients"] = rng.randint(1, 2)
+        serve["promote"] = rng.random() < 0.3
+    return {"faults": faults, "serve": serve}

@@ -2,7 +2,8 @@
 
 Turns a completed fit into a durable, memory-mapped artifact and serves
 entry/block/interval queries over it concurrently - see README
-"Serving the posterior".  Layering (each importable without jax):
+"Serving the posterior" and "Serving fleet".  Layering (each importable
+without jax):
 
 * :mod:`dcfm_tpu.serve.artifact` - versioned on-disk format, export from
   a ``FitResult`` or a v6 checkpoint, ``np.memmap`` zero-copy open;
@@ -11,7 +12,15 @@ entry/block/interval queries over it concurrently - see README
 * :mod:`dcfm_tpu.serve.batcher` - panel-coalescing microbatcher with a
   bounded queue and explicit backpressure;
 * :mod:`dcfm_tpu.serve.server` - stdlib JSON HTTP API with latency
-  histograms, cache metrics, and graceful SIGTERM drain.
+  histograms, cache metrics, tiered load-shedding, atomic artifact
+  hot-swap, and graceful SIGTERM drain;
+* :mod:`dcfm_tpu.serve.promote` - the ``CURRENT`` promotion pointer:
+  CRC-verified atomic publication of a new artifact generation;
+* :mod:`dcfm_tpu.serve.fleet` - supervised ``--workers N``
+  SO_REUSEPORT replica fleet (respawn with backoff, poison detection,
+  graceful drain);
+* :mod:`dcfm_tpu.serve.loadgen` - seeded load generator + response
+  classifier, the chaos harness's ground truth.
 """
 
 from dcfm_tpu.serve.artifact import (
@@ -19,9 +28,14 @@ from dcfm_tpu.serve.artifact import (
     ArtifactVersionError, PosteriorArtifact, create_sparse_artifact,
     export_fit_result, export_from_checkpoint, quantize_panels,
     write_artifact)
-from dcfm_tpu.serve.batcher import DeadlineExceeded, Overloaded, QueryBatcher
+from dcfm_tpu.serve.batcher import (
+    BatcherClosed, DeadlineExceeded, Overloaded, QueryBatcher)
 from dcfm_tpu.serve.engine import PanelCache, QueryEngine
-from dcfm_tpu.serve.server import PosteriorServer
+from dcfm_tpu.serve.loadgen import run_load
+from dcfm_tpu.serve.promote import (
+    POINTER_FILE, PointerError, PointerState, promote_artifact,
+    read_pointer, verify_candidate)
+from dcfm_tpu.serve.server import GENERATION_HEADER, PosteriorServer
 
 __all__ = [
     "ARTIFACT_VERSION", "ArtifactCorruptError", "ArtifactError",
@@ -29,5 +43,7 @@ __all__ = [
     "PosteriorArtifact", "create_sparse_artifact", "export_fit_result",
     "export_from_checkpoint", "quantize_panels", "write_artifact",
     "QueryEngine", "PanelCache", "QueryBatcher", "Overloaded",
-    "DeadlineExceeded", "PosteriorServer",
+    "DeadlineExceeded", "BatcherClosed", "PosteriorServer",
+    "GENERATION_HEADER", "POINTER_FILE", "PointerError", "PointerState",
+    "promote_artifact", "read_pointer", "verify_candidate", "run_load",
 ]

@@ -37,6 +37,7 @@ import threading
 
 import numpy as np
 
+from dcfm_tpu.resilience.faults import fault_plan
 from dcfm_tpu.serve.artifact import PosteriorArtifact
 from dcfm_tpu.utils.preprocess import caller_to_shard_index
 
@@ -168,6 +169,12 @@ class QueryEngine:
         factor = self._factor[kind]
 
         def make():
+            # chaos seam: the serve-side io fault point is the cache-miss
+            # dequant (io_delay stalls it, io_error raises OSError the
+            # HTTP layer maps to a typed retryable 503)
+            plan = fault_plan()
+            if plan is not None:
+                plan.on_write("panel", f"{kind}:{pair}")
             self.artifact.verify_panel(kind, pair)
             p = raw[pair].astype(np.float32) * factor[pair]
             if diag:

@@ -15,45 +15,80 @@ dependency the container doesn't already have.  Endpoints:
   is loadable, ``degraded`` when it is not (``DCFM_NATIVE_DISABLE=1``
   or no compiler) - every query path is pure NumPy and keeps working in
   degraded mode; the flag exists so a fleet can see it.  ``draining``
-  once shutdown began.
+  once shutdown began.  Under a fleet supervisor the payload also
+  carries this worker's ``{index, pid}``, the promotion pointer's
+  current generation, and the supervisor's fleet-wide liveness table.
 * ``GET /metrics`` - per-endpoint latency histograms (p50/p99 + bucket
   counts), panel-cache hit/miss/eviction counters, batcher queue stats,
-  and the served artifact's fingerprint + generation tag.
+  hot-swap + load-shed counters, and the served artifact's
+  fingerprint + generation tag.
 * ``GET /metrics?format=prometheus`` - the same metrics in Prometheus
   text exposition format (0.0.4), rendered from the unified registry
   (``dcfm_tpu/obs/metrics.py``) the latency histograms live on - plus
   the process default registry, so an embedded fit's progress gauges
-  (iteration, chunk seconds, stream skips, sentinel rewinds,
-  checkpoint generation) ride the same scrape.
+  ride the same scrape.
 
-Every query response additionally carries the
-``X-DCFM-Artifact-Generation`` header - the tag a zero-downtime
-hot-swap (ROADMAP item 2) will bump on artifact promotion so clients
-can observe which posterior generation answered.
+Every query response carries the ``X-DCFM-Artifact-Generation`` header.
+The generation, engine, batcher, and artifact travel TOGETHER in one
+immutable ``_Epoch`` swapped by a single reference assignment: a
+request reads the epoch once and answers entirely from it, so the
+header always names the artifact that actually produced the bytes, and
+per-client generations are monotonically non-decreasing across a
+hot-swap (the epoch pointer only moves forward).
+
+Hot-swap: when constructed on a *promotion root* (a directory with a
+``CURRENT`` pointer - see ``serve/promote.py``) the server watches the
+pointer with a cheap ``os.stat`` probe (time-gated per request, or
+forced by SIGHUP), fully CRC-verifies the candidate, and installs a
+new epoch; in-flight requests finish on the old engine (the old
+batcher drains after the flip), and a torn/corrupt/mismatched
+candidate is REFUSED with a typed ``serve_swap_refused`` event while
+the old artifact keeps serving.
+
+Tiered load-shedding: under queue or latency pressure (batcher fill
+with hysteresis, windowed entry p99 against the deadline budget) the
+EXPENSIVE routes - ``/v1/block``, ``/v1/interval`` - shed first with a
+typed 503 + jittered ``Retry-After``; ``/v1/entry`` and ``/healthz``
+stay up (the batcher's own bounded queue protects entry with 429s).
+
+Slow-client discipline: every connection gets a read AND write socket
+timeout (``io_timeout``), so a slow-loris client parks a handler
+thread for at most that long instead of forever - ``block_on_close``
+joins handler threads at drain, so an unbounded read would otherwise
+stall SIGTERM shutdown fleet-wide.
 
 Shutdown discipline (dcfm-lint DCFM503): ``shutdown()`` +
 ``server_close()`` always run on the exit path - ``run()`` installs
 SIGTERM/SIGINT handlers that trigger a graceful drain (stop accepting,
-finish in-flight requests - ``block_on_close`` joins the handler
-threads - then close the batcher's worker).
+finish in-flight requests, then close the batcher's worker).
 """
 
 from __future__ import annotations
 
 import json
+import os
+import random
 import signal
+import socket
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlsplit
 
 from dcfm_tpu.obs import metrics as obs_metrics
+from dcfm_tpu.obs.recorder import record
+from dcfm_tpu.resilience.faults import fault_event
 from dcfm_tpu.serve.artifact import (
     ArtifactCorruptError, ArtifactError, PosteriorArtifact)
-from dcfm_tpu.serve.batcher import DeadlineExceeded, Overloaded, QueryBatcher
+from dcfm_tpu.serve.batcher import (
+    BatcherClosed, DeadlineExceeded, Overloaded, QueryBatcher)
 from dcfm_tpu.serve.engine import QueryEngine
+from dcfm_tpu.serve.promote import (
+    PointerError, is_pointer_root, pointer_stat, read_pointer,
+    verify_candidate)
 
 MAX_BLOCK_ENTRIES = 1 << 20       # 4 MB of float32 per response, maximum
+GENERATION_HEADER = "X-DCFM-Artifact-Generation"
 
 
 class _BadRequest(ValueError):
@@ -117,19 +152,47 @@ def _parse_indices(spec: str, p: int) -> list:
     return out
 
 
+class _Epoch:
+    """One servable generation: artifact + engine + batcher + tag.
+
+    Immutable after construction and swapped by a single reference
+    assignment, so any request that read the epoch once answers
+    consistently - the value, the error type, and the generation header
+    all come from the same artifact even while a hot-swap lands."""
+
+    __slots__ = ("artifact", "engine", "batcher", "generation")
+
+    def __init__(self, artifact, engine, batcher, generation):
+        self.artifact = artifact
+        self.engine = engine
+        self.batcher = batcher
+        self.generation = generation
+
+
 class _Handler(BaseHTTPRequestHandler):
     server_version = "dcfm-serve/1"
     protocol_version = "HTTP/1.1"
-    # socket timeout: an idle keep-alive connection must not hold its
-    # handler thread open forever - block_on_close joins handler threads
-    # at drain, so an unbounded read here would stall SIGTERM shutdown
+    # fallback socket timeout; setup() overrides it per connection from
+    # the server's io_timeout knob
     timeout = 10
+
+    def setup(self):
+        # per-connection read AND write timeout: settimeout covers both
+        # directions, so neither a slow-loris request (drip-fed header)
+        # nor a stuffed client that never drains our response can park
+        # this handler thread past the bound - block_on_close joins
+        # handler threads at drain, so an unbounded socket op here would
+        # stall SIGTERM shutdown fleet-wide
+        self.timeout = self.server.io_timeout
+        super().setup()
 
     def log_message(self, fmt, *args):   # latency lives in /metrics
         pass
 
     def do_GET(self):                    # noqa: N802 (stdlib API name)
         app = self.server.app
+        # chaos seam: a kill_event here is "worker SIGKILLed mid-request"
+        fault_event("serve_request")
         parts = urlsplit(self.path)
         t0 = time.perf_counter()
         status, payload, headers = app.handle(parts.path,
@@ -143,17 +206,24 @@ class _Handler(BaseHTTPRequestHandler):
         else:
             body = json.dumps(payload).encode()
             ctype = "application/json"
-        self.send_response(status)
-        self.send_header("Content-Type", ctype)
-        self.send_header("Content-Length", str(len(body)))
-        # generation-tagged responses: which posterior generation
-        # answered (bumped on artifact hot-swap - ROADMAP item 2)
-        self.send_header("X-DCFM-Artifact-Generation",
-                         str(app.generation))
-        for k, v in headers.items():
-            self.send_header(k, v)
-        self.end_headers()
-        self.wfile.write(body)
+        try:
+            self.send_response(status)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            # generation-tagged responses: which posterior generation
+            # answered.  handle() pins it to the epoch that computed the
+            # payload; the fallback covers string payloads (Prometheus).
+            gen = headers.pop(GENERATION_HEADER, str(app.generation))
+            self.send_header(GENERATION_HEADER, gen)
+            for k, v in headers.items():
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(body)
+        except OSError as e:
+            # slow or vanished client (socket timeout / reset while we
+            # wrote): drop the CONNECTION, never the handler thread
+            app.client_aborted(repr(e))
+            self.close_connection = True
 
 
 class _Httpd(ThreadingHTTPServer):
@@ -163,6 +233,16 @@ class _Httpd(ThreadingHTTPServer):
     block_on_close = True
     allow_reuse_address = True
     app = None
+    io_timeout = 10.0
+    reuse_port = False
+
+    def server_bind(self):
+        if self.reuse_port and hasattr(socket, "SO_REUSEPORT"):
+            # fleet mode: N workers bind+listen the same port and the
+            # kernel load-balances accepted connections across them
+            self.socket.setsockopt(socket.SOL_SOCKET,
+                                   socket.SO_REUSEPORT, 1)
+        super().server_bind()
 
 
 class PosteriorServer:
@@ -170,23 +250,63 @@ class PosteriorServer:
 
     def __init__(self, artifact, *, host: str = "127.0.0.1", port: int = 0,
                  cache_bytes: int = 256 << 20, max_queue: int = 1024,
-                 max_batch: int = 256, request_timeout: float = 2.0):
+                 max_batch: int = 256, request_timeout: float = 2.0,
+                 io_timeout: float = 10.0, reuse_port: bool = False,
+                 swap_poll: float = 0.5, shed_high: float = 0.75,
+                 shed_low: float = 0.50, worker_index=None):
+        self._cache_bytes = int(cache_bytes)
+        self._max_queue = int(max_queue)
+        self._max_batch = int(max_batch)
+        self._request_timeout = float(request_timeout)
+        self.worker_index = worker_index
+        # promotion-root mode: the path holds a CURRENT pointer naming
+        # the live artifact; the server opens the target and watches the
+        # pointer for hot-swaps.  A bare artifact path serves statically.
+        self._pointer_root = None
+        self._ptr_stat = None
+        self._swap_refused_stat = None
+        generation = 0
         if isinstance(artifact, str):
-            artifact = PosteriorArtifact.open(artifact)
-        self.artifact = artifact
-        self.engine = QueryEngine(artifact, cache_bytes=cache_bytes)
+            if is_pointer_root(artifact):
+                self._pointer_root = artifact
+                ptr = read_pointer(artifact)
+                generation = ptr.generation
+                self._ptr_stat = ptr.stat
+                artifact = PosteriorArtifact.open(ptr.path)
+            else:
+                artifact = PosteriorArtifact.open(artifact)
+        # Unified metrics registry (dcfm_tpu/obs/metrics.py): latency
+        # histograms, per-status counts, batcher counters, swap/shed
+        # counters all live here; cache/batcher snapshots are pull
+        # gauges sampled at scrape time.  One registry PER SERVER (two
+        # servers in one process never collide); the Prometheus renderer
+        # appends the process default registry so an embedded fit's
+        # progress gauges ride the same scrape.
+        self.metrics = obs_metrics.MetricsRegistry()
+        engine = QueryEngine(artifact, cache_bytes=self._cache_bytes)
         # bind BEFORE starting the batcher's non-daemon worker: a bind
         # failure (port in use) must raise out of __init__ with no
         # orphaned thread keeping the process alive past the traceback
-        self._httpd = _Httpd((host, port), _Handler)
+        self._httpd = _Httpd((host, port), _Handler,
+                             bind_and_activate=False)
         self._httpd.app = self
+        self._httpd.io_timeout = float(io_timeout)
+        self._httpd.reuse_port = bool(reuse_port)
         try:
-            self.batcher = QueryBatcher(self.engine, max_queue=max_queue,
-                                        max_batch=max_batch,
-                                        default_timeout=request_timeout)
+            self._httpd.server_bind()
+            self._httpd.server_activate()
         except BaseException:
             self._httpd.server_close()
             raise
+        try:
+            batcher = QueryBatcher(engine, max_queue=self._max_queue,
+                                   max_batch=self._max_batch,
+                                   default_timeout=self._request_timeout,
+                                   registry=self.metrics)
+        except BaseException:
+            self._httpd.server_close()
+            raise
+        self._epoch = _Epoch(artifact, engine, batcher, generation)
         self.address = self._httpd.server_address[:2]
         self._t0 = time.monotonic()
         self._draining = False
@@ -195,28 +315,50 @@ class PosteriorServer:
         self._closed = False
         self._hist: dict = {}
         self._hist_lock = threading.Lock()
-        # Unified metrics registry (dcfm_tpu/obs/metrics.py): the
-        # latency histograms live HERE (LatencyHistogram is a per-route
-        # JSON view over one labeled histogram), per-status response
-        # counts ride a counter, and the cache/batcher/artifact stats
-        # are pull gauges sampled at scrape time.  One registry PER
-        # SERVER (two servers in one process never collide); the
-        # Prometheus renderer appends the process default registry so
-        # an embedded fit's progress gauges ride the same scrape.
-        self.generation = 0    # bumped on artifact hot-swap (ROADMAP 2)
-        self.metrics = obs_metrics.MetricsRegistry()
+        # hot-swap state: the non-blocking lock means at most one
+        # request pays the probe/verify cost while the rest sail past
+        self.swap_poll = float(swap_poll)
+        self._swap_lock = threading.Lock()
+        self._swap_next_probe = 0.0
+        self._swap_sighup = threading.Event()
+        # tiered load-shedding state (hysteresis: enter high, exit low)
+        self.shed_high = float(shed_high)
+        self.shed_low = float(shed_low)
+        self._shedding = False
+        self._shed_lock = threading.Lock()
+        self._shed_prev = ((0,) * len(_BUCKET_BOUNDS_MS), 0)
+        self._lat_check_at = 0.0
+        self._lat_pressure = False
+        self._latency_budget_ms = 0.5 * self._request_timeout * 1e3
+        self._retry_base = 0.05
         self._lat_hist = self.metrics.histogram(
             "dcfm_serve_request_latency_ms", _BUCKET_BOUNDS_MS,
             "request latency per route, milliseconds", labels=("route",))
         self._responses = self.metrics.counter(
             "dcfm_serve_responses_total",
             "responses by HTTP status", labels=("status",))
+        self._swaps = self.metrics.counter(
+            "dcfm_serve_swaps_total", "successful artifact hot-swaps")
+        self._swap_refused = self.metrics.counter(
+            "dcfm_serve_swap_refused_total",
+            "hot-swaps refused (torn/corrupt/mismatched candidate)",
+            labels=("reason",))
+        self._shed_total = self.metrics.counter(
+            "dcfm_serve_shed_total",
+            "expensive-route responses shed under pressure",
+            labels=("route",))
+        self._client_aborts = self.metrics.counter(
+            "dcfm_serve_client_aborts_total",
+            "connections dropped mid-response (slow/vanished clients)")
         g = self.metrics.gauge
         g("dcfm_serve_uptime_seconds", "seconds since server start"
           ).set_function(lambda: time.monotonic() - self._t0)
         g("dcfm_serve_artifact_generation",
           "generation tag of the served artifact (bumped on hot-swap)"
           ).set_function(lambda: self.generation)
+        g("dcfm_serve_shedding",
+          "1 while the expensive routes are being shed"
+          ).set_function(lambda: float(self._shedding))
         # one stats() sample is shared by every per-stat series of a
         # scrape (the registry reads series sequentially): without the
         # short-lived memo each exposition would call engine.stats() /
@@ -249,8 +391,27 @@ class PosteriorServer:
             batch_g.set_function(
                 lambda s=stat: float(batch_stats().get(s, 0)), stat=stat)
 
+    # the epoch owns the servable quartet; these views always show the
+    # CURRENT one (requests in flight hold their own epoch reference)
+    @property
+    def artifact(self):
+        return self._epoch.artifact
+
+    @property
+    def engine(self):
+        return self._epoch.engine
+
+    @property
+    def batcher(self):
+        return self._epoch.batcher
+
+    @property
+    def generation(self):
+        return self._epoch.generation
+
     _ROUTES = ("/healthz", "/metrics", "/v1/entry", "/v1/block",
                "/v1/interval")
+    _EXPENSIVE = ("/v1/block", "/v1/interval")
 
     # -- observability -------------------------------------------------
     def observe(self, path: str, status: int, ms: float) -> None:
@@ -268,15 +429,177 @@ class PosteriorServer:
         self._responses.inc(status=str(status))
         h.record(ms)
 
+    def client_aborted(self, detail: str) -> None:
+        """A connection died mid-response (slow-loris timeout, reset)."""
+        self._client_aborts.inc()
+        record("serve_client_abort", detail=detail,
+               worker=self.worker_index)
+
     def status_counts(self) -> dict:
         """{status: count} derived from the registry counter - the one
         home of the per-status bookkeeping."""
         return {lab["status"]: int(self._responses.value(**lab))
                 for lab, _child in self._responses.series()}
 
+    def _retry_after(self) -> str:
+        """Jittered backoff hint: uniformly smeared over [base, 2*base)
+        so a synchronized thundering herd of rejected clients does not
+        come back as one synchronized wave."""
+        return f"{self._retry_base * (1.0 + random.random()):.3f}"
+
+    # -- load shedding -------------------------------------------------
+    def _latency_pressure(self) -> bool:
+        """Windowed /v1/entry p99 vs. the deadline budget (half the
+        request timeout): bucket-count deltas since the last check give
+        a p99 over the RECENT window, not the process lifetime, so the
+        gate opens and closes with the actual congestion."""
+        now = time.monotonic()
+        if now < self._lat_check_at:
+            return self._lat_pressure
+        self._lat_check_at = now + 0.25
+        counts, n, _sum = self._lat_hist.data(route="/v1/entry")
+        prev_counts, prev_n = self._shed_prev
+        self._shed_prev = (counts, n)
+        dn = n - prev_n
+        if dn < 16:                 # too few samples to judge a p99
+            self._lat_pressure = False
+            return False
+        delta = [c - p for c, p in zip(counts, prev_counts)]
+        target, acc, p99 = 0.99 * dn, 0, 0.0
+        for b, c in zip(_BUCKET_BOUNDS_MS, delta):
+            acc += c
+            if acc >= target:
+                p99 = _BUCKET_BOUNDS_MS[-2] if b == float("inf") else b
+                break
+        self._lat_pressure = p99 >= self._latency_budget_ms
+        return self._lat_pressure
+
+    def _should_shed(self, route: str) -> bool:
+        """Tiered shedding gate, consulted only by the EXPENSIVE routes:
+        batcher queue fill (enter >= shed_high, exit <= shed_low -
+        hysteresis, no flapping) or sustained entry-latency pressure.
+        /v1/entry and /healthz never consult it: cheap traffic and
+        liveness stay up while the heavy tiers make room."""
+        st = self.batcher.stats()
+        fill = st["queue_depth"] / max(1, st["queue_capacity"])
+        with self._shed_lock:
+            if not self._shedding:
+                if fill >= self.shed_high or self._latency_pressure():
+                    self._shedding = True
+                    record("serve_shed", active=True, route=route,
+                           fill=round(fill, 3),
+                           worker=self.worker_index)
+            else:
+                if fill <= self.shed_low and not self._latency_pressure():
+                    self._shedding = False
+                    record("serve_shed", active=False,
+                           fill=round(fill, 3),
+                           worker=self.worker_index)
+            if self._shedding:
+                self._shed_total.inc(route=route)
+            return self._shedding
+
+    # -- hot-swap ------------------------------------------------------
+    def _maybe_swap(self) -> None:
+        """Cheap pointer probe, time-gated (or forced by SIGHUP); at
+        most one thread at a time pays the verify/build cost while
+        every other request proceeds on the current epoch."""
+        if self._pointer_root is None or self._draining:
+            return
+        now = time.monotonic()
+        if now < self._swap_next_probe and not self._swap_sighup.is_set():
+            return
+        if not self._swap_lock.acquire(blocking=False):
+            return                     # another request is mid-swap
+        try:
+            if self._draining:
+                return
+            self._swap_sighup.clear()
+            self._swap_next_probe = now + self.swap_poll
+            try:
+                key = pointer_stat(self._pointer_root)
+            except OSError:
+                return                 # pointer vanished: keep serving
+            if key == self._ptr_stat or key == self._swap_refused_stat:
+                return
+            self._swap(key)
+        finally:
+            self._swap_lock.release()
+
+    def _swap(self, key) -> None:
+        """Verify + install the newly promoted artifact.  Refusal keeps
+        the old epoch serving and remembers the refused pointer state so
+        the (expensive) verification is not retried per probe."""
+        fault_event("swap_begin")
+        old = self._epoch
+        try:
+            ptr = read_pointer(self._pointer_root)
+            art = verify_candidate(ptr.path)
+            if ptr.fingerprint not in ("unverified", art.fingerprint):
+                raise ArtifactError(
+                    f"candidate fingerprint {art.fingerprint} does not "
+                    f"match promoted {ptr.fingerprint} - the artifact "
+                    "changed after promotion; refusing the swap")
+        except (PointerError, ArtifactError, OSError) as e:
+            self._swap_refused_stat = key
+            reason = type(e).__name__
+            self._swap_refused.inc(reason=reason)
+            record("serve_swap_refused", reason=reason, error=str(e),
+                   generation=old.generation, worker=self.worker_index)
+            return
+        generation = max(old.generation, ptr.generation)
+        if art.fingerprint == old.artifact.fingerprint:
+            # same bytes re-promoted: adopt the generation tag, keep
+            # the warm engine and cache
+            self._epoch = _Epoch(old.artifact, old.engine, old.batcher,
+                                 generation)
+            self._ptr_stat = key
+            return
+        engine = QueryEngine(art, cache_bytes=self._cache_bytes)
+        batcher = QueryBatcher(engine, max_queue=self._max_queue,
+                               max_batch=self._max_batch,
+                               default_timeout=self._request_timeout,
+                               registry=self.metrics)
+        # the flip: one reference assignment installs the new quartet
+        self._epoch = _Epoch(art, engine, batcher, generation)
+        self._ptr_stat = key
+        fault_event("swap_commit")
+        self._swaps.inc()
+        record("serve_swap", generation=generation,
+               from_generation=old.generation,
+               fingerprint=art.fingerprint, worker=self.worker_index)
+        # drain in-flight requests on the OLD engine: close() serves
+        # everything already queued before joining the worker, so the
+        # swap drops zero requests
+        old.batcher.close()
+
     # -- routing -------------------------------------------------------
     def handle(self, path: str, q: dict) -> tuple:
         """-> (status, json payload, extra headers)."""
+        self._maybe_swap()
+        ep = self._epoch
+        try:
+            status, payload, headers = self._dispatch(ep, path, q)
+        except BatcherClosed as e:
+            # raced a hot-swap: the successor epoch is already
+            # installed - retry once there; a second closure means the
+            # server itself is draining, which IS a typed 429-retry
+            ep = self._epoch
+            try:
+                status, payload, headers = self._dispatch(ep, path, q)
+            except BatcherClosed:
+                status, payload, headers = 429, {
+                    "error": str(e), "retry": True,
+                    "retry_after": float(self._retry_after())}, \
+                    {"Retry-After": self._retry_after()}
+        headers = dict(headers)
+        # pin the generation header to the epoch that produced the
+        # payload: a response computed on the old engine mid-swap says
+        # so, and per-client generations never decrease
+        headers.setdefault(GENERATION_HEADER, str(ep.generation))
+        return status, payload, headers
+
+    def _dispatch(self, ep, path: str, q: dict) -> tuple:
         try:
             if path == "/healthz":
                 return 200, self._healthz(), {}
@@ -284,18 +607,27 @@ class PosteriorServer:
                 if q.get("format", [""])[0] == "prometheus":
                     return 200, self._metrics_prometheus(), {}
                 return 200, self._metrics(), {}
+            if path in self._EXPENSIVE and self._should_shed(path):
+                ra = self._retry_after()
+                return 503, {"error": f"overloaded: {path} shed under "
+                             "pressure - retry with backoff",
+                             "shed": True, "retry": True,
+                             "retry_after": float(ra)}, {"Retry-After": ra}
             if path == "/v1/entry":
-                return self._entry(q)
+                return self._entry(ep, q)
             if path == "/v1/block":
-                return self._block(q)
+                return self._block(ep, q)
             if path == "/v1/interval":
-                return self._interval(q)
+                return self._interval(ep, q)
             return 404, {"error": f"no route {path}"}, {}
         except _BadRequest as e:
             return 400, {"error": str(e)}, {}
+        except BatcherClosed:
+            raise                      # handle() retries on the successor
         except Overloaded as e:
-            return 429, {"error": str(e), "retry": True}, \
-                {"Retry-After": "0.05"}
+            ra = self._retry_after()
+            return 429, {"error": str(e), "retry": True,
+                         "retry_after": float(ra)}, {"Retry-After": ra}
         except DeadlineExceeded as e:
             return 504, {"error": str(e)}, {}
         except ArtifactCorruptError as e:
@@ -307,10 +639,18 @@ class PosteriorServer:
                          "kind": e.kind}, {}
         except (ArtifactError, ValueError, IndexError) as e:
             return 400, {"error": str(e)}, {}
+        except OSError as e:
+            # an I/O failure reading the memmapped panel (or an injected
+            # io_error chaos fault on the dequant path): typed and
+            # retryable - another replica, or this one after the cache
+            # re-fills, can still answer
+            ra = self._retry_after()
+            return 503, {"error": repr(e), "retry": True,
+                         "retry_after": float(ra)}, {"Retry-After": ra}
         except Exception as e:           # pragma: no cover - last resort
             return 500, {"error": repr(e)}, {}
 
-    def _q_int(self, q, name):
+    def _q_int(self, ep, q, name):
         if name not in q:
             raise _BadRequest(f"missing required parameter {name!r}")
         try:
@@ -318,9 +658,9 @@ class PosteriorServer:
         except ValueError:
             raise _BadRequest(f"{name}={q[name][0]!r} is not an integer") \
                 from None
-        if not 0 <= v < self.artifact.p_original:
+        if not 0 <= v < ep.artifact.p_original:
             raise _BadRequest(
-                f"{name}={v} out of [0, {self.artifact.p_original})")
+                f"{name}={v} out of [0, {ep.artifact.p_original})")
         return v
 
     @staticmethod
@@ -329,15 +669,15 @@ class PosteriorServer:
             return default
         return q[name][0] not in ("0", "false", "no")
 
-    def _entry(self, q):
-        i, j = self._q_int(q, "i"), self._q_int(q, "j")
+    def _entry(self, ep, q):
+        i, j = self._q_int(ep, q, "i"), self._q_int(ep, q, "j")
         dest = self._q_flag(q, "destandardize")
-        value = self.batcher.entry(i, j, destandardize=dest)
+        value = ep.batcher.entry(i, j, destandardize=dest)
         return 200, {"i": i, "j": j, "value": float(value),
                      "destandardized": dest}, {}
 
-    def _block(self, q):
-        p = self.artifact.p_original
+    def _block(self, ep, q):
+        p = ep.artifact.p_original
         if "rows" not in q or "cols" not in q:
             raise _BadRequest("block queries need rows= and cols=")
         rows = _parse_indices(q["rows"][0], p)
@@ -348,26 +688,48 @@ class PosteriorServer:
                          "request"}, {}
         dest = self._q_flag(q, "destandardize")
         kind = q.get("kind", ["mean"])[0]
-        vals = self.engine.block(rows, cols, kind=kind, destandardize=dest)
+        vals = ep.engine.block(rows, cols, kind=kind, destandardize=dest)
         return 200, {"rows": rows, "cols": cols,
                      "values": [[float(v) for v in row] for row in vals],
                      "destandardized": dest, "kind": kind}, {}
 
-    def _interval(self, q):
-        i, j = self._q_int(q, "i"), self._q_int(q, "j")
+    def _interval(self, ep, q):
+        i, j = self._q_int(ep, q, "i"), self._q_int(ep, q, "j")
         alpha = float(q.get("alpha", ["0.05"])[0])
         if not 0.0 < alpha < 1.0:
             raise _BadRequest(f"alpha={alpha} must be in (0, 1)")
         dest = self._q_flag(q, "destandardize")
-        mean, sd, lo, hi = self.engine.interval(
+        mean, sd, lo, hi = ep.engine.interval(
             i, j, alpha=alpha, destandardize=dest)
         return 200, {"i": i, "j": j, "alpha": alpha, "mean": mean,
                      "sd": sd, "lo": lo, "hi": hi}, {}
 
+    def _fleet_status(self):
+        """The fleet supervisor's liveness table, when one is running:
+        it atomically rewrites the JSON file named by DCFM_FLEET_STATUS
+        and every worker serves it on /healthz, so ANY replica answers
+        for the whole fleet.  mtime-cached; absent/torn reads degrade to
+        None (a worker must stay healthy when its supervisor is mid-
+        rewrite or gone)."""
+        path = os.environ.get("DCFM_FLEET_STATUS")
+        if not path:
+            return None
+        try:
+            st = os.stat(path)
+            key = (st.st_mtime_ns, st.st_size)
+            cached = getattr(self, "_fleet_cache", None)
+            if cached is None or cached[0] != key:
+                with open(path, "r", encoding="utf-8") as f:
+                    self._fleet_cache = (key, json.load(f))
+            return self._fleet_cache[1]
+        except (OSError, ValueError):
+            return None
+
     def _healthz(self):
         from dcfm_tpu import native
-        a = self.artifact
-        return {
+        ep = self._epoch
+        a = ep.artifact
+        h = {
             "status": ("draining" if self._draining
                        else "ok" if native.available() else "degraded"),
             "native": native.available(),
@@ -376,21 +738,48 @@ class PosteriorServer:
             # fleet checks before/after an artifact hot-swap (a replica
             # still answering under the old fingerprint is stale)
             "artifact_fingerprint": a.fingerprint,
-            "artifact_generation": self.generation,
+            "artifact_generation": ep.generation,
+            "shedding": self._shedding,
             "uptime_s": round(time.monotonic() - self._t0, 3),
         }
+        if self.worker_index is not None:
+            h["worker"] = {"index": int(self.worker_index),
+                           "pid": os.getpid()}
+        if self._pointer_root is not None:
+            try:
+                h["pointer_generation"] = \
+                    read_pointer(self._pointer_root).generation
+            except PointerError:
+                h["pointer_generation"] = None
+        fleet = self._fleet_status()
+        if fleet is not None:
+            h["fleet"] = fleet
+        return h
 
     def _metrics(self):
         with self._hist_lock:
             hists = {p: h.snapshot() for p, h in self._hist.items()}
         statuses = self.status_counts()
+        ep = self._epoch
         return {
             "latency": hists,
             "statuses": statuses,
-            "cache": self.engine.stats(),
-            "batcher": self.batcher.stats(),
-            "artifact": {"fingerprint": self.artifact.fingerprint,
-                         "generation": self.generation},
+            "cache": ep.engine.stats(),
+            "batcher": ep.batcher.stats(),
+            "artifact": {"fingerprint": ep.artifact.fingerprint,
+                         "generation": ep.generation},
+            "swap": {
+                "swaps": int(self._swaps.value()),
+                "refused": sum(
+                    int(self._swap_refused.value(**lab))
+                    for lab, _c in self._swap_refused.series()),
+            },
+            "shed": {
+                "active": self._shedding,
+                "by_route": {lab["route"]: int(self._shed_total.value(**lab))
+                             for lab, _c in self._shed_total.series()},
+            },
+            "client_aborts": int(self._client_aborts.value()),
             "uptime_s": round(time.monotonic() - self._t0, 3),
         }
 
@@ -429,7 +818,11 @@ class PosteriorServer:
             self._accept_thread.join()
             self._accept_thread = None
         self._httpd.server_close()        # joins in-flight handler threads
-        self.batcher.close()
+        # _swap_lock: a hot-swap observed pre-drain must finish
+        # installing (and closing the predecessor batcher) before we
+        # close the current one - otherwise its successor would leak
+        with self._swap_lock:
+            self.batcher.close()
 
     def run(self) -> None:
         """Serve until SIGTERM/SIGINT, then drain gracefully.
@@ -438,15 +831,20 @@ class PosteriorServer:
         the only one Python delivers signals to - waits on an event the
         handlers set; calling ``shutdown()`` from a signal handler while
         ``serve_forever`` runs on the handler's own thread would
-        deadlock.
+        deadlock.  SIGHUP forces an immediate promotion-pointer probe
+        (the fleet supervisor's swap-now nudge for idle workers).
         """
         stop = threading.Event()
         prev = {s: signal.signal(s, lambda *_: stop.set())
                 for s in (signal.SIGTERM, signal.SIGINT)}
+        if hasattr(signal, "SIGHUP"):
+            prev[signal.SIGHUP] = signal.signal(
+                signal.SIGHUP, lambda *_: self._swap_sighup.set())
         self.start()
         try:
             while not stop.wait(0.2):
-                pass
+                # idle workers still observe promotions (and SIGHUP)
+                self._maybe_swap()
         finally:
             for s, h in prev.items():
                 signal.signal(s, h)
@@ -455,16 +853,40 @@ class PosteriorServer:
 
 def serve_main(args) -> int:
     """``dcfm-tpu serve`` entry point (argparse Namespace from cli.py)."""
+    rec = None
+    obs_dir = os.environ.get("DCFM_OBS_DIR")
+    if obs_dir:
+        from dcfm_tpu.obs import recorder as _recorder
+        rec = _recorder.install(_recorder.FlightRecorder(obs_dir))
+    worker_index = getattr(args, "worker_index", None)
     server = PosteriorServer(
         args.artifact, host=args.host, port=args.port,
         cache_bytes=int(args.cache_mb) << 20, max_queue=args.max_queue,
-        max_batch=args.max_batch, request_timeout=args.request_timeout)
+        max_batch=args.max_batch, request_timeout=args.request_timeout,
+        io_timeout=getattr(args, "io_timeout", 10.0),
+        reuse_port=bool(getattr(args, "reuse_port", False)),
+        swap_poll=getattr(args, "swap_poll", 0.5),
+        shed_high=getattr(args, "shed_high", 0.75),
+        shed_low=getattr(args, "shed_low", 0.50),
+        worker_index=worker_index)
     host, port = server.address
+    record("serve_start", worker=worker_index, pid=os.getpid(),
+           generation=server.generation,
+           fingerprint=server.artifact.fingerprint)
     print(json.dumps({"serving": f"http://{host}:{port}",  # dcfm: ignore[DCFM901] - the serve CLI's stdout protocol
                       "artifact": args.artifact,
                       "p": server.artifact.p_original,
-                      "has_sd": server.artifact.has_sd}), flush=True)
-    server.run()
+                      "has_sd": server.artifact.has_sd,
+                      "generation": server.generation,
+                      "worker": worker_index}), flush=True)
+    try:
+        server.run()
+    finally:
+        record("serve_stop", worker=worker_index,
+               generation=server.generation)
+        if rec is not None:
+            from dcfm_tpu.obs import recorder as _recorder
+            _recorder.uninstall(rec)
     print(json.dumps({"drained": True,  # dcfm: ignore[DCFM901] - the serve CLI's stdout protocol
                       "statuses": server.status_counts()}), flush=True)
     return 0

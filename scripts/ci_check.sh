@@ -39,6 +39,17 @@ python -m dcfm_tpu.analysis dcfm_tpu/runtime/ || exit 1
 echo "== dcfm-lint: observability subsystem (DCFM901 telemetry) =="
 python -m dcfm_tpu.analysis dcfm_tpu/obs/ || exit 1
 
+# The fleet layer is named file-by-file so a tree-level glob change can
+# never silently drop it: these four files ARE the serving-fleet
+# availability story (supervision, atomic promotion, the loadgen
+# ground truth, the operator's load driver), and a handler-route
+# blocking wait here (DCFM1001) is the slow-loris hang class the
+# chaos harness exists to catch.
+echo "== dcfm-lint: serving fleet files (DCFM1001 handler-wait bounds) =="
+python -m dcfm_tpu.analysis \
+    dcfm_tpu/serve/fleet.py dcfm_tpu/serve/promote.py \
+    dcfm_tpu/serve/loadgen.py scripts/serve_load.py || exit 1
+
 # Serve tests always run through the crash-isolated lane IN ADDITION to
 # their in-process tier-1 run below: they exercise native assembly +
 # sockets + thread storms, so a native-level abort here must fail ONE
@@ -57,9 +68,14 @@ python -m dcfm_tpu.analysis dcfm_tpu/obs/ || exit 1
 # test_obs.py rides it too: the flight-recorder crash lane SIGKILLs
 # real supervised children and replays their (possibly torn) event
 # logs - a runaway child must fail one file with its signal named.
+# test_serve_fleet.py is the serve-chaos smoke: it SIGKILLs real
+# SO_REUSEPORT workers, promotes torn/corrupt artifacts under live
+# load, and drives slow-loris clients at a real fleet subprocess -
+# the canonical crash-isolated citizen.
 echo "== serve + chaos tests incl. crash-fuzz smoke (crash-isolated lane) =="
 for f in tests/test_serve_artifact.py tests/test_serve_engine.py \
-         tests/test_serve_server.py tests/test_resilience.py \
+         tests/test_serve_server.py tests/test_serve_fleet.py \
+         tests/test_resilience.py \
          tests/test_runtime_stream.py tests/test_obs.py; do
     JAX_PLATFORMS=cpu python -m dcfm_tpu.analysis.isolate "$f" \
         -- -q -m 'not slow' --continue-on-collection-errors \

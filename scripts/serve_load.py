@@ -1,0 +1,55 @@
+#!/usr/bin/env python
+"""CLI wrapper over dcfm_tpu.serve.loadgen.run_load.
+
+Drives a running serve fleet and prints the classified result as JSON.
+Exit code 1 when the fleet violated the chaos contract (any untyped
+error, dropped request, or generation regression), 0 otherwise - so a
+shell harness can gate on it directly:
+
+    dcfm-tpu serve ART --workers 4 --port 8080 &
+    python scripts/serve_load.py http://127.0.0.1:8080 \
+        --threads 16 --requests 200 --slow-clients 2
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from dcfm_tpu.serve.loadgen import run_load   # noqa: E402
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("base", help="fleet base URL, e.g. http://127.0.0.1:8080")
+    ap.add_argument("--threads", type=int, default=8)
+    ap.add_argument("--requests", type=int, default=50,
+                    help="requests per thread")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--p", type=int, default=24,
+                    help="index range for generated queries")
+    ap.add_argument("--retries", type=int, default=6,
+                    help="per-request reconnect budget (SO_REUSEPORT "
+                         "failover across worker deaths)")
+    ap.add_argument("--timeout", type=float, default=10.0)
+    ap.add_argument("--slow-clients", type=int, default=0,
+                    help="concurrent slow-loris sockets to hold open")
+    ap.add_argument("--slow-hold-s", type=float, default=2.0)
+    args = ap.parse_args(argv)
+    result = run_load(
+        args.base, threads=args.threads,
+        requests_per_thread=args.requests, seed=args.seed, p=args.p,
+        retries=args.retries, timeout=args.timeout,
+        slow_clients=args.slow_clients, slow_hold_s=args.slow_hold_s)
+    print(json.dumps(result, indent=2))  # dcfm: ignore[DCFM901] - the load driver's stdout protocol: the classified result IS the output
+    bad = (result["untyped"] or result["dropped"]
+           or result["generation"]["violations"]
+           or result["value_errors"])
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

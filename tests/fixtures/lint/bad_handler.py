@@ -1,0 +1,23 @@
+"""Known-bad handler route methods: DCFM1001 must fire (all shapes)."""
+import socket
+from http.server import BaseHTTPRequestHandler
+
+
+class Handler(BaseHTTPRequestHandler):
+    def do_GET(self):
+        # DCFM1001: timeout-less join - a wedged worker thread parks
+        # this handler thread (and the client connection) forever
+        self.server.worker.join()
+        # DCFM1001: blocking queue get with no timeout - an empty queue
+        # is a permanent hang, not a typed 503/504
+        item = self.server.results.get()
+        self.wfile.write(repr(item).encode())
+
+    def handle(self):
+        # DCFM1001: blocking ops on a socket this method created and
+        # never settimeout-ed - a silent upstream blocks forever
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        s.connect(("127.0.0.1", 9999))
+        data = s.recv(4096)
+        s.close()
+        return data

@@ -1,0 +1,32 @@
+"""Known-good handler route methods: every wait carries a deadline."""
+import queue
+import socket
+from http.server import BaseHTTPRequestHandler
+
+
+class Handler(BaseHTTPRequestHandler):
+    def do_GET(self):
+        # bounded join: a miss becomes a typed 504, never a hang
+        self.server.worker.join(timeout=1.0)
+        if self.server.worker.is_alive():
+            self.send_error(504, "worker still busy")
+            return
+        try:
+            item = self.server.results.get(timeout=0.5)
+        except queue.Empty:
+            self.send_error(503, "no result ready - retry")
+            return
+        self.wfile.write(repr(item).encode())
+
+    def handle(self):
+        # the method-created socket is deadline-bounded before any
+        # blocking op
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        s.settimeout(2.0)
+        try:
+            s.connect(("127.0.0.1", 9999))
+            return s.recv(4096)
+        except OSError:
+            return b""
+        finally:
+            s.close()

@@ -178,6 +178,37 @@ def test_robust_rules_skip_test_files():
                    for f in lint_source(src, "test_mod.py"))
 
 
+def test_bad_handler_fires_1001():
+    assert _rules_fired("bad_handler.py") == {"DCFM1001"}
+
+
+def test_bad_handler_flags_every_wait_shape():
+    findings = lint_file(os.path.join(FIXTURES, "bad_handler.py"))
+    msgs = [f.message for f in findings if f.rule == "DCFM1001"]
+    # timeout-less join, blocking queue get, and the two socket ops on
+    # the untimed method-created socket (connect + recv)
+    assert len(msgs) == 4
+    assert any(".join()" in m for m in msgs)
+    assert any(".get()" in m for m in msgs)
+    assert any(".connect()" in m for m in msgs)
+    assert any(".recv()" in m for m in msgs)
+
+
+def test_handler_rule_scoped_to_route_methods():
+    """DCFM1001 only polices request-path methods of handler
+    subclasses: the same timeout-less join is quiet in a plain class
+    method and in a non-route helper of a handler subclass."""
+    src = ("from http.server import BaseHTTPRequestHandler\n"
+           "class NotAHandler:\n"
+           "    def do_GET(self):\n"
+           "        self.worker.join()\n"
+           "class H(BaseHTTPRequestHandler):\n"
+           "    def helper(self):\n"
+           "        self.worker.join()\n")
+    assert not any(f.rule == "DCFM1001"
+                   for f in lint_source(src, "mod.py"))
+
+
 def test_every_rule_family_has_a_firing_fixture():
     """The registry and the fixtures cannot drift apart: every
     registered rule fires somewhere in the known-bad fixture set."""
@@ -196,7 +227,8 @@ def test_every_rule_family_has_a_firing_fixture():
 @pytest.mark.parametrize("name", [
     "good_rng.py", "good_jit.py", "good_dtype.py", "good_ffi.py",
     "good_thread.py", "good_server.py", "good_robust.py",
-    "good_multihost.py", "good_runtime.py", "good_obs.py"])
+    "good_multihost.py", "good_runtime.py", "good_obs.py",
+    "good_handler.py"])
 def test_good_fixture_is_clean(name):
     findings = lint_file(os.path.join(FIXTURES, name))
     assert findings == [], [str(f) for f in findings]

@@ -1,0 +1,503 @@
+"""Serving fleet resilience: hot-swap, shedding, supervision, chaos.
+
+Pins the serve-side resilience layer end to end:
+
+* atomic promotion pointer discipline (``serve/promote.py``): verified
+  promotion, generation monotonicity, the hardlinked audit trail, and
+  the refusal of corrupt candidates;
+* artifact hot-swap under a 64-thread live storm: zero dropped
+  requests, the generation header never decreases per client, and the
+  VALUES are bitwise-correct for whichever generation answered - the
+  old artifact's bytes keep serving mid-swap;
+* a corrupt candidate promoted by a buggy promoter (``verify=False``)
+  is refused by the serving worker while the old artifact keeps
+  serving, then a good candidate swaps in cleanly;
+* per-connection io_timeout sheds a slow-loris client instead of
+  parking a handler thread;
+* the ``--workers N`` fleet: SO_REUSEPORT replicas supervised by the
+  parent - a SIGKILLed worker is respawned, traffic keeps flowing,
+  SIGTERM drains the whole fleet, and ``dcfm-tpu events`` summarizes
+  the run; workers that die on arrival trip poison detection;
+* the serve chaos harness: seeded ``serve_fuzz_spec`` points driven
+  through a REAL fleet subprocess under the loadgen - every response
+  ok or typed, zero dropped, zero generation regressions, and the
+  fleet never hangs (``communicate(timeout=...)`` is the watchdog
+  bound).  Three representative points run in tier-1; the >=25-point
+  sweep is ``slow``-marked.
+"""
+
+import json
+import os
+import shutil
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+
+import numpy as np
+import pytest
+
+from dcfm_tpu.obs.cli import summarize
+from dcfm_tpu.resilience.faults import serve_fuzz_spec
+from dcfm_tpu.serve.artifact import (
+    ArtifactError, MEAN_PANELS_FILE, META_FILE, PosteriorArtifact,
+    artifact_fingerprint, panel_crc32, write_artifact)
+from dcfm_tpu.serve.loadgen import run_load
+from dcfm_tpu.serve.promote import promote_artifact, read_pointer
+from dcfm_tpu.serve.server import GENERATION_HEADER, PosteriorServer
+from dcfm_tpu.utils.preprocess import preprocess
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+P_ORIG = 24
+
+
+def _make_artifact(path, *, seed=0, p=P_ORIG, g=2):
+    """A small CRC'd artifact with random panels - no fit, no jax.
+    Diagonal-pair panels are symmetrized (a real posterior's diagonal
+    blocks are); everything else is arbitrary bytes, which is exactly
+    what the bitwise value checks want."""
+    rng = np.random.default_rng(seed)
+    Y = rng.standard_normal((40, p)).astype(np.float32)
+    pre = preprocess(Y, g)
+    n_pairs = g * (g + 1) // 2
+    P = pre.shard_size
+    q = rng.integers(-127, 128, size=(n_pairs, P, P)).astype(np.int8)
+    pair = 0
+    for a in range(g):
+        for b in range(a, g):
+            if a == b:
+                q[pair] = np.triu(q[pair]) + np.triu(q[pair], 1).T
+            pair += 1
+    scale = rng.uniform(0.5, 1.5, n_pairs).astype(np.float32)
+    sd_q = rng.integers(1, 128, size=(n_pairs, P, P)).astype(np.int8)
+    sd_scale = rng.uniform(0.5, 1.5, n_pairs).astype(np.float32)
+    art = write_artifact(path, mean_q8=q, mean_scale=scale, pre=pre,
+                         sd_q8=sd_q, sd_scale=sd_scale)
+    return art.path
+
+
+def _variant_artifact(src, dst):
+    """Copy ``src`` and NEGATE its int8 mean panels in place, then
+    re-record the panel CRCs + fingerprint.  int8 quant values live in
+    [-127, 127] and every downstream op (dequant scale, symmetrize,
+    destandardize) is sign-preserving IEEE arithmetic, so the variant
+    serves EXACTLY the negated float32 of the original - a bitwise
+    which-generation-answered oracle."""
+    shutil.copytree(src, dst)
+    with open(os.path.join(dst, META_FILE), "r", encoding="utf-8") as f:
+        meta = json.load(f)
+    n_pairs = meta["g"] * (meta["g"] + 1) // 2
+    q = np.memmap(os.path.join(dst, MEAN_PANELS_FILE), dtype=np.int8,
+                  mode="r+", shape=(n_pairs, meta["P"], meta["P"]))
+    np.negative(q, out=q)
+    q.flush()
+    meta["panel_crc"]["mean"] = [int(panel_crc32(np.asarray(panel)))
+                                 for panel in q]
+    meta["fingerprint"] = artifact_fingerprint(meta)
+    with open(os.path.join(dst, META_FILE), "w", encoding="utf-8") as f:
+        json.dump(meta, f)
+    return dst
+
+
+def _flip_byte(path, offset=7):
+    with open(path, "r+b") as f:
+        f.seek(offset)
+        b = f.read(1)
+        f.seek(offset)
+        f.write(bytes([b[0] ^ 0x5A]))
+
+
+def _get(base, path, timeout=15):
+    """-> (status, payload, headers) without raising on 4xx/5xx."""
+    try:
+        with urllib.request.urlopen(base + path, timeout=timeout) as r:
+            return r.status, json.loads(r.read()), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read()), dict(e.headers)
+
+
+def _get_retry(base, path, timeout=15, tries=20):
+    """_get with reconnects: a SIGKILLed SO_REUSEPORT worker resets
+    in-flight connections; the retry lands on a live replica."""
+    for attempt in range(tries):
+        try:
+            return _get(base, path, timeout=timeout)
+        except OSError:
+            time.sleep(0.05 * (attempt + 1))
+    raise AssertionError(f"no replica ever answered {path}")
+
+
+# ---------------------------------------------------------------------------
+# promotion pointer
+# ---------------------------------------------------------------------------
+
+def test_promote_pointer_discipline(tmp_path):
+    root = str(tmp_path)
+    v1 = _make_artifact(os.path.join(root, "v1"), seed=1)
+    st1 = promote_artifact(root, "v1")
+    assert st1.generation == 1 and st1.target == "v1"
+    assert read_pointer(root).path == v1
+    _variant_artifact(v1, os.path.join(root, "v2"))
+    st2 = promote_artifact(root, "v2")
+    assert st2.generation == 2
+    assert st2.fingerprint != st1.fingerprint
+    # the audit trail: every pointer that ever served is linked aside
+    assert os.path.exists(os.path.join(root, "CURRENT.gen1"))
+    assert os.path.exists(os.path.join(root, "CURRENT.gen2"))
+    # a corrupt candidate is refused by the verifying promoter and the
+    # pointer does not move
+    shutil.copytree(os.path.join(root, "v2"), os.path.join(root, "v3"))
+    _flip_byte(os.path.join(root, "v3", MEAN_PANELS_FILE))
+    with pytest.raises(ArtifactError):
+        promote_artifact(root, "v3")
+    assert read_pointer(root).generation == 2
+    assert read_pointer(root).target == "v2"
+    # the operator's path: `dcfm-tpu promote` verifies then publishes
+    cp = subprocess.run(
+        [sys.executable, "-m", "dcfm_tpu.cli", "promote", root,
+         os.path.join(root, "v1")],
+        capture_output=True, text=True, cwd=REPO)
+    assert cp.returncode == 0, cp.stderr
+    assert json.loads(cp.stdout)["generation"] == 3
+    assert read_pointer(root).target == "v1"
+    # and it refuses the corrupt candidate with a non-zero exit
+    cp = subprocess.run(
+        [sys.executable, "-m", "dcfm_tpu.cli", "promote", root,
+         os.path.join(root, "v3")],
+        capture_output=True, text=True, cwd=REPO)
+    assert cp.returncode != 0
+    assert read_pointer(root).generation == 3
+
+
+# ---------------------------------------------------------------------------
+# hot-swap under live traffic (in-process server, 64-thread storm)
+# ---------------------------------------------------------------------------
+
+def test_hot_swap_under_64_thread_storm(tmp_path):
+    """The tentpole acceptance: promote a new generation in the middle
+    of a 64-thread storm.  Zero dropped requests, zero untyped errors,
+    per-client generations never decrease, and every 200 is bitwise
+    the artifact its generation header names - old bytes mid-swap, new
+    bytes after."""
+    root = str(tmp_path)
+    v1 = _make_artifact(os.path.join(root, "v1"), seed=3)
+    _variant_artifact(v1, os.path.join(root, "v2"))
+    ref = PosteriorArtifact.open(v1).assemble()
+    promote_artifact(root, "v1")
+    srv = PosteriorServer(root, port=0, max_queue=2048, max_batch=64,
+                          request_timeout=60.0, swap_poll=0.0)
+    host, port = srv.start()
+    seen = {"ok": 0}
+    promote_once = threading.Event()
+
+    def expect(kind, path, body, gen):
+        # promotion is triggered BY traffic: after 200 responses the
+        # new generation lands while >= 1000 requests are still in
+        # flight - a guaranteed mid-storm swap, no timing guesswork
+        seen["ok"] += 1
+        if seen["ok"] == 200 and not promote_once.is_set():
+            promote_once.set()
+            promote_artifact(root, "v2")
+        q = urllib.parse.parse_qs(urllib.parse.urlparse(path).query)
+        i, j = int(q["i"][0]), int(q["j"][0])
+        want = (np.float32(ref[i, j]) if gen == 1
+                else np.float32(-ref[i, j]))
+        got = np.float32(body["value"])
+        if got != want:
+            return (f"generation {gen} entry ({i},{j}): "
+                    f"got {got!r} want {want!r}")
+        return None
+
+    try:
+        res = run_load(f"http://{host}:{port}", threads=64,
+                       requests_per_thread=25, seed=7, p=P_ORIG,
+                       retries=2, timeout=60.0, expect=expect,
+                       route_mix=(("entry", 1),))
+        st, m, _ = _get(f"http://{host}:{port}", "/metrics")
+    finally:
+        srv.close()
+    assert res["dropped"] == 0
+    assert res["untyped"] == []
+    assert res["value_errors"] == []
+    assert res["generation"]["violations"] == 0
+    assert res["generation"]["min"] == 1       # old bytes served mid-swap
+    assert res["generation"]["max"] == 2       # the swap landed under load
+    assert st == 200 and m["swap"]["swaps"] == 1
+    assert m["swap"]["refused"] == 0
+
+
+def test_corrupt_candidate_refused_old_keeps_serving(tmp_path):
+    """A buggy promoter publishes a bit-flipped candidate
+    (``verify=False``): the worker refuses the swap with a typed event,
+    keeps answering from the old artifact at the old generation, and a
+    subsequently promoted GOOD candidate swaps in cleanly."""
+    root = str(tmp_path)
+    v1 = _make_artifact(os.path.join(root, "v1"), seed=4)
+    ref = PosteriorArtifact.open(v1).assemble()
+    promote_artifact(root, "v1")
+    srv = PosteriorServer(root, port=0, swap_poll=0.0)
+    host, port = srv.start()
+    base = f"http://{host}:{port}"
+    try:
+        v2 = _variant_artifact(v1, os.path.join(root, "v2"))
+        _flip_byte(os.path.join(v2, MEAN_PANELS_FILE))
+        promote_artifact(root, "v2", verify=False)     # the buggy promoter
+        st, e, hdrs = _get(base, "/v1/entry?i=1&j=2")
+        assert st == 200
+        assert np.float32(e["value"]) == np.float32(ref[1, 2])
+        assert hdrs[GENERATION_HEADER] == "1"          # swap refused
+        st, h, _ = _get(base, "/healthz")
+        assert h["artifact_generation"] == 1
+        assert h["pointer_generation"] == 2            # pointer DID move
+        st, m, _ = _get(base, "/metrics")
+        assert m["swap"]["refused"] >= 1 and m["swap"]["swaps"] == 0
+        # recovery: a good candidate promotes and swaps
+        _variant_artifact(v1, os.path.join(root, "v3"))
+        promote_artifact(root, "v3")
+        st, e, hdrs = _get(base, "/v1/entry?i=1&j=2")
+        assert st == 200
+        assert np.float32(e["value"]) == np.float32(-ref[1, 2])
+        assert hdrs[GENERATION_HEADER] == "3"
+        st, m, _ = _get(base, "/metrics")
+        assert m["swap"]["swaps"] == 1
+    finally:
+        srv.close()
+
+
+# ---------------------------------------------------------------------------
+# per-connection io_timeout vs. the slow-loris client
+# ---------------------------------------------------------------------------
+
+def test_slow_loris_is_shed_not_parked(tmp_path):
+    """A client that sends half a request and squats: the per-connection
+    io_timeout closes it (recv sees EOF) while real traffic keeps being
+    answered, and close() does not hang on a parked handler thread."""
+    art = _make_artifact(str(tmp_path / "a"), seed=5)
+    srv = PosteriorServer(art, port=0, io_timeout=0.5)
+    host, port = srv.start()
+    base = f"http://{host}:{port}"
+    try:
+        loris = socket.create_connection((host, port), timeout=5.0)
+        loris.sendall(b"GET /healthz HTTP/1.1\r\nHost: loris\r\n")
+        # real traffic flows while the loris squats
+        st, h, _ = _get(base, "/healthz")
+        assert st == 200 and h["status"] in ("ok", "degraded")
+        # the server gives up on the silent socket at io_timeout: EOF
+        loris.settimeout(10.0)
+        assert loris.recv(1024) == b""
+        loris.close()
+        st, _, _ = _get(base, "/v1/entry?i=0&j=1")
+        assert st == 200
+    finally:
+        t0 = time.monotonic()
+        srv.close()
+        assert time.monotonic() - t0 < 10.0, "drain parked on the loris"
+
+
+# ---------------------------------------------------------------------------
+# the --workers N fleet (real CLI subprocesses)
+# ---------------------------------------------------------------------------
+
+def _readline_bounded(proc, timeout=90.0):
+    out = []
+    t = threading.Thread(target=lambda: out.append(proc.stdout.readline()))
+    t.start()
+    t.join(timeout)
+    if t.is_alive():
+        proc.kill()
+        proc.communicate()
+        raise AssertionError("fleet never printed its protocol line")
+    return out[0]
+
+
+def _spawn_fleet(root, run_dir, *, workers=2, extra=(), env_extra=None):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", **(env_extra or {}))
+    proc = subprocess.Popen(
+        [sys.executable, "-u", "-m", "dcfm_tpu.cli", "serve", root,
+         "--workers", str(workers), "--port", "0", "--run-dir", run_dir,
+         "--fleet-min-uptime", "0.2", "--fleet-backoff", "0.1",
+         "--request-timeout", "30", "--swap-poll", "0.05",
+         *extra],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        cwd=REPO, env=env)
+    line = _readline_bounded(proc)
+    assert line, proc.stderr.read()
+    info = json.loads(line)
+    return proc, info
+
+
+def _terminate_fleet(proc, timeout=90.0):
+    """SIGTERM + bounded communicate: the harness's no-hang watchdog."""
+    if proc.poll() is None:
+        proc.send_signal(signal.SIGTERM)
+    try:
+        return proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.communicate()
+        raise AssertionError("fleet hung past the drain bound")
+
+
+def test_fleet_kill_respawn_drain_and_events(tmp_path):
+    root = str(tmp_path / "root")
+    os.makedirs(root)
+    v1 = _make_artifact(os.path.join(root, "v1"), seed=6)
+    ref = PosteriorArtifact.open(v1).assemble()
+    promote_artifact(root, "v1")
+    run_dir = str(tmp_path / "obs")
+    proc, info = _spawn_fleet(root, run_dir, workers=2)
+    try:
+        assert info["ready"] is True and info["workers"] == 2
+        base = info["serving"]
+        st, h, _ = _get_retry(base, "/healthz")
+        assert st == 200
+        # per-worker liveness + fleet-wide table on ANY replica
+        assert h["worker"]["index"] in (0, 1)
+        assert h["artifact_generation"] == 1
+        fleet = h["fleet"]
+        assert len(fleet["workers"]) == 2
+        pids = [w["pid"] for w in fleet["workers"] if w["alive"]]
+        assert len(pids) == 2
+        # SIGKILL one worker: the supervisor must respawn it
+        os.kill(pids[0], signal.SIGKILL)
+        deadline = time.monotonic() + 30.0
+        respawned = False
+        while time.monotonic() < deadline and not respawned:
+            st, h, _ = _get_retry(base, "/healthz")
+            ws = (h.get("fleet") or {}).get("workers", [])
+            respawned = any(w["launch"] >= 2 and w["alive"] for w in ws)
+            time.sleep(0.05)
+        assert respawned, "killed worker never respawned"
+        # traffic still flows, values still bitwise
+        st, e, _ = _get_retry(base, "/v1/entry?i=0&j=1")
+        assert st == 200
+        assert np.float32(e["value"]) == np.float32(ref[0, 1])
+    finally:
+        out, err = _terminate_fleet(proc)
+    assert proc.returncode == 0, err
+    assert json.loads(out.strip().splitlines()[-1])["drained"] is True
+    # the run dir tells the whole story
+    s = summarize(run_dir)
+    assert len(s["worker_launches"]) >= 3      # 2 initial + 1 respawn
+    assert len(s["worker_deaths"]) >= 1
+    assert s["fleet_drained"] is True
+    assert not s["fleet_poisoned"]
+    # and `dcfm-tpu events` narrates it
+    cp = subprocess.run(
+        [sys.executable, "-m", "dcfm_tpu.cli", "events", run_dir],
+        capture_output=True, text=True, cwd=REPO)
+    assert cp.returncode == 0, cp.stderr
+    assert "serve worker deaths" in cp.stdout
+    assert "fleet drained cleanly" in cp.stdout
+
+
+def test_fleet_poison_detection_on_instant_deaths(tmp_path):
+    """Workers that die on arrival every launch are deterministic
+    breakage: the fleet backs off, trips poison detection, and exits 2
+    with a typed JSON line instead of relaunching forever."""
+    run_dir = str(tmp_path / "obs")
+    proc, info = _spawn_fleet(
+        str(tmp_path / "no-such-artifact"), run_dir, workers=2,
+        # min-uptime 10s: interpreter startup + the instant ArtifactError
+        # still counts as an on-arrival death
+        extra=["--fleet-poison-deaths", "2", "--fleet-min-uptime", "10"])
+    # no SIGTERM: the fleet must give up BY ITSELF, bounded
+    try:
+        out, err = proc.communicate(timeout=60.0)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.communicate()
+        raise AssertionError("poisoned fleet never gave up")
+    assert proc.returncode == 2, (out, err)
+    lines = [json.loads(ln) for ln in out.strip().splitlines()]
+    assert any(ln.get("poisoned") for ln in lines), lines
+    s = summarize(run_dir)
+    assert s["fleet_poisoned"] is True
+    assert len(s["worker_deaths"]) >= 2
+
+
+# ---------------------------------------------------------------------------
+# the serve chaos harness
+# ---------------------------------------------------------------------------
+
+def _run_chaos_point(tmp_path, seed, index):
+    """One seeded chaos point end to end: build the promotion root,
+    export the fault plan to a REAL 2-worker fleet, drive the loadgen
+    (with the point's slow-loris clients), optionally promote mid-load
+    (optionally a corrupted candidate), then drain under a hard bound.
+    Asserts the sweep contract: every response ok or typed, zero
+    dropped, zero generation regressions, fleet exits 0, never hangs."""
+    spec = serve_fuzz_spec(seed, index, workers=2, max_requests=30)
+    sv = spec["serve"]
+    root = str(tmp_path / f"root{index}")
+    os.makedirs(root)
+    v1 = _make_artifact(os.path.join(root, "v1"), seed=100 + index)
+    promote_artifact(root, "v1")
+    v2 = _variant_artifact(v1, os.path.join(root, "v2"))
+    if sv["promotion_fault"] == "torn":
+        size = os.path.getsize(os.path.join(v2, MEAN_PANELS_FILE))
+        with open(os.path.join(v2, MEAN_PANELS_FILE), "r+b") as f:
+            f.truncate(size // 2)
+    elif sv["promotion_fault"] == "bit_flip":
+        _flip_byte(os.path.join(v2, MEAN_PANELS_FILE))
+    run_dir = str(tmp_path / f"obs{index}")
+    proc, info = _spawn_fleet(
+        root, run_dir, workers=2,
+        extra=["--io-timeout", "1.0", "--fleet-watchdog", "300"],
+        env_extra={"DCFM_FAULT_PLAN": json.dumps(spec)})
+    timer = None
+    try:
+        base = info["serving"]
+        if sv["promote"]:
+            timer = threading.Timer(
+                0.3, lambda: promote_artifact(
+                    root, "v2", verify=not sv["promotion_fault"]))
+            timer.start()
+        res = run_load(base, threads=6, requests_per_thread=10,
+                       seed=seed * 1000 + index, p=P_ORIG, retries=10,
+                       timeout=30.0, slow_clients=sv["slow_clients"],
+                       slow_hold_s=3.0)
+        if timer is not None:
+            timer.join()
+    finally:
+        if timer is not None:
+            timer.cancel()
+        out, err = _terminate_fleet(proc, timeout=120.0)
+    assert proc.returncode == 0, (sv, err[-2000:])
+    assert res["untyped"] == [], (sv, res["untyped"][:3])
+    assert res["dropped"] == 0, (sv, res)
+    assert res["generation"]["violations"] == 0, (sv, res)
+    if sv["promotion_fault"]:
+        # every worker must have refused the corrupt candidate: no
+        # response was ever tagged with the poisoned generation
+        assert res["generation"]["max"] in (None, 1), (sv, res)
+    return res, spec
+
+
+def test_serve_chaos_smoke(tmp_path):
+    """Tier-1 smoke: the first three DISTINCT chaos shapes of the
+    seed-0 stream, through the full subprocess fleet harness."""
+    picked, kinds = [], set()
+    for idx in range(40):
+        kind = serve_fuzz_spec(0, idx)["serve"]["kind"]
+        if kind not in kinds:
+            kinds.add(kind)
+            picked.append(idx)
+        if len(picked) == 3:
+            break
+    for idx in picked:
+        _run_chaos_point(tmp_path, 0, idx)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("index", range(25))
+def test_serve_chaos_sweep(tmp_path, index):
+    """The >=25-point acceptance sweep (DCFM_SERVE_FUZZ_SEED reseeds
+    the whole stream): 0 hangs, 0 dropped, 0 untyped, per-point."""
+    seed = int(os.environ.get("DCFM_SERVE_FUZZ_SEED", "0"))
+    _run_chaos_point(tmp_path, seed, index)
