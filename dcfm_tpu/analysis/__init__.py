@@ -38,8 +38,15 @@ from dcfm_tpu.analysis.rules import RULES, Rule
 
 __all__ = [
     "Finding", "RULES", "Rule", "lint_file", "lint_paths", "lint_source",
-    "main",
+    "lint_project", "main",
 ]
+
+
+def lint_project(paths, **kwargs):
+    """Project-aware lint (cross-module symbol table, optional cache /
+    changed-only selection); see analysis/engine.py."""
+    from dcfm_tpu.analysis.engine import lint_project as _lp
+    return _lp(paths, **kwargs)
 
 
 def main(argv=None) -> int:

@@ -26,8 +26,10 @@ from __future__ import annotations
 
 import ast
 import dataclasses
+import io
 import os
 import re
+import tokenize
 from typing import Iterable, Optional
 
 from dcfm_tpu.analysis.rules import RULES
@@ -96,11 +98,19 @@ def _last(name: Optional[str]) -> str:
 
 
 class _Module:
-    """Shared per-file context: aliases, traced-function set, taint."""
+    """Shared per-file context: aliases, traced-function set, taint.
 
-    def __init__(self, tree: ast.Module, source: str, path: str):
+    ``project`` is the optional cross-module symbol table built by
+    analysis/engine.py (threaded classes, loader helpers, jit entries);
+    single-file mode (``lint_file`` without a project) keeps every rule
+    functional on in-module evidence alone.
+    """
+
+    def __init__(self, tree: ast.Module, source: str, path: str,
+                 project=None):
         self.tree = tree
         self.path = path
+        self.project = project
         self.lines = source.splitlines()
         base = os.path.basename(path)
         self.is_test = base.startswith("test_") or base == "conftest.py"
@@ -110,10 +120,17 @@ class _Module:
         # convention).  Deliberately NOT a substring match: a module
         # like runtime_flags.py is ordinary library code and must not
         # be held to the pipeline's async-fetch discipline.
-        parts = path.replace("\\", "/").split("/")
+        parts = str(path).replace("\\", "/").split("/")
         stem = base[:-3] if base.endswith(".py") else base
         self.is_runtime = ("runtime" in parts[:-1] or stem == "runtime"
                            or stem.endswith("_runtime"))
+        # Standalone scripts (scripts/, bench.py, the graft driver) are
+        # operator entry points, not library code: library_only rules
+        # (constant seeds, console prints, daemon helpers) skip them
+        # exactly like test files - the whole-tree gate must not force
+        # telemetry discipline onto demo drivers.
+        self.is_script = ("scripts" in parts[:-1]
+                          or stem in {"bench", "__graft_entry__"})
         self.ignores = self._collect_ignores()
         self.aliases: dict = {}
         self._collect_aliases()
@@ -121,11 +138,23 @@ class _Module:
         self._collect_traced()
 
     def _collect_ignores(self) -> dict:
+        """Pragmas from real COMMENT tokens only: a docstring or rule
+        summary that merely *mentions* the ``# dcfm: ignore[...]``
+        syntax is prose, not a suppression (and must not be flagged as
+        a stale one by DCFM002)."""
         out: dict = {}
-        for i, line in enumerate(self.lines, 1):
-            m = _IGNORE_RE.search(line)
+        try:
+            tokens = list(tokenize.generate_tokens(
+                io.StringIO("\n".join(self.lines) + "\n").readline))
+        except (tokenize.TokenError, IndentationError, SyntaxError):
+            return out
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _IGNORE_RE.search(tok.string)
             if m:
-                out[i] = {r.strip() for r in m.group(1).split(",")}
+                out[tok.start[0]] = {r.strip()
+                                     for r in m.group(1).split(",")}
         return out
 
     def _collect_aliases(self) -> None:
@@ -162,24 +191,24 @@ class _Module:
 
     # -- traced-function discovery ------------------------------------
     def _collect_traced(self) -> None:
-        # function-definition tree: every def, keyed by enclosing scope
-        self._defs_by_scope: dict = {}
+        # function-definition tree: every def, keyed by nearest
+        # enclosing def scope (module for top-level and class methods -
+        # class bodies do not make a def scope).  One linear traversal;
+        # the previous per-def ancestor walk was quadratic and dominated
+        # whole-tree lint time.
+        self._defs_by_scope: dict = {self.tree: {}}
 
-        def collect(scope: ast.AST) -> None:
-            local = self._defs_by_scope.setdefault(scope, {})
-            for st in ast.walk(scope):
-                if st is scope:
-                    continue
-                if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                    # only direct children scopes: a def is "in" the
-                    # nearest enclosing def
-                    if _enclosing_def(self.tree, st) is scope or (
-                            scope is self.tree
-                            and _enclosing_def(self.tree, st) is None):
-                        local[st.name] = st
-                        collect(st)
+        def collect(node: ast.AST, scope: ast.AST) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child,
+                              (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    self._defs_by_scope[scope][child.name] = child
+                    self._defs_by_scope.setdefault(child, {})
+                    collect(child, child)
+                else:
+                    collect(child, scope)
 
-        collect(self.tree)
+        collect(self.tree, self.tree)
 
         for scope, defs in self._defs_by_scope.items():
             for fdef in defs.values():
@@ -224,36 +253,23 @@ class _Module:
                             changed = True
 
 
-def _enclosing_def(tree: ast.Module, target: ast.AST):
-    """Nearest FunctionDef ancestor of ``target`` (None = module)."""
-    path = []
-
-    def walk(node, anc):
-        if node is target:
-            path.append(anc)
-            return True
-        for child in ast.iter_child_nodes(node):
-            na = node if isinstance(
-                node, (ast.FunctionDef, ast.AsyncFunctionDef)) else anc
-            if walk(child, na):
-                return True
-        return False
-
-    walk(tree, None)
-    return path[0] if path else None
-
-
 class _Reporter:
     def __init__(self, mod: _Module):
         self.mod = mod
         self.findings: list = []
         self._seen: set = set()
+        # (line, rule) pairs whose pragma actually suppressed an emit -
+        # the stale-suppression pass (DCFM002) reports every pragma NOT
+        # in this set once all checkers have run
+        self.used_ignores: set = set()
 
     def emit(self, rule: str, node: ast.AST, message: str) -> None:
-        if rule in RULES and RULES[rule].library_only and self.mod.is_test:
+        if rule in RULES and RULES[rule].library_only \
+                and (self.mod.is_test or self.mod.is_script):
             return
         line = getattr(node, "lineno", 0)
         if rule in self.mod.ignores.get(line, set()):
+            self.used_ignores.add((line, rule))
             return
         key = (rule, line, getattr(node, "col_offset", 0))
         if key in self._seen:
@@ -1251,16 +1267,53 @@ def _check_handlers(mod: _Module, rep: _Reporter) -> None:
 
 
 # =====================================================================
+# DCFM002 - stale suppressions
+# =====================================================================
+
+class _PragmaSite:
+    """Synthetic emit anchor for a pragma comment (no AST node exists
+    for a comment; line/col come from the source text)."""
+
+    def __init__(self, line: int, col: int):
+        self.lineno = line
+        self.col_offset = col
+
+
+def _check_stale_pragmas(mod: _Module, rep: _Reporter) -> None:
+    """DCFM002: every ``# dcfm: ignore[RULE]`` must have suppressed at
+    least one finding in this run.  MUST run after every other checker
+    (it reads the reporter's used-ignore ledger)."""
+    for line, rules in sorted(mod.ignores.items()):
+        text = mod.lines[line - 1] if 0 < line <= len(mod.lines) else ""
+        m = _IGNORE_RE.search(text)
+        col = m.start() if m else 0
+        for rule in sorted(rules):
+            if (line, rule) in rep.used_ignores:
+                continue
+            detail = ("names an unknown rule id"
+                      if rule not in RULES and rule != "DCFM000"
+                      else "no longer fires on this line")
+            rep.emit("DCFM002", _PragmaSite(line, col),
+                     f"stale suppression: '# dcfm: ignore[{rule}]' "
+                     f"{detail} - the pragma hides nothing today but "
+                     "would mask a future regression; drop it")
+
+
+# =====================================================================
 # driver
 # =====================================================================
 
-def lint_source(source: str, path: str = "<string>") -> list:
+def lint_source(source: str, path: str = "<string>",
+                project=None) -> list:
+    from dcfm_tpu.analysis.lifetime import check_lifetime
+    from dcfm_tpu.analysis.locks import check_locks
+
     try:
         tree = ast.parse(source, filename=path)
     except SyntaxError as e:
         return [Finding(path, e.lineno or 0, e.offset or 0, "DCFM000",
                         f"syntax error: {e.msg}")]
-    mod = _Module(tree, source, path)
+    mod = _Module(tree, source, path, project=project)
     rep = _Reporter(mod)
     _check_rng(mod, rep)
     _check_traced_bodies(mod, rep)
@@ -1273,26 +1326,22 @@ def lint_source(source: str, path: str = "<string>") -> list:
     _check_pipeline(mod, rep)
     _check_obs(mod, rep)
     _check_handlers(mod, rep)
+    check_locks(mod, rep, project)
+    check_lifetime(mod, rep, project)
+    _check_stale_pragmas(mod, rep)      # must stay last: reads the ledger
     rep.findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return rep.findings
 
 
-def lint_file(path: str) -> list:
+def lint_file(path: str, project=None) -> list:
     with open(path, "r", encoding="utf-8") as f:
-        return lint_source(f.read(), path)
+        return lint_source(f.read(), path, project=project)
 
 
 def lint_paths(paths: Iterable[str]) -> list:
-    findings: list = []
-    for p in paths:
-        if os.path.isdir(p):
-            for root, dirs, files in os.walk(p):
-                dirs[:] = [d for d in dirs if d not in {
-                    "__pycache__", ".git", ".jax_cache"}]
-                for fn in sorted(files):
-                    if fn.endswith(".py"):
-                        findings.extend(lint_file(os.path.join(root, fn)))
-        elif p.endswith(".py"):
-            findings.extend(lint_file(p))
-    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
-    return findings
+    """Project-aware lint over files/directories: builds the cross-
+    module symbol table first (analysis/engine.py), then lints each
+    file with it.  Kept as the stable public entry point - the engine
+    adds caching/baseline/SARIF on top for the CLI."""
+    from dcfm_tpu.analysis.engine import lint_project
+    return lint_project(paths)

@@ -7,8 +7,16 @@ from, and the fixture tests assert that every registered rule has at
 least one known-bad fixture that fires it.
 
 ``library_only`` rules are skipped for test files (``test_*.py`` /
-``conftest.py``): tests legitimately use constant seeds and daemon
-helper threads; library code must not.
+``conftest.py``) and standalone scripts (``scripts/``, ``bench.py``,
+the graft entry): tests legitimately use constant seeds and daemon
+helper threads, and demo scripts print to the console by design;
+library code must not.
+
+``severity`` feeds the CLI exit-code contract: ``error`` findings fail
+the build (exit 1); ``warning`` findings (suppression rot, style-grade
+drift) are reported but only fail under ``--fail-on warning`` - which
+is what scripts/ci_check.sh passes, so warnings still gate CI without
+hard-failing ad-hoc local runs.
 """
 
 from __future__ import annotations
@@ -23,9 +31,18 @@ class Rule:
     family: str
     summary: str
     library_only: bool = False
+    severity: str = "error"
 
 
 RULES = {r.id: r for r in [
+    # ---- DCFM0xx: linter meta-discipline -----------------------------
+    Rule("DCFM002", "stale-suppression", "meta",
+         "a `# dcfm: ignore[DCFMxxx]` pragma on a line where that rule "
+         "no longer fires - the suppression has rotted (the code it "
+         "excused was fixed, moved, or the pragma named the wrong "
+         "rule) and now hides nothing but would hide a future "
+         "regression; drop it",
+         severity="warning"),
     # ---- DCFM1xx: RNG discipline -------------------------------------
     Rule("DCFM101", "rng-key-reuse", "rng",
          "a PRNG key is consumed more than once on one path: two "
@@ -136,8 +153,8 @@ RULES = {r.id: r for r in [
     Rule("DCFM801", "pipeline-blocking-host-fetch", "pipeline",
          "blocking host fetch (jax.device_get on an array variable, or "
          "np.asarray/np.array on a name) inside a function of a runtime "
-         "pipeline module (any module under - or named - 'runtime', i.e. "
-         "dcfm_tpu/runtime/) with no PRECEDING copy_to_host_async "
+         "pipeline module (any module under - or named - 'runtime', "
+         "such as dcfm_tpu/runtime/) with no PRECEDING copy_to_host_async "
          "dispatch in the same function.  The chunk pipeline's contract "
          "is async-first: dispatch the device->host copy at the chunk "
          "boundary and drain off-thread "
@@ -157,5 +174,36 @@ RULES = {r.id: r for r in [
          "settimeout.  One slow client then parks the handler thread "
          "forever - the slow-loris hang class; every wait in a request "
          "path must be deadline-bounded",
+         library_only=True),
+    # ---- DCFM11xx: lockset race discipline ---------------------------
+    Rule("DCFM1101", "lockset-inconsistent-guard", "locks",
+         "an instance attribute of a multi-threaded class (one that "
+         "runs its own methods on threading.Thread targets, is a "
+         "handler class, or owns a lock) is written under a guarding "
+         "lock on one path and read/written without it on another - "
+         "the lockset intersection over its access sites is empty, the "
+         "Eraser-style data-race signature.  Hold the same lock on "
+         "every access, or annotate the documented benign race "
+         "(immutable-reference hot-swap, monotonic gauge) with "
+         "`# dcfm: ignore[DCFM1101] - <why>`",
+         library_only=True),
+    Rule("DCFM1102", "lock-order-inversion", "locks",
+         "two locks are acquired in both nesting orders somewhere in "
+         "this module (A held while taking B, and B held while taking "
+         "A) - the classic ABBA deadlock; pick one global order and "
+         "acquire in that order everywhere",
+         library_only=True),
+    # ---- DCFM12xx: host-buffer lifetime discipline -------------------
+    Rule("DCFM1201", "host-buffer-lifetime", "lifetime",
+         "a host buffer of numpy provenance (np.load / np.memmap / a "
+         "view of one / a loader-helper return) flows into a jit entry "
+         "point, jax.device_put, or jax.make_array_from_callback "
+         "without an owned-copy commit - on the CPU backend jit "
+         "ingestion aliases the host buffer zero-copy, so if the "
+         "source dies before the device reads it this is a "
+         "use-after-free (the PR-1 resume SIGSEGV / PR-5 multiproc "
+         "NaN-Sigma / PR-6 stream-drain class).  Commit through "
+         "_owned_copy_jit / _copy_tree / np.ascontiguousarray while "
+         "the source is still alive",
          library_only=True),
 ]}

@@ -394,7 +394,7 @@ def main(argv=None) -> int:
         from dcfm_tpu.serve.promote import promote_artifact
         st = promote_artifact(args.root, args.candidate,
                               verify=not args.no_verify)
-        print(json.dumps({  # dcfm: ignore[DCFM901] - the promote CLI's stdout protocol
+        print(json.dumps({
             "promoted": st.target, "generation": st.generation,
             "fingerprint": st.fingerprint}), flush=True)
         return 0

@@ -108,7 +108,7 @@ class FlightRecorder:
             # resume gates record() right before committing a decision -
             # an emit failure there must not be mistaken for a gate
             # failure)
-            pass  # dcfm: ignore[DCFM601] - best-effort telemetry by contract; the run outranks its log
+            pass
 
     def flush(self, fsync: bool = False) -> None:
         """Flush (and optionally fsync) the log - called at chunk
@@ -122,7 +122,7 @@ class FlightRecorder:
                 if fsync:
                     os.fsync(self._f.fileno())
         except (OSError, ValueError):
-            pass  # dcfm: ignore[DCFM601] - best-effort telemetry by contract; the run outranks its log
+            pass
 
     def close(self) -> None:
         with self._lock:
@@ -133,7 +133,7 @@ class FlightRecorder:
                 self._f.flush()
                 os.fsync(self._f.fileno())
             except (OSError, ValueError):
-                pass  # dcfm: ignore[DCFM601] - best-effort durability on close; the log is already line-flushed
+                pass
             self._f.close()
 
 
@@ -159,7 +159,7 @@ def uninstall(rec: FlightRecorder) -> None:
         try:
             _STACK.remove(rec)
         except ValueError:
-            pass  # dcfm: ignore[DCFM601] - double-uninstall is a harmless no-op by contract
+            pass
 
 
 def active() -> Optional[FlightRecorder]:

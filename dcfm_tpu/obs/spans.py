@@ -57,7 +57,7 @@ def _role_pid(role: str) -> int:
             launch_s, proc_s = role[1:].split(".p", 1)
             return (int(launch_s) - 1) * 100 + int(proc_s)
         except ValueError:
-            pass  # dcfm: ignore[DCFM601] - an unrecognized role just gets the fallback pid
+            pass
     return hash(role) % 1000 + 1000
 
 

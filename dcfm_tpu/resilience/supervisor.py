@@ -277,7 +277,7 @@ def _demote(p: str, err, report: SuperviseReport,
     try:
         os.replace(p, p + ".corrupt")
     except OSError:
-        pass  # dcfm: ignore[DCFM601] - a vanished file is already demoted
+        pass
 
 
 def _promote(src: str, slot: str) -> None:
@@ -407,7 +407,7 @@ def _ensure_unanimous_checkpoint(path: str, num_processes: int,
                 try:
                     os.replace(slot, slot + ".orphan")
                 except OSError:
-                    pass  # dcfm: ignore[DCFM601] - a vanished file needs no setting aside
+                    pass
     for i in range(num_processes):
         side = proc_path(path + ".full", i, num_processes)
         for p, _, err in scan_generations(side):
@@ -821,11 +821,11 @@ def supervise(Y, cfg, *, max_retries: int = 5, backoff_base: float = 1.0,
                 try:
                     os.unlink(p)
                 except OSError:
-                    pass  # dcfm: ignore[DCFM601] - scratch cleanup only
+                    pass
             try:
                 os.rmdir(workdir)
             except OSError:
-                pass  # dcfm: ignore[DCFM601] - scratch cleanup only
+                pass
     # The children completed the chain; materialize the FitResult in this
     # process via a no-op resume (loads the finished checkpoint, executes
     # zero iterations, fetches + assembles) - with the supervision

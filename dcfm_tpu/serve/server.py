@@ -729,6 +729,8 @@ class PosteriorServer:
         from dcfm_tpu import native
         ep = self._epoch
         a = ep.artifact
+        with self._shed_lock:
+            shedding = self._shedding
         h = {
             "status": ("draining" if self._draining
                        else "ok" if native.available() else "degraded"),
@@ -739,7 +741,7 @@ class PosteriorServer:
             # still answering under the old fingerprint is stale)
             "artifact_fingerprint": a.fingerprint,
             "artifact_generation": ep.generation,
-            "shedding": self._shedding,
+            "shedding": shedding,
             "uptime_s": round(time.monotonic() - self._t0, 3),
         }
         if self.worker_index is not None:
@@ -761,6 +763,8 @@ class PosteriorServer:
             hists = {p: h.snapshot() for p, h in self._hist.items()}
         statuses = self.status_counts()
         ep = self._epoch
+        with self._shed_lock:
+            shedding = self._shedding
         return {
             "latency": hists,
             "statuses": statuses,
@@ -775,7 +779,7 @@ class PosteriorServer:
                     for lab, _c in self._swap_refused.series()),
             },
             "shed": {
-                "active": self._shedding,
+                "active": shedding,
                 "by_route": {lab["route"]: int(self._shed_total.value(**lab))
                              for lab, _c in self._shed_total.series()},
             },

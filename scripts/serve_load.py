@@ -44,7 +44,7 @@ def main(argv=None) -> int:
         requests_per_thread=args.requests, seed=args.seed, p=args.p,
         retries=args.retries, timeout=args.timeout,
         slow_clients=args.slow_clients, slow_hold_s=args.slow_hold_s)
-    print(json.dumps(result, indent=2))  # dcfm: ignore[DCFM901] - the load driver's stdout protocol: the classified result IS the output
+    print(json.dumps(result, indent=2))
     bad = (result["untyped"] or result["dropped"]
            or result["generation"]["violations"]
            or result["value_errors"])

@@ -1,0 +1,37 @@
+"""Known-bad host-buffer lifetime fixture: the three shipped UAF shapes
+(PR-1 resume SIGSEGV, PR-5 multiprocess NaN Sigma, PR-6 stream drain)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def _sweep(carry):
+    return jnp.sin(carry)
+
+
+def _load_carry(path):
+    # loader helper: its return value dies with the closed npz handle
+    with np.load(path) as z:
+        return z["carry"]
+
+
+def resume_shape_pr1(path):
+    # PR-1: loader-helper result fed straight into the chunk jit
+    carry = _load_carry(path)
+    return _sweep(carry)
+
+
+def assemble_shape_pr5(path, sharding):
+    # PR-5: make_array_from_callback over pages that die with `z`
+    with np.load(path) as z:
+        page = z["page_0"]
+    return jax.make_array_from_callback(
+        page.shape, sharding, lambda idx, _p=page: _p[idx])
+
+
+def stream_shape_pr6(path, sharding):
+    # PR-6: a memmap view handed to device_put; the map dies at return
+    mm = np.memmap(path, dtype="float32", mode="r", shape=(64, 64))
+    view = mm[:32]
+    return jax.device_put(view, sharding)

@@ -1,0 +1,46 @@
+"""Known-bad lockset fixture: inconsistent guard + ABBA inversion."""
+import threading
+
+
+class Accumulator:
+    """Worker thread bumps ``total``; readers race it unguarded."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._order_a = threading.Lock()
+        self._order_b = threading.Lock()
+        self.total = 0
+        self._stop = threading.Event()
+        self._worker = threading.Thread(target=self._loop)
+        self._worker.start()
+
+    def _loop(self):
+        while not self._stop.is_set():
+            with self._lock:
+                self.total += 1
+
+    def peek(self):
+        # DCFM1101: guarded in _loop/snapshot_locked, bare here
+        return self.total
+
+    def reset(self):
+        self.total = 0
+
+    def snapshot_locked(self):
+        with self._lock:
+            return self.total
+
+    def transfer_ab(self):
+        with self._order_a:
+            with self._order_b:
+                return self.snapshot_locked()
+
+    def transfer_ba(self):
+        # DCFM1102: opposite order from transfer_ab
+        with self._order_b:
+            with self._order_a:
+                return self.snapshot_locked()
+
+    def close(self):
+        self._stop.set()
+        self._worker.join()

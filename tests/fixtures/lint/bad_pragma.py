@@ -1,0 +1,8 @@
+"""Known-bad stale-suppression fixture: pragmas that hide nothing."""
+
+
+def configured(flag):
+    limit = 4  # dcfm: ignore[DCFM501]
+    if flag:
+        limit += 1  # dcfm: ignore[DCFM999]
+    return limit

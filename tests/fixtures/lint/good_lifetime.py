@@ -1,0 +1,52 @@
+"""Known-good twin of bad_lifetime.py: the same flows, committed
+through owned copies before (rebind) or after (trailing commit) the
+sink, plus the parameter-sourced callback that must stay quiet."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def _sweep(carry):
+    return jnp.sin(carry)
+
+
+@jax.jit
+def _owned_copy_jit(tree):
+    return jax.tree_util.tree_map(jnp.asarray, tree)
+
+
+def _copy_tree(tree):
+    return jax.tree_util.tree_map(np.array, tree)
+
+
+def _load_carry(path):
+    with np.load(path) as z:
+        return z["carry"]
+
+
+def resume_committed(path):
+    carry = _load_carry(path)
+    carry = _owned_copy_jit(carry)    # commit: rebind through owned copy
+    return _sweep(carry)
+
+
+def resume_ascontiguous(path):
+    raw = _load_carry(path)
+    carry = np.ascontiguousarray(raw)
+    return _sweep(carry)
+
+
+def assemble_committed(path, sharding):
+    # the checkpoint.py shape: alias pages, commit while source alive
+    with np.load(path) as z:
+        page = z["page_0"]
+        arr = jax.make_array_from_callback(
+            page.shape, sharding, lambda idx, _p=page: _p[idx])
+        return _copy_tree(arr)
+
+
+def place_params(Y, mesh):
+    # parameters are the caller's responsibility: no taint, no finding
+    return jax.make_array_from_callback(
+        Y.shape, mesh, lambda idx: Y[idx])

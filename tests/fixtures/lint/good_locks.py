@@ -1,0 +1,47 @@
+"""Known-good lockset fixture: consistent guarding, one lock order,
+init-only config, thread-safe primitive attributes, and a documented
+benign race (the sanctioned pragma idiom)."""
+import threading
+
+
+class Metered:
+    def __init__(self, capacity):
+        self._lock = threading.Lock()
+        self._aux = threading.Lock()
+        self.capacity = capacity      # init-only: never written later
+        self.count = 0
+        self._q = []
+        self._stop = threading.Event()
+        self._worker = threading.Thread(target=self._loop)
+        self._worker.start()
+
+    def _loop(self):
+        while not self._stop.is_set():
+            with self._lock:
+                self.count += 1
+                self._q.append(self.count)
+
+    def stats(self):
+        with self._lock:
+            return {"count": self.count, "cap": self.capacity,
+                    "depth": len(self._q)}
+
+    def peek_dirty(self):
+        # monotonic gauge: a stale read is fine for logging
+        return self.count  # dcfm: ignore[DCFM1101]
+
+    def drain(self):
+        with self._lock:
+            with self._aux:           # always _lock -> _aux
+                out, self._q = self._q, []
+                return out
+
+    def flush(self):
+        with self._lock:
+            with self._aux:           # same order: no inversion
+                self._q = []
+
+    def close(self):
+        self._stop.set()
+        self._worker.join()
+        return self.stats()

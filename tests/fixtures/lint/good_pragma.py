@@ -1,0 +1,14 @@
+"""Known-good stale-suppression twin: every pragma hides a live
+finding, so DCFM002 stays silent."""
+import threading
+
+
+def sanctioned_daemon(fn):
+    # deliberate, documented exception - the pragma is USED
+    t = threading.Thread(target=fn, daemon=True)  # dcfm: ignore[DCFM501]
+    t.start()
+    return t
+
+
+def _join(t):
+    t.join()

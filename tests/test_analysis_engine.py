@@ -1,0 +1,425 @@
+"""Engine-level tests: cross-module symbol table, cache, baseline,
+SARIF, and the CLI exit-code contract.
+
+tests/test_lint.py pins per-rule behavior on single files; this module
+pins everything the project engine adds on top - the parts CI leans on
+(scripts/ci_check.sh runs one whole-tree baseline-gated lint).  Pure
+``ast`` + subprocess: no jax import needed.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+from dcfm_tpu.analysis import lint_file
+from dcfm_tpu.analysis.engine import lint_project
+from dcfm_tpu.analysis.__main__ import main
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "fixtures", "lint")
+
+_KEY_REUSE = textwrap.dedent("""\
+    import jax
+
+
+    def {name}(key):
+        a = jax.random.normal(key, (2,))
+        b = jax.random.normal(key, (2,))
+        return a + b
+""")
+
+
+def _cli(args, cwd):
+    env = dict(os.environ, PYTHONPATH=REPO)
+    return subprocess.run(
+        [sys.executable, "-m", "dcfm_tpu.analysis"] + args,
+        capture_output=True, text=True, cwd=cwd, env=env)
+
+
+# ---------------------------------------------------------------------------
+# cross-module symbol table: findings single-file analysis cannot see
+# ---------------------------------------------------------------------------
+
+def test_project_table_flags_cross_module_thread_target(tmp_path):
+    """A class with zero in-module threading evidence races once some
+    OTHER module hands its method to threading.Thread."""
+    a = tmp_path / "a.py"
+    a.write_text(textwrap.dedent("""\
+        import threading
+
+        _REG_LOCK = threading.Lock()
+
+
+        class Counter:
+            def __init__(self):
+                self.n = 0
+
+            def inc(self):
+                with _REG_LOCK:
+                    self.n += 1
+
+            def peek(self):
+                return self.n
+    """))
+    (tmp_path / "b.py").write_text(textwrap.dedent("""\
+        import threading
+
+        from a import Counter
+
+
+        def run():
+            c = Counter()
+            t = threading.Thread(target=c.inc)
+            t.start()
+            t.join()
+            return c
+    """))
+    # single-file: no evidence the class is threaded -> silent
+    assert lint_file(str(a)) == []
+    findings = lint_project([str(tmp_path)])
+    races = [f for f in findings if f.rule == "DCFM1101"]
+    assert len(races) == 1
+    assert str(races[0].path).endswith("a.py")
+    assert "Thread targets elsewhere" in races[0].message
+
+
+def test_project_table_flags_cross_module_loader_helper(tmp_path):
+    """numpy provenance survives a cross-module helper call: the
+    PR-1 resume shape split over two files."""
+    (tmp_path / "loaders.py").write_text(textwrap.dedent("""\
+        import numpy as np
+
+
+        def load_page(path):
+            return np.load(path)["page"]
+    """))
+    consume = tmp_path / "consume.py"
+    consume.write_text(textwrap.dedent("""\
+        import jax
+        import jax.numpy as jnp
+
+        from loaders import load_page
+
+
+        @jax.jit
+        def step(x):
+            return jnp.sum(x)
+
+
+        def resume(path):
+            page = load_page(path)
+            return step(page)
+    """))
+    assert lint_file(str(consume)) == []
+    findings = lint_project([str(tmp_path)])
+    uaf = [f for f in findings if f.rule == "DCFM1201"]
+    assert len(uaf) == 1
+    assert str(uaf[0].path).endswith("consume.py")
+
+
+def test_reintroducing_pr5_pattern_in_scratch_file_is_flagged(tmp_path):
+    """The acceptance gate for the whole checker: paste the PR-5
+    make_array_from_callback-over-dying-buffers pattern into a scratch
+    file and the tree lint must flag DCFM1201."""
+    (tmp_path / "scratch.py").write_text(textwrap.dedent("""\
+        import jax
+        import numpy as np
+
+
+        def place(path, sharding):
+            with np.load(path) as z:
+                sigma = z["Sigma"]
+            return jax.make_array_from_callback(
+                sigma.shape, sharding, lambda idx: sigma[idx])
+    """))
+    findings = lint_project([str(tmp_path)])
+    assert any(f.rule == "DCFM1201" for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# content-hash cache: warm runs are correct, identical, and faster
+# ---------------------------------------------------------------------------
+
+def _write_tree(root, n=24):
+    body = textwrap.dedent("""\
+        import threading
+
+
+        class Widget{i}:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.state = {{}}
+
+            def update(self, k, v):
+                with self._lock:
+                    self.state[k] = v
+
+            def get(self, k):
+                with self._lock:
+                    return self.state.get(k)
+
+
+        def helper_{i}(x):
+            out = []
+            for j in range(10):
+                out.append(x + j)
+            return out
+    """)
+    for i in range(n):
+        (root / f"mod_{i:02d}.py").write_text(body.format(i=i))
+
+
+def test_cache_warm_run_is_faster_and_identical(tmp_path):
+    _write_tree(tmp_path)
+    cache = str(tmp_path / ".lintcache.json")
+    t0 = time.perf_counter()
+    cold = lint_project([str(tmp_path)], cache_path=cache,
+                        exclude=[cache])
+    t1 = time.perf_counter()
+    warm = lint_project([str(tmp_path)], cache_path=cache,
+                        exclude=[cache])
+    t2 = time.perf_counter()
+    assert cold == warm == []
+    assert os.path.exists(cache)
+    # warm run only hashes file bytes; cold parses + lints everything
+    assert (t2 - t1) < (t1 - t0)
+
+
+def test_cache_does_not_mask_edits(tmp_path):
+    _write_tree(tmp_path, n=4)
+    cache = str(tmp_path / ".lintcache.json")
+    assert lint_project([str(tmp_path)], cache_path=cache,
+                        exclude=[cache]) == []
+    # introduce a violation into one cached file: it must be re-linted
+    (tmp_path / "mod_00.py").write_text(_KEY_REUSE.format(name="f"))
+    findings = lint_project([str(tmp_path)], cache_path=cache,
+                            exclude=[cache])
+    assert [f.rule for f in findings] == ["DCFM101"]
+    assert str(findings[0].path).endswith("mod_00.py")
+
+
+def test_cache_warm_cli_output_is_byte_identical(tmp_path):
+    (tmp_path / "mod.py").write_text(_KEY_REUSE.format(name="f"))
+    args = ["mod.py", "--format", "json", "--cache-file", "c.json"]
+    first = _cli(args, cwd=str(tmp_path))    # cold: populates the cache
+    second = _cli(args, cwd=str(tmp_path))   # warm: served from it
+    assert first.returncode == second.returncode == 1
+    assert first.stdout == second.stdout
+    assert json.loads(first.stdout)[0]["rule"] == "DCFM101"
+
+
+# ---------------------------------------------------------------------------
+# baseline: adopt debt, gate on new findings, report stale entries
+# ---------------------------------------------------------------------------
+
+def test_baseline_add_expire_round_trip(tmp_path):
+    mod = tmp_path / "mod.py"
+    mod.write_text(_KEY_REUSE.format(name="f"))
+    base = ["--baseline", "b.json"]
+
+    assert _cli(["mod.py"], cwd=str(tmp_path)).returncode == 1
+
+    # adopt the debt
+    wrote = _cli(["mod.py"] + base + ["--write-baseline"],
+                 cwd=str(tmp_path))
+    assert wrote.returncode == 0
+    entries = json.loads((tmp_path / "b.json").read_text())["entries"]
+    assert len(entries) == 1 and entries[0]["rule"] == "DCFM101"
+
+    gated = _cli(["mod.py"] + base, cwd=str(tmp_path))
+    assert gated.returncode == 0
+    assert "clean" in gated.stdout and "1 baselined" in gated.stdout
+
+    # fingerprints are line-number-free: shifting the file keeps the
+    # suppression
+    mod.write_text("# moved\n" + mod.read_text())
+    assert _cli(["mod.py"] + base, cwd=str(tmp_path)).returncode == 0
+
+    # a NEW violation still fails, and is the only one reported
+    mod.write_text(mod.read_text() + "\n\n"
+                   + _KEY_REUSE.format(name="g").split("\n\n\n", 1)[1])
+    newly = _cli(["mod.py"] + base, cwd=str(tmp_path))
+    assert newly.returncode == 1
+    assert newly.stdout.count("DCFM101") == 1
+    assert "1 baselined" in newly.stdout
+
+    # refresh adopts both; deleting the old one leaves a stale entry
+    _cli(["mod.py"] + base + ["--write-baseline"], cwd=str(tmp_path))
+    mod.write_text(_KEY_REUSE.format(name="g"))
+    stale = _cli(["mod.py"] + base, cwd=str(tmp_path))
+    assert stale.returncode == 0
+    assert "stale baseline" in stale.stdout
+
+    # and a refresh shrinks the file back down
+    _cli(["mod.py"] + base + ["--write-baseline"], cwd=str(tmp_path))
+    entries = json.loads((tmp_path / "b.json").read_text())["entries"]
+    assert len(entries) == 1
+
+
+def test_unreadable_baseline_is_a_usage_error(tmp_path):
+    (tmp_path / "mod.py").write_text("x = 1\n")
+    proc = _cli(["mod.py", "--baseline", "missing.json"],
+                cwd=str(tmp_path))
+    assert proc.returncode == 2
+    assert "unreadable baseline" in proc.stderr
+
+
+# ---------------------------------------------------------------------------
+# --changed: PR-diff lints with whole-tree symbol context
+# ---------------------------------------------------------------------------
+
+def test_changed_only_lints_the_diff(tmp_path):
+    env = dict(os.environ, PYTHONPATH=REPO)
+
+    def git(*args):
+        subprocess.run(["git", "-c", "user.email=ci@local",
+                        "-c", "user.name=ci"] + list(args),
+                       cwd=str(tmp_path), check=True,
+                       capture_output=True, env=env)
+
+    (tmp_path / "old.py").write_text(_KEY_REUSE.format(name="f"))
+    git("init", "-q")
+    git("add", "old.py")
+    git("commit", "-qm", "seed")
+    # committed debt is not in the diff; the new untracked file is
+    (tmp_path / "new.py").write_text(_KEY_REUSE.format(name="g"))
+    proc = _cli([".", "--changed"], cwd=str(tmp_path))
+    assert proc.returncode == 1
+    assert "new.py" in proc.stdout and "old.py" not in proc.stdout
+
+
+def test_changed_without_git_is_a_usage_error(tmp_path):
+    (tmp_path / "mod.py").write_text("x = 1\n")
+    env = dict(os.environ, PYTHONPATH=REPO, GIT_DIR=str(tmp_path / "no"),
+               GIT_WORK_TREE=str(tmp_path))
+    proc = subprocess.run(
+        [sys.executable, "-m", "dcfm_tpu.analysis", "mod.py",
+         "--changed"],
+        capture_output=True, text=True, cwd=str(tmp_path), env=env)
+    assert proc.returncode == 2
+    assert "--changed" in proc.stderr
+
+
+# ---------------------------------------------------------------------------
+# SARIF output
+# ---------------------------------------------------------------------------
+
+def test_sarif_output_minimal_schema(tmp_path):
+    (tmp_path / "mod.py").write_text(_KEY_REUSE.format(name="f"))
+    proc = _cli(["mod.py", "--format", "sarif"], cwd=str(tmp_path))
+    assert proc.returncode == 1
+    log = json.loads(proc.stdout)
+    assert log["version"] == "2.1.0"
+    assert "sarif-schema-2.1.0" in log["$schema"]
+    run = log["runs"][0]
+    driver = run["tool"]["driver"]
+    assert driver["name"] == "dcfm-lint"
+    rule_ids = {r["id"] for r in driver["rules"]}
+    assert {"DCFM101", "DCFM1101", "DCFM1201", "DCFM002"} <= rule_ids
+    res = run["results"][0]
+    assert res["ruleId"] == "DCFM101"
+    assert res["level"] == "error"
+    assert res["message"]["text"]
+    loc = res["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"] == "mod.py"
+    assert loc["region"]["startLine"] >= 1
+    assert loc["region"]["startColumn"] >= 1
+
+
+def test_sarif_severity_maps_warning_rules(tmp_path):
+    proc = _cli([os.path.join(FIXTURES, "bad_pragma.py"),
+                 "--format", "sarif"], cwd=REPO)
+    log = json.loads(proc.stdout)
+    levels = {r["ruleId"]: r["level"]
+              for r in log["runs"][0]["results"]}
+    assert levels == {"DCFM002": "warning"}
+
+
+# ---------------------------------------------------------------------------
+# CLI contract: exit codes, severity threshold, broken pipes, README
+# ---------------------------------------------------------------------------
+
+def test_exit_0_on_clean_tree(tmp_path):
+    (tmp_path / "mod.py").write_text("x = 1\n")
+    assert _cli(["mod.py"], cwd=str(tmp_path)).returncode == 0
+
+
+def test_exit_1_on_findings(tmp_path):
+    (tmp_path / "mod.py").write_text(_KEY_REUSE.format(name="f"))
+    assert _cli(["mod.py"], cwd=str(tmp_path)).returncode == 1
+
+
+def test_exit_2_on_nonexistent_path(tmp_path):
+    proc = _cli(["nope_missing.py"], cwd=str(tmp_path))
+    assert proc.returncode == 2
+    assert "no such path" in proc.stderr
+
+
+def test_exit_2_on_bad_flag(tmp_path):
+    assert _cli(["--bogus-flag"], cwd=str(tmp_path)).returncode == 2
+
+
+def test_warning_severity_gates_only_with_fail_on(tmp_path):
+    """DCFM002 is a warning: reported always, fails only under
+    --fail-on warning (what CI passes, so suppression rot gates)."""
+    bad = os.path.join(FIXTURES, "bad_pragma.py")
+    soft = _cli([bad], cwd=REPO)
+    assert soft.returncode == 0
+    assert "DCFM002" in soft.stdout
+    hard = _cli([bad, "--fail-on", "warning"], cwd=REPO)
+    assert hard.returncode == 1
+
+
+def test_broken_pipe_exits_zero(monkeypatch):
+    class _DeadPipe:
+        def write(self, s):
+            raise BrokenPipeError()
+
+        def flush(self):
+            pass
+
+        def fileno(self):
+            raise OSError("no fd")
+
+    monkeypatch.setattr(sys, "stdout", _DeadPipe())
+    rc = main([os.path.join(FIXTURES, "bad_rng.py")])
+    assert rc == 0
+
+
+def test_check_readme_passes_on_shipped_readme():
+    proc = _cli(["--check-readme", "README.md"], cwd=REPO)
+    assert proc.returncode == 0, proc.stderr
+
+
+def test_check_readme_fails_on_drift(tmp_path):
+    text = open(os.path.join(REPO, "README.md"),
+                encoding="utf-8").read()
+    tampered = tmp_path / "README.md"
+    tampered.write_text(text.replace("| DCFM101 |", "| DCFM1xx |"))
+    proc = _cli(["--check-readme", str(tampered)], cwd=str(tmp_path))
+    assert proc.returncode == 1
+    assert "out of date" in proc.stderr
+
+
+def test_check_readme_fails_without_markers(tmp_path):
+    plain = tmp_path / "README.md"
+    plain.write_text("# no markers here\n")
+    proc = _cli(["--check-readme", str(plain)], cwd=str(tmp_path))
+    assert proc.returncode == 1
+    assert "markers" in proc.stderr
+
+
+# ---------------------------------------------------------------------------
+# the committed whole-tree gate (what scripts/ci_check.sh runs)
+# ---------------------------------------------------------------------------
+
+def test_whole_tree_gate_is_clean_against_committed_baseline():
+    proc = _cli([".", "--exclude", "tests/fixtures/lint",
+                 "--baseline", "LINT_BASELINE.json",
+                 "--fail-on", "warning"], cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr

@@ -209,6 +209,45 @@ def test_handler_rule_scoped_to_route_methods():
                    for f in lint_source(src, "mod.py"))
 
 
+def test_bad_locks_fires_1101_1102():
+    assert _rules_fired("bad_locks.py") == {"DCFM1101", "DCFM1102"}
+
+
+def test_bad_locks_names_guard_and_race_site():
+    findings = lint_file(os.path.join(FIXTURES, "bad_locks.py"))
+    race = [f for f in findings if f.rule == "DCFM1101"]
+    abba = [f for f in findings if f.rule == "DCFM1102"]
+    # one finding per attribute, at the first unguarded access
+    assert len(race) == 1
+    assert "self._lock" in race[0].message
+    assert "total" in race[0].message
+    # the inversion is flagged once, at the later of the two orders
+    assert len(abba) == 1
+    assert "ABBA" in abba[0].message
+
+
+def test_bad_lifetime_fires_1201_for_all_three_shipped_shapes():
+    """One finding per historical UAF: PR-1 (loader return into jit),
+    PR-5 (npz page into make_array_from_callback), PR-6 (memmap view
+    into device_put)."""
+    findings = lint_file(os.path.join(FIXTURES, "bad_lifetime.py"))
+    assert {f.rule for f in findings} == {"DCFM1201"}
+    msgs = [f.message for f in findings]
+    assert len(msgs) == 3
+    assert any("loader helper" in m for m in msgs)
+    assert any("make_array_from_callback" in m for m in msgs)
+    assert any("device_put" in m for m in msgs)
+
+
+def test_bad_pragma_fires_002_for_dead_and_unknown():
+    findings = lint_file(os.path.join(FIXTURES, "bad_pragma.py"))
+    assert {f.rule for f in findings} == {"DCFM002"}
+    msgs = [f.message for f in findings]
+    assert len(msgs) == 2
+    assert any("no longer fires" in m for m in msgs)
+    assert any("unknown rule" in m for m in msgs)
+
+
 def test_every_rule_family_has_a_firing_fixture():
     """The registry and the fixtures cannot drift apart: every
     registered rule fires somewhere in the known-bad fixture set."""
@@ -228,7 +267,8 @@ def test_every_rule_family_has_a_firing_fixture():
     "good_rng.py", "good_jit.py", "good_dtype.py", "good_ffi.py",
     "good_thread.py", "good_server.py", "good_robust.py",
     "good_multihost.py", "good_runtime.py", "good_obs.py",
-    "good_handler.py"])
+    "good_handler.py", "good_locks.py", "good_lifetime.py",
+    "good_pragma.py"])
 def test_good_fixture_is_clean(name):
     findings = lint_file(os.path.join(FIXTURES, name))
     assert findings == [], [str(f) for f in findings]
