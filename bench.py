@@ -82,6 +82,125 @@ def _serve_probe(res):
                 "rejected_429": load["rejected_429"]}
 
 
+# Refit-phase probe shape: small on purpose - the warm-vs-cold ratio
+# and the data-to-serving wall are schedule properties, not
+# throughput numbers, so they don't need the north-star shape.
+REFIT_N = int(os.environ.get("BENCH_REFIT_N", 160))
+REFIT_P = int(os.environ.get("BENCH_REFIT_P", 48))
+REFIT_SHARD_W = int(os.environ.get("BENCH_REFIT_SHARD_W", 12))
+REFIT_BURNIN = int(os.environ.get("BENCH_REFIT_BURNIN", 240))
+REFIT_MCMC = int(os.environ.get("BENCH_REFIT_MCMC", 120))
+
+
+def _refit_probe():
+    """Online-loop probe (dcfm_tpu/online): run the real cycle machinery
+    end to end - cold generation 1, append rows, warm refit promoted as
+    generation 2 - against a live PosteriorServer on the promotion
+    root, and measure
+
+    * ``refit_warm_s`` vs ``refit_cold_s``: the warm-started refit
+      (grafted donor state + shortened burn-in) against a cold control
+      fit of the IDENTICAL appended data and full schedule;
+    * ``data_to_serving_s``: appended rows landing on disk -> the first
+      served response whose ``X-DCFM-Artifact-Generation`` header shows
+      the new generation (refit + stream + validate + promote + swap).
+
+    The cold control runs FIRST so any residual XLA compile for the
+    grown-n shape lands on it, not in the warm number (the persistent
+    compile cache set up in main() makes that near-zero on repeat
+    invocations anyway)."""
+    import dataclasses
+
+    from dcfm_tpu.api import fit as _fit
+    from dcfm_tpu.online.cycle import (DATA_FILE, CyclePlan, CycleSettings,
+                                       plan_cycle, read_manifest,
+                                       refit_config, run_cycle)
+    from dcfm_tpu.serve.server import GENERATION_HEADER, PosteriorServer
+
+    rng = np.random.default_rng(3)
+    k_true = 3
+    n_all = REFIT_N + REFIT_N // 4
+    L = rng.standard_normal((REFIT_P, k_true)).astype(np.float32)
+    F = rng.standard_normal((n_all, k_true)).astype(np.float32)
+    Y_all = (F @ L.T
+             + 0.3 * rng.standard_normal((n_all, REFIT_P))).astype(np.float32)
+    with tempfile.TemporaryDirectory() as td:
+        data = os.path.join(td, "data")
+        root = os.path.join(td, "root")
+        for d in (data, root):
+            os.makedirs(d)
+        settings = CycleSettings(
+            root=root, workdir=os.path.join(td, "watch"),
+            factors_per_shard=k_true, rho=0.9, shard_width=REFIT_SHARD_W,
+            burnin=REFIT_BURNIN, mcmc=REFIT_MCMC,
+            warm_burnin=max(1, REFIT_BURNIN // 4), seed=0,
+            supervised=False,        # in-process: the probe times the fit
+            max_drift=10.0)          # drift gating is tests' business
+        os.makedirs(settings.workdir)
+        np.save(os.path.join(data, DATA_FILE), Y_all[:REFIT_N])
+        m1 = read_manifest(data)
+        r1 = run_cycle(settings, Y_all[:REFIT_N],
+                       plan_cycle(settings, None, m1, None))
+
+        # cold control: identical appended data, full schedule, no donor
+        plan_cold = CyclePlan(
+            kind="replaced", manifest=None,
+            num_shards=settings.num_shards(REFIT_P), target_generation=0,
+            candidate="cold-control",
+            checkpoint=os.path.join(td, "cold.ckpt.npz"), warm_from=None)
+        cfg_cold = dataclasses.replace(
+            refit_config(settings, plan_cold),
+            stream_artifact=os.path.join(td, "cold-art"))
+        # Compile warm-up, the headline bench's discipline: one fit per
+        # schedule at the appended shape, because the Gibbs scan length
+        # is part of the jaxpr - the cold and warm schedules compile
+        # SEPARATELY, and without this the warm refit (which runs after
+        # cold) pays a fresh compile that cold already amortized,
+        # flattering the ratio toward 1 at small probe shapes.
+        from dcfm_tpu import FitConfig
+        for bi in (settings.burnin, settings.warm_burnin):
+            _fit(Y_all, FitConfig(
+                model=cfg_cold.model,
+                run=dataclasses.replace(cfg_cold.run, burnin=bi),
+                backend=cfg_cold.backend))
+        t = time.perf_counter()
+        _fit(Y_all, cfg_cold)
+        refit_cold_s = time.perf_counter() - t
+
+        srv = PosteriorServer(root, port=0)
+        srv.start()
+        try:
+            _, _, hdr = srv.handle("/v1/entry", {"i": ["0"], "j": ["1"]})
+            assert hdr[GENERATION_HEADER] == "1", hdr
+            # the appended rows land NOW: the data-to-serving clock runs
+            # until a served response carries the new generation
+            t_data = time.perf_counter()
+            np.save(os.path.join(data, DATA_FILE), Y_all)
+            m2 = read_manifest(data)
+            r2 = run_cycle(settings, Y_all,
+                           plan_cycle(settings, m1, m2, r1.checkpoint))
+            deadline = time.monotonic() + 60.0
+            while True:
+                status, _, hdr = srv.handle(
+                    "/v1/entry", {"i": ["0"], "j": ["1"]})
+                if status == 200 and hdr.get(GENERATION_HEADER) == "2":
+                    break
+                if time.monotonic() > deadline:
+                    raise RuntimeError(
+                        "refit probe: serving generation never flipped "
+                        f"to 2 (last header {hdr})")
+                time.sleep(0.02)
+            data_to_serving_s = time.perf_counter() - t_data
+        finally:
+            srv.close()
+        if not r2.warm:
+            raise RuntimeError("refit probe: generation 2 fell back cold "
+                               "- the warm/cold ratio would be a lie")
+        return {"refit_warm_s": r2.refit_s, "refit_cold_s": refit_cold_s,
+                "warm_cold_ratio": r2.refit_s / max(refit_cold_s, 1e-9),
+                "data_to_serving_s": data_to_serving_s}
+
+
 def main():
     import jax
 
@@ -248,6 +367,11 @@ def main():
     serve_p50 = float(np.median([r["p50_ms"] for r in serve_rounds]))
     serve_p99 = float(np.median([r["p99_ms"] for r in serve_rounds]))
 
+    # Refit-phase probe: the online fit->serve loop's trajectory numbers
+    # (warm-vs-cold refit wall and the appended-data -> new-generation-
+    # served latency), one round at the small probe shape.
+    refit = _refit_probe()
+
     # ESS/s on the chain traces (utils/diagnostics.ess via
     # FitResult.diagnostics): iterations/sec says nothing about MIXING -
     # a sampler change can keep iters/s and halve the information per
@@ -332,6 +456,16 @@ def main():
         "serve_shed": int(sum(r["shed"] for r in serve_rounds)),
         "serve_rejected_429": int(sum(r["rejected_429"]
                                       for r in serve_rounds)),
+        # Online-loop refit phase (dcfm_tpu/online at the small probe
+        # shape): warm-started refit vs a cold control of the identical
+        # appended data (warm grafts the donor state and runs burnin/4,
+        # so the ratio should sit well under 1), and the appended-rows ->
+        # first-response-at-the-new-generation wall as a fleet would see
+        # it (X-DCFM-Artifact-Generation header flip).
+        "refit_warm_s": round(refit["refit_warm_s"], 2),
+        "refit_cold_s": round(refit["refit_cold_s"], 2),
+        "warm_cold_ratio": round(refit["warm_cold_ratio"], 4),
+        "data_to_serving_s": round(refit["data_to_serving_s"], 2),
     }
     print(json.dumps(result))
     # Regression gates - this script exits non-zero so the driver FAILS on
@@ -369,6 +503,21 @@ def main():
     #   (measured 0.54 on this box at PR 6's numbers: exposed 0.274 s of
     #   0.59 s total drain).  Skipped when the stream never engaged
     #   (multi-process, non-quant8, or a no-op resume).
+    # * warm refit: the whole point of the WarmStart seam is that a
+    #   warm refit reaches serving faster than a cold one - a ratio at
+    #   or above 1.0 means the graft or the shortened schedule silently
+    #   stopped paying for itself.  Only gated at the default probe
+    #   schedule: an env-shrunk schedule (e.g. BENCH_REFIT_BURNIN=60)
+    #   saves so few iterations that the fixed graft cost (donor read +
+    #   CRC + device_put) legitimately dominates.
+    default_refit = (REFIT_N, REFIT_P, REFIT_BURNIN, REFIT_MCMC) == (
+        160, 48, 240, 120)
+    if default_refit and refit["warm_cold_ratio"] >= 1.0:
+        print(f"WARM REFIT REGRESSION: warm/cold wall ratio "
+              f"{refit['warm_cold_ratio']:.3f} >= 1.0 "
+              f"(warm {refit['refit_warm_s']:.2f}s, "
+              f"cold {refit['refit_cold_s']:.2f}s)", file=sys.stderr)
+        status = 1
     if (default_shape and stream.get("snapshots", 0) > 0
             and overlap_med is not None and overlap_med <= 0.5):
         print(f"STREAM OVERLAP REGRESSION: median overlap_fraction "

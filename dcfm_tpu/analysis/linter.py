@@ -1267,6 +1267,48 @@ def _check_handlers(mod: _Module, rep: _Reporter) -> None:
 
 
 # =====================================================================
+# DCFM1301 - daemon poll-loop shutdown discipline
+# =====================================================================
+
+def _check_poll_loops(mod: _Module, rep: _Reporter) -> None:
+    """DCFM1301: a constant-condition polling loop (``while True:`` /
+    ``while 1:``) that paces itself with ``time.sleep`` but consults no
+    shutdown signal - no ``break``, no ``return``, and no
+    ``.wait()``/``.is_set()`` event call anywhere in its body.  Such a
+    daemon loop can only be stopped by killing its thread or process:
+    SIGTERM drains nothing, tests leak the thread, and at interpreter
+    teardown it is the DCFM501 SIGABRT class wearing a sleep.  Pace the
+    loop with ``threading.Event.wait(interval)`` and gate each turn on
+    ``.is_set()`` (the watch daemon's idiom), or give it an exit
+    path."""
+    for loop in ast.walk(mod.tree):
+        if not isinstance(loop, ast.While):
+            continue
+        if not (isinstance(loop.test, ast.Constant) and loop.test.value):
+            continue
+        sleeps = False
+        has_exit = bool(loop.orelse)   # while/else implies a break path
+        for n in ast.walk(loop):
+            if isinstance(n, (ast.Break, ast.Return)):
+                has_exit = True
+            elif isinstance(n, ast.Call):
+                if mod.resolve(n.func) == "time.sleep":
+                    sleeps = True
+                elif (isinstance(n.func, ast.Attribute)
+                        and n.func.attr in ("wait", "is_set")):
+                    # an Event consulted or used as the pacer IS the
+                    # shutdown seam this rule wants
+                    has_exit = True
+        if sleeps and not has_exit:
+            rep.emit("DCFM1301", loop,
+                     "constant-true poll loop paces with time.sleep() "
+                     "but consults no shutdown signal (no break/return, "
+                     "no Event .wait()/.is_set()) - it can only be "
+                     "stopped by killing the thread; pace with "
+                     "stop.wait(interval) and check stop.is_set()")
+
+
+# =====================================================================
 # DCFM002 - stale suppressions
 # =====================================================================
 
@@ -1326,6 +1368,7 @@ def lint_source(source: str, path: str = "<string>",
     _check_pipeline(mod, rep)
     _check_obs(mod, rep)
     _check_handlers(mod, rep)
+    _check_poll_loops(mod, rep)
     check_locks(mod, rep, project)
     check_lifetime(mod, rep, project)
     _check_stale_pragmas(mod, rep)      # must stay last: reads the ledger

@@ -193,6 +193,18 @@ RULES = {r.id: r for r in [
          "A) - the classic ABBA deadlock; pick one global order and "
          "acquire in that order everywhere",
          library_only=True),
+    # ---- DCFM13xx: daemon poll-loop discipline -----------------------
+    Rule("DCFM1301", "poll-loop-without-shutdown-check", "daemon",
+         "a constant-condition polling loop (while True/while 1) that "
+         "paces itself with time.sleep() but consults no shutdown "
+         "signal: no break, no return, and no Event .wait()/.is_set() "
+         "anywhere in its body.  The loop can only be stopped by "
+         "killing its thread or process - SIGTERM drains nothing, "
+         "tests leak the thread, and at interpreter teardown it joins "
+         "the DCFM501 SIGABRT class.  Pace with stop.wait(interval) "
+         "and gate each turn on stop.is_set() (the watch daemon's "
+         "idiom), or give the loop an exit path",
+         library_only=True),
     # ---- DCFM12xx: host-buffer lifetime discipline -------------------
     Rule("DCFM1201", "host-buffer-lifetime", "lifetime",
          "a host buffer of numpy provenance (np.load / np.memmap / a "

@@ -508,6 +508,15 @@ def _fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
         m = dataclasses.replace(m, impute_missing=True)
     key = jax.random.key(run.seed)
     k_init, k_chain = jax.random.split(key)
+    if cfg.warm_start is not None:
+        # Warm refits re-lineage the chain streams (fold_in is the
+        # house derivation everywhere - tests/test_rng_lineage.py):
+        # without this, a warm start from a same-seed donor would replay
+        # the donor's exact per-iteration keys against an already-mixed
+        # state.  relineage=0 is refused at validate() for this reason.
+        # k_init stays unlineaged so the cold-fallback chain is exactly
+        # the chain a plain fit(seed) would run.
+        k_chain = jax.random.fold_in(k_chain, cfg.warm_start.relineage)
 
     devices = _resolve_devices(cfg.backend)
     n_mesh = cfg.backend.mesh_devices

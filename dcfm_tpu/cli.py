@@ -58,9 +58,17 @@ def build_parser() -> argparse.ArgumentParser:
         help="summarize a run's flight-recorder event log "
              "(FitResult.events_path / <checkpoint>.obs): launches, "
              "deaths, promoted generations, resume decisions, rewinds, "
-             "injected faults, per-phase walls, stream overlap; "
-             "--trace exports a Chrome/Perfetto trace; see "
-             "`dcfm-tpu events --help`")
+             "injected faults, per-phase walls, stream overlap, online "
+             "watch cycles; --trace exports a Chrome/Perfetto trace; "
+             "see `dcfm-tpu events --help`")
+    sub.add_parser(
+        "watch", add_help=False,
+        help="online fit->serve daemon: poll a data directory (SIGUSR1 "
+             "wakes immediately), refit on appended rows / new shards "
+             "(warm-started from the previous run's checkpoint, "
+             "supervised), and promote each validated artifact "
+             "generation to a serving fleet's promotion root; see "
+             "`dcfm-tpu watch --help`")
 
     # Posterior-serving subsystem (dcfm_tpu/serve; README "Serving the
     # posterior"): export a completed fit to a memory-mapped artifact,
@@ -342,6 +350,11 @@ def main(argv=None) -> int:
         # JSONL event log only, never a checkpoint payload
         from dcfm_tpu.obs.cli import events_main
         return events_main(raw[1:])
+    if raw and raw[0] == "watch":
+        # the daemon's own flags belong to its delegated parser; jax
+        # loads lazily when the first refit actually runs
+        from dcfm_tpu.online.watch import watch_main
+        return watch_main(raw[1:])
     args = build_parser().parse_args(argv)
     if args.command == "fit" and args.supervise:
         # Supervised mode re-runs THIS CLI (minus the supervise flags,

@@ -268,6 +268,40 @@ class BackendConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class WarmStart:
+    """Initialize a fresh chain from a PRIOR run's v6 checkpoint state
+    (the online fit->serve loop, dcfm_tpu/online/; ROADMAP item 3).
+
+    Distinct from resume: resume continues THE SAME run bitwise
+    (checkpoint_compatible refuses on any fingerprint/schedule change),
+    while a warm start seeds a NEW chain - new data fingerprint, new
+    (usually shortened) burn-in, fresh accumulators at iteration 0 -
+    from the previous posterior's state.  Two growth shapes are
+    grafted (runtime/resume.warm_start_carry):
+
+    * appended rows (n grows): Lambda/ps/prior state carry over
+      verbatim; the new rows' latent factors start at the init draw.
+    * new feature shards (g grows): converged shards keep their state
+      bitwise; the new shards' loadings start at the init draw (the
+      packed-panel layout already pads to shard evenly).
+
+    The chain RNG key is re-lineaged via fold_in(k_chain, relineage)
+    in api._fit, so a warm chain never replays the donor's streams;
+    the derivation is deterministic given the config, so a supervised
+    relaunch of the refit resumes consistently.  An incompatible or
+    unreadable donor falls back to a cold start, recorded as a
+    ``warm_start`` flight-recorder event with the reason.
+    """
+
+    # Path to the donor v6 checkpoint (a prior fit's checkpoint_path).
+    checkpoint: str
+    # RNG re-lineage counter folded into the chain key.  Successive
+    # online generations bump it so generation N+2 warm-started from
+    # N+1's posterior does not share streams with N+1's own refit.
+    relineage: int = 1
+
+
+@dataclasses.dataclass(frozen=True)
 class FitConfig:
     model: ModelConfig
     run: RunConfig
@@ -384,6 +418,13 @@ class FitConfig:
     # not "off").  The artifact's bytes are bitwise-identical to a
     # post-hoc ``res.export_artifact`` of the same chain.
     stream_artifact: Optional[str] = None
+    # Warm-start seam (see WarmStart): seed this chain from a prior
+    # run's checkpoint state instead of the cold init.  Resume takes
+    # precedence when both are configured (elastic recovery of the
+    # warm refit itself); the warm graft only runs when no resumable
+    # checkpoint of THIS run exists.  Single-process runs only (the
+    # multi-process path keeps cold init).
+    warm_start: Optional[WarmStart] = None
 
 
 def validate_obs(obs) -> None:
@@ -549,3 +590,13 @@ def validate(cfg: FitConfig, n: int, p: int) -> None:
         raise ValueError(
             f"DL concentration a={m.dl.a} must be in (0, 1] "
             "(1/K <= a <= 1/2 is the usual range)")
+    if cfg.warm_start is not None:
+        ws = cfg.warm_start
+        if not isinstance(ws.checkpoint, str) or not ws.checkpoint:
+            raise ValueError(
+                "warm_start.checkpoint must be a non-empty path to the "
+                "donor run's v6 checkpoint")
+        if not isinstance(ws.relineage, int) or ws.relineage < 1:
+            raise ValueError(
+                f"warm_start.relineage must be an int >= 1, got "
+                f"{ws.relineage!r} (0 would replay the donor's streams)")

@@ -91,6 +91,28 @@ def summarize(run_dir: str, events=None, torn: int = 0) -> dict:
                  "generation": e.get("generation"),
                  "verified": e.get("verified")}
                 for e in by.get("artifact_promote", [])]
+    # online fit->serve loop events (dcfm-tpu watch run dirs)
+    detections = [{"kind": e.get("kind"), "n": e.get("n"),
+                   "p": e.get("p"),
+                   "target_generation": e.get("target_generation")}
+                  for e in by.get("online_detect", [])]
+    online_promos = [{"generation": e.get("generation"),
+                      "kind": e.get("kind"), "warm": e.get("warm"),
+                      "drift": e.get("drift"),
+                      "refit_s": e.get("refit_s"),
+                      "cycle_s": e.get("cycle_s")}
+                     for e in by.get("online_promote", [])]
+    online_refusals = [{"stage": e.get("stage"),
+                        "reason": e.get("reason"),
+                        "kind": e.get("kind"),
+                        "generation": e.get("generation")}
+                       for e in by.get("online_refused", [])]
+    warm_starts = [{"decision": e.get("decision"),
+                    "reason": e.get("reason"),
+                    "verbatim_leaves": e.get("verbatim_leaves"),
+                    "leaves": e.get("leaves"),
+                    "relineage": e.get("relineage")}
+                   for e in by.get("warm_start", [])]
     return {
         "run_dir": run_dir,
         "events": len(events),
@@ -125,6 +147,13 @@ def summarize(run_dir: str, events=None, torn: int = 0) -> dict:
         "fleet_poisoned": bool(by.get("fleet_poisoned")),
         "fleet_watchdog_fired": bool(by.get("fleet_watchdog_fired")),
         "fleet_drained": bool(by.get("fleet_drained")),
+        "online_detections": detections,
+        "online_refits": len(by.get("online_refit", [])),
+        "online_promotions": online_promos,
+        "online_refusals": online_refusals,
+        "warm_starts": warm_starts,
+        "watch_cycles": (by["watch_stop"][-1].get("cycles")
+                         if by.get("watch_stop") else None),
     }
 
 
@@ -206,6 +235,32 @@ def _print_summary(s: dict, out: List[str]) -> None:
     if s["serve_client_aborts"]:
         out.append(f"client aborts/timeouts shed: "
                    f"{s['serve_client_aborts']}")
+    if s["online_detections"]:
+        out.append(f"online detections: {len(s['online_detections'])}  "
+                   f"refits: {s['online_refits']}  "
+                   f"promotions: {len(s['online_promotions'])}  "
+                   f"refusals: {len(s['online_refusals'])}")
+        for d in s["online_detections"]:
+            out.append(f"  detected {d['kind']}: n={d['n']} p={d['p']} "
+                       f"-> generation {d['target_generation']}")
+    for w in s["warm_starts"]:
+        if w["decision"] == "warm":
+            out.append(f"warm start: {w['verbatim_leaves']}/{w['leaves']} "
+                       f"leaves verbatim (relineage "
+                       f"{w['relineage']})")
+        else:
+            out.append(f"warm start fell back COLD: {w['reason']}")
+    for p in s["online_promotions"]:
+        out.append(f"online promotion: generation {p['generation']} "
+                   f"({p['kind']}, {'warm' if p['warm'] else 'cold'}, "
+                   f"drift {p['drift']}, refit {p['refit_s']}s, "
+                   f"data-to-serving {p['cycle_s']}s)")
+    for r in s["online_refusals"]:
+        out.append(f"online cycle REFUSED at {r['stage']} (old artifact "
+                   f"kept serving): {r['reason']}")
+    if s["watch_cycles"] is not None:
+        out.append(f"watch daemon promoted {s['watch_cycles']} "
+                   f"cycle(s) before stopping")
     if s["fleet_poisoned"]:
         out.append("FLEET POISONED: repeated instant worker deaths")
     if s["fleet_watchdog_fired"]:

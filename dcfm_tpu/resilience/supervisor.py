@@ -121,11 +121,12 @@ def _log(msg: str) -> None:
     print(f"[supervise] {msg}", file=sys.stderr, flush=True)  # dcfm: ignore[DCFM901] - the supervisor's documented stderr mirror
 
 
-def _postmortem(obs_dir: Optional[str], launch: int) -> str:
-    """Last-events suffix for the typed supervision errors: a poison or
-    hang report should name the flight-recorder path and what the dead
-    launch last did, so triage starts from evidence instead of from a
-    checkpoint-payload walk."""
+def postmortem(obs_dir: Optional[str], launch: Optional[int] = None) -> str:
+    """Last-events suffix for typed operational errors: a poison, hang,
+    or refused-cycle report should name the flight-recorder path and
+    what the dying run last did, so triage starts from evidence instead
+    of from a checkpoint-payload walk.  ``launch=None`` tails the whole
+    run (the online watch daemon's errors aren't launch-scoped)."""
     if not obs_dir:
         return ""
     suffix = f"; flight recorder: {obs_dir}"
@@ -142,8 +143,14 @@ def _postmortem(obs_dir: Optional[str], launch: int) -> str:
         if it is not None:
             s += f"@it{it}"
         brief.append(s)
-    return (f"{suffix} (last {len(evs)} events of launch {launch}: "
+    scope = "run" if launch is None else f"launch {launch}"
+    return (f"{suffix} (last {len(evs)} events of {scope}: "
             + ", ".join(brief) + ")")
+
+
+# historical private name; the supervision loop and its tests predate the
+# online loop making this a shared seam
+_postmortem = postmortem
 
 
 def _checkpoint_slots(path: str) -> list:

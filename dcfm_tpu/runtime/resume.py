@@ -35,9 +35,10 @@ from dcfm_tpu.models.sampler import num_saved_draws
 from dcfm_tpu.obs.recorder import record
 from dcfm_tpu.resilience.faults import fault_event
 from dcfm_tpu.utils.checkpoint import (
-    checkpoint_compatible, discover_checkpoint, load_checkpoint,
-    load_checkpoint_multiprocess, load_checkpoint_resharded, proc_path,
-    read_checkpoint_meta, retained_checkpoints)
+    _verify_crc, checkpoint_compatible, config_from_checkpoint_meta,
+    discover_checkpoint, load_checkpoint, load_checkpoint_multiprocess,
+    load_checkpoint_resharded, proc_path, read_checkpoint_meta,
+    retained_checkpoints)
 
 
 @dataclasses.dataclass
@@ -150,6 +151,116 @@ def _try_full_sidecar(ctx: ResumeContext, template, light_kept: int):
         return None
 
 
+def _warm_incompatible(meta: dict, cfg: FitConfig) -> Optional[str]:
+    """Why the donor checkpoint cannot seed this run's chain, or None.
+
+    Deliberately LOOSER than :func:`checkpoint_compatible` - that gate
+    protects a mid-run resume (same run, same data, same schedule); a
+    warm start is a NEW run whose data grew, so seed/burnin/thin/
+    fingerprint are all allowed to differ.  What must hold is the graft
+    geometry: same chain count (state leaves carry a leading C axis)
+    and the same model up to ``num_shards`` (the one model field that
+    grows when a new feature shard arrives - K, prior family, and the
+    adapt schedule shape the state pytree itself)."""
+    if int(meta["version"]) != 6:
+        return (f"donor checkpoint is format v{meta['version']}, "
+                "warm start requires v6")
+    old = config_from_checkpoint_meta(meta)
+    if old.run.num_chains != cfg.run.num_chains:
+        return (f"donor ran {old.run.num_chains} chains, this run "
+                f"{cfg.run.num_chains} - the state graft is per-chain")
+    if dataclasses.replace(old.model,
+                           num_shards=cfg.model.num_shards) != cfg.model:
+        return ("donor model config differs beyond num_shards - the "
+                "state pytrees are not graft-compatible")
+    return None
+
+
+def _graft_state_leaf(old: np.ndarray, fresh) -> np.ndarray:
+    """Graft one donor state leaf into its fresh-init counterpart.
+
+    Identical shapes -> the donor bytes verbatim (the bitwise contract
+    the new-shard first-draw parity test pins).  Fresh leaf grew along
+    some axes (appended rows grow n, a new shard grows Gl) -> copy the
+    donor into the origin block and keep the fresh prior init in the
+    grown region - exactly the "new shard initializes from the prior,
+    converged shards keep their state" semantics.  Shrunk or
+    reshaped-beyond-recognition leaves raise; the caller turns that
+    into a recorded cold fallback."""
+    f_shape = tuple(np.shape(fresh))
+    dtype = np.dtype(fresh.dtype)
+    if old.shape == f_shape:
+        return np.asarray(old, dtype=dtype)  # dcfm: ignore[DCFM801] - donor npz bytes already on host, not a device fetch
+    if (old.ndim != len(f_shape)
+            or any(o > f for o, f in zip(old.shape, f_shape))):
+        raise ValueError(
+            f"donor state leaf {old.shape} does not embed in fresh "
+            f"{f_shape} - data shrank or layout changed")
+    out = np.array(fresh, dtype=dtype)  # dcfm: ignore[DCFM801] - one-time pre-chain fetch of the fresh init leaf; nothing to overlap with yet
+    out[tuple(slice(0, s) for s in old.shape)] = old.astype(dtype)
+    return out
+
+
+def _try_warm_start(ctx: ResumeContext, init_fn, Yd):
+    """The WarmStart seam (config.WarmStart; the online fit->serve
+    loop).  -> (carry, 0, 0) seeded from the donor run's checkpointed
+    SamplerState, or None for the cold fallback - never raises.
+
+    Only the STATE grafts: accumulators, iteration, and health start
+    fresh (this is a new run over new data; the donor's Sigma sums
+    average a different posterior).  State leaves are the first
+    ``len(leaves(state))`` entries of the checkpoint payload in both
+    full and state-only files (ChainCarry puts ``state`` first and
+    ``_slim`` only drops accumulator fields), each CRC-verified on its
+    raw stored bytes before grafting.  Donor Lambda must agree on
+    (P, K) - per-shard feature width and rank are graft axes nobody
+    grows; n (rows) and Gl (shards) may."""
+    cfg, ws = ctx.cfg, ctx.cfg.warm_start
+    try:
+        meta = read_checkpoint_meta(ws.checkpoint)
+        reason = _warm_incompatible(meta, cfg)
+        if reason is not None:
+            record("warm_start", decision="cold", reason=reason,
+                   checkpoint=ws.checkpoint)
+            return None
+        fresh = init_fn(ctx.k_init, Yd)
+        s_leaves, s_def = jax.tree.flatten(fresh.state)
+        grafted, verbatim = [], 0
+        with np.load(ws.checkpoint) as z:
+            # donor Lambda is leaf_0: refuse up front if the per-shard
+            # feature width or rank moved (those axes never graft)
+            lam = z["leaf_0"]
+            if (lam.ndim != np.ndim(s_leaves[0])
+                    or lam.shape[-2:] != tuple(
+                        np.shape(s_leaves[0]))[-2:]):
+                record("warm_start", decision="cold",
+                       reason=(f"donor Lambda {lam.shape} vs fresh "
+                               f"{np.shape(s_leaves[0])}: per-shard "
+                               "feature width / rank mismatch"),
+                       checkpoint=ws.checkpoint)
+                return None
+            for i, fl in enumerate(s_leaves):
+                name = f"leaf_{i}"
+                arr = z[name]
+                _verify_crc(meta, name, arr, ws.checkpoint)
+                g = _graft_state_leaf(arr, fl)
+                verbatim += int(arr.shape == tuple(np.shape(fl)))
+                grafted.append(jax.device_put(g, fl.sharding))
+        state = jax.tree.unflatten(s_def, grafted)
+        record("warm_start", decision="warm", checkpoint=ws.checkpoint,
+               donor_iteration=int(meta["iteration"]),
+               relineage=ws.relineage, leaves=len(grafted),
+               verbatim_leaves=verbatim)
+        return fresh._replace(state=state), 0, 0
+    except Exception as e:
+        # warm start is best-effort by contract: any failure becomes a
+        # recorded cold fallback (the reason lands in the event)
+        record("warm_start", decision="cold",
+               reason=f"{type(e).__name__}: {e}",
+               checkpoint=ws.checkpoint)
+        return None
+
+
 def resume_state(ctx: ResumeContext, init_fn, Yd):
     """-> (carry, done, acc_start).  resume=True demands a compatible
     checkpoint; resume="auto" (elastic recovery) falls back to a fresh
@@ -247,6 +358,14 @@ def resume_state(ctx: ResumeContext, init_fn, Yd):
         raise FileNotFoundError(
             f"resume=True but no checkpoint at {cfg.checkpoint_path} "
             "(or any .procK-of-N set)")
+    # The WarmStart seam sits strictly BELOW resume: a crash-relaunch of
+    # a warm refit must resume its own checkpoint (re-grafting the donor
+    # would discard the refit's progress); only a genuinely fresh start
+    # consults the donor, and any warm failure falls through to cold.
+    if cfg.warm_start is not None:
+        warm = _try_warm_start(ctx, init_fn, Yd)
+        if warm is not None:
+            return warm
     record("resume_decision", decision="fresh", iteration=0, acc_start=0)
     return init_fn(ctx.k_init, Yd), 0, 0
 
@@ -462,6 +581,13 @@ def resume_state_multiproc(ctx: ResumeContext, init_fn, Yd):
             loaded[0])
     if carry0 is None:   # init was freed for a load that was discarded
         carry0 = init_fn(ctx.k_init, Yd)
+    if cfg.warm_start is not None:
+        # Multi-host SPMD runs never warm-start: the graft is a host-side
+        # numpy splice with no collective agreement story.  Recorded, not
+        # silent - the online loop reads this as "refit went cold".
+        record("warm_start", decision="cold",
+               reason="multi-process runs never warm-start",
+               checkpoint=cfg.warm_start.checkpoint)
     record("resume_decision", decision="fresh", iteration=0, acc_start=0)
     return carry0, 0, 0
 

@@ -59,9 +59,14 @@ class PanelCache:
         self.hits = 0
         self.misses = 0
         self.evictions = 0
+        # per-key touch counts, surviving eviction: the hot-set the
+        # pre-warmer replays into the NEXT generation's engine (a
+        # promotion must not reset the cache cold under load)
+        self._touch: "collections.Counter" = collections.Counter()
 
     def get(self, key, make):
         with self._lock:
+            self._touch[key] += 1
             panel = self._od.get(key)
             if panel is not None:
                 self.hits += 1
@@ -91,6 +96,12 @@ class PanelCache:
                     "evictions": self.evictions, "panels": len(self._od),
                     "bytes": self._bytes,
                     "budget_bytes": self.budget_bytes}
+
+    def hot_keys(self, limit: int) -> list:
+        """The ``limit`` most-touched keys, hottest first - the hit/miss
+        counters aggregated per key, including keys since evicted."""
+        with self._lock:
+            return [k for k, _ in self._touch.most_common(int(limit))]
 
 
 def _norm_ppf(p: float) -> float:
@@ -293,3 +304,33 @@ class QueryEngine:
 
     def stats(self) -> dict:
         return self.cache.stats()
+
+    # -- hot-set pre-warming -------------------------------------------
+    def hot_panels(self, limit: int = 64) -> list:
+        """The hottest ``(kind, pair)`` keys by touch count, hottest
+        first - what the server persists per generation and replays
+        into the next generation's engine at swap time."""
+        return self.cache.hot_keys(limit)
+
+    def prewarm(self, keys) -> int:
+        """Dequantize the given ``(kind, pair)`` keys into the cache
+        (coldest first, so the hottest land last and sit at the LRU's
+        warm end).  Unknown kinds and out-of-range pairs are skipped -
+        a hot set recorded against a previous generation may name
+        panels the new artifact does not have.  Returns the number of
+        panels now resident."""
+        warmed = 0
+        for kind, pair in reversed(list(keys)):
+            kind, pair = str(kind), int(pair)
+            if kind not in self._factor:
+                continue
+            raw, _ = self.artifact.panels(kind)
+            if not 0 <= pair < raw.shape[0]:
+                continue
+            g = self._g
+            # pair is on the triu grid; diagonal pairs are the ones
+            # whose panel index matches _pair(r, r) for some shard r
+            diag = any(self._pair(r, r) == pair for r in range(g))
+            self._panel(kind, pair, diag)
+            warmed += 1
+        return warmed

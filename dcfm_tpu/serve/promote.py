@@ -46,6 +46,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
+from typing import Optional
 
 from dcfm_tpu.obs.recorder import record
 from dcfm_tpu.resilience.faults import fault_event, fault_plan
@@ -127,7 +128,9 @@ def verify_candidate(path: str) -> PosteriorArtifact:
 
 
 def promote_artifact(root: str, candidate: str, *,
-                     verify: bool = True) -> PointerState:
+                     verify: bool = True,
+                     expect_generation: Optional[int] = None
+                     ) -> PointerState:
     """Atomically point ``root/CURRENT`` at ``candidate`` (a directory
     name inside the root, or a path to one).  Returns the new
     :class:`PointerState`; the generation is the previous pointer's + 1
@@ -136,7 +139,14 @@ def promote_artifact(root: str, candidate: str, *,
     ``verify=True`` (default) runs :func:`verify_candidate` first and
     raises instead of promoting a corrupt candidate.  ``verify=False``
     writes the pointer regardless - the chaos harness's buggy-promoter
-    model; every serving worker still refuses independently."""
+    model; every serving worker still refuses independently.
+
+    ``expect_generation`` is the online loop's monotonicity gate: the
+    promotion proceeds only if the generation it WOULD write equals
+    this value.  A cycle computes its target generation at detect time;
+    if another promoter (or a crashed-and-resumed twin of this cycle)
+    moved the pointer meanwhile, writing would re-number history - the
+    typed :class:`ArtifactError` makes the cycle re-detect instead."""
     name = (os.path.relpath(candidate, root) if os.path.isabs(candidate)
             else candidate)
     cand_path = os.path.join(root, name)
@@ -155,6 +165,11 @@ def promote_artifact(root: str, candidate: str, *,
         generation = read_pointer(root).generation + 1
     except PointerError:
         generation = 1
+    if expect_generation is not None and generation != expect_generation:
+        raise ArtifactError(
+            f"{root}: promotion would write generation {generation}, "
+            f"caller expected {expect_generation} - the pointer moved "
+            "since this cycle detected; refusing to re-number history")
     ppath = os.path.join(root, POINTER_FILE)
     plan = fault_plan()
     count = plan.on_write("pointer", ppath) if plan is not None else 0

@@ -89,6 +89,39 @@ from dcfm_tpu.serve.promote import (
 
 MAX_BLOCK_ENTRIES = 1 << 20       # 4 MB of float32 per response, maximum
 GENERATION_HEADER = "X-DCFM-Artifact-Generation"
+# hot-set pre-warmer (online loop): how many of the previous engine's
+# hottest panels are dequantized into a new engine before it serves
+PREWARM_LIMIT = 64
+HOTSET_SUFFIX = ".hotset.json"
+
+
+def _hotset_path(artifact_path: str) -> str:
+    """The hot-set file lives BESIDE the artifact directory (one per
+    generation, e.g. ``root/v2.hotset.json``) - never inside it, where
+    an extra file would muddy the finalized, CRC-recorded layout."""
+    return artifact_path.rstrip(os.sep) + HOTSET_SUFFIX
+
+
+def _load_hotset(artifact_path: str) -> list:
+    """Persisted hot set -> [(kind, pair), ...]; absent/torn -> []."""
+    try:
+        with open(_hotset_path(artifact_path), "r", encoding="utf-8") as f:
+            return [(str(k), int(p)) for k, p in json.load(f)]
+    except (OSError, ValueError, TypeError):
+        return []
+
+
+def _save_hotset(artifact_path: str, keys: list) -> None:
+    """Best-effort tmp+replace write (a torn hot set only costs a cold
+    cache, never a wrong answer)."""
+    path = _hotset_path(artifact_path)
+    try:
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump([[str(k), int(p)] for k, p in keys], f)
+        os.replace(tmp, path)
+    except OSError:
+        pass
 
 
 class _BadRequest(ValueError):
@@ -284,6 +317,10 @@ class PosteriorServer:
         # progress gauges ride the same scrape.
         self.metrics = obs_metrics.MetricsRegistry()
         engine = QueryEngine(artifact, cache_bytes=self._cache_bytes)
+        # pre-warm from this generation's persisted hot set (written by
+        # the worker that served it last): a restarted worker answers
+        # its first requests from warm panels instead of dequant misses
+        self._prewarmed = engine.prewarm(_load_hotset(artifact.path))
         # bind BEFORE starting the batcher's non-daemon worker: a bind
         # failure (port in use) must raise out of __init__ with no
         # orphaned thread keeping the process alive past the traceback
@@ -359,6 +396,10 @@ class PosteriorServer:
         g("dcfm_serve_shedding",
           "1 while the expensive routes are being shed"
           ).set_function(lambda: float(self._shedding))
+        g("dcfm_serve_prewarm_panels",
+          "panels pre-dequantized into the serving engine at its "
+          "construction or last hot-swap (hot-set pre-warmer)"
+          ).set_function(lambda: float(self._prewarmed))
         # one stats() sample is shared by every per-stat series of a
         # scrape (the registry reads series sequentially): without the
         # short-lived memo each exposition would call engine.stats() /
@@ -556,6 +597,15 @@ class PosteriorServer:
             self._ptr_stat = key
             return
         engine = QueryEngine(art, cache_bytes=self._cache_bytes)
+        # hot-set pre-warmer: replay the OLD engine's hottest panels
+        # into the new engine BEFORE the flip, so a promotion under
+        # load does not reset the cache cold (the panel grid only grows
+        # across generations; keys past the new grid are skipped).  The
+        # set is persisted beside the new artifact so a restarted
+        # worker on this generation warms the same way.
+        hot = old.engine.hot_panels(PREWARM_LIMIT) or _load_hotset(art.path)
+        _save_hotset(art.path, hot)
+        self._prewarmed = engine.prewarm(hot)
         batcher = QueryBatcher(engine, max_queue=self._max_queue,
                                max_batch=self._max_batch,
                                default_timeout=self._request_timeout,
@@ -567,7 +617,9 @@ class PosteriorServer:
         self._swaps.inc()
         record("serve_swap", generation=generation,
                from_generation=old.generation,
-               fingerprint=art.fingerprint, worker=self.worker_index)
+               fingerprint=art.fingerprint,
+               prewarm_panels=self._prewarmed,
+               worker=self.worker_index)
         # drain in-flight requests on the OLD engine: close() serves
         # everything already queued before joining the worker, so the
         # swap drops zero requests
@@ -827,6 +879,11 @@ class PosteriorServer:
         # close the current one - otherwise its successor would leak
         with self._swap_lock:
             self.batcher.close()
+        # persist this generation's hot set beside its artifact so a
+        # restarted worker pre-warms from the traffic just served
+        hot = self.engine.hot_panels(PREWARM_LIMIT)
+        if hot:
+            _save_hotset(self.artifact.path, hot)
 
     def run(self) -> None:
         """Serve until SIGTERM/SIGINT, then drain gracefully.

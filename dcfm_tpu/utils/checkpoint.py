@@ -38,7 +38,7 @@ import numpy as np
 
 from dcfm_tpu.config import (
     AdaptConfig, BackendConfig, DLConfig, FitConfig, HorseshoeConfig,
-    MGPConfig, ModelConfig, RunConfig)
+    MGPConfig, ModelConfig, RunConfig, WarmStart)
 from dcfm_tpu.obs.recorder import record
 from dcfm_tpu.resilience.faults import fault_plan
 
@@ -216,6 +216,12 @@ def _config_from_json(d: dict) -> FitConfig:
         sentinel_max_rewinds=d.get("sentinel_max_rewinds", 3),
         obs=d.get("obs", "auto"),
         stream_artifact=d.get("stream_artifact"),
+        # .get: checkpoints written before the online loop carry no
+        # 'warm_start' key; a refit's own checkpoint round-trips its
+        # WarmStart so a supervised relaunch re-derives the same
+        # re-lineaged chain key.
+        warm_start=(WarmStart(**d["warm_start"])
+                    if d.get("warm_start") else None),
     )
 
 

@@ -1,0 +1,18 @@
+"""Known-bad daemon poll loops: DCFM1301 must fire (both spellings)."""
+import time
+
+
+def watch_forever(check):
+    # DCFM1301: constant-true loop paced by time.sleep with no
+    # shutdown signal anywhere - only SIGKILL stops this daemon
+    while True:
+        check()
+        time.sleep(5.0)
+
+
+def poll_with_numeric_true(check):
+    # DCFM1301: `while 1` is the same loop wearing an int
+    while 1:
+        if check():
+            continue
+        time.sleep(0.5)

@@ -1,0 +1,33 @@
+"""Known-good daemon poll loops: every loop consults a shutdown seam."""
+import threading
+import time
+
+
+def watch_until_stopped(check, stop: threading.Event, interval: float):
+    # the watch daemon's idiom: the Event paces the poll AND is the
+    # shutdown signal - SIGTERM sets it and the loop drains
+    while not stop.is_set():
+        check()
+        stop.wait(interval)
+
+
+def poll_with_event_pacer(check, stop: threading.Event):
+    # constant-true spelling is fine when the body consults the Event
+    while True:
+        if stop.wait(1.0):
+            return
+        check()
+
+
+def bounded_retry(check):
+    # an exit path (return) makes a sleep-paced loop a retry loop,
+    # not an unkillable daemon
+    while True:
+        if check():
+            return True
+        time.sleep(0.1)
+
+
+def sleep_outside_any_loop():
+    # a bare sleep is pacing, not a daemon loop
+    time.sleep(0.01)

@@ -209,6 +209,28 @@ def test_handler_rule_scoped_to_route_methods():
                    for f in lint_source(src, "mod.py"))
 
 
+def test_bad_poll_fires_1301():
+    assert _rules_fired("bad_poll.py") == {"DCFM1301"}
+
+
+def test_bad_poll_flags_both_constant_spellings():
+    findings = lint_file(os.path.join(FIXTURES, "bad_poll.py"))
+    # `while True` and `while 1`, one finding each
+    assert len([f for f in findings if f.rule == "DCFM1301"]) == 2
+
+
+def test_poll_rule_skips_variable_condition_loops():
+    """DCFM1301 only polices constant-true loops: a loop gated on any
+    expression already has a shutdown seam to flip."""
+    src = ("import time\n"
+           "def f(running, check):\n"
+           "    while running:\n"
+           "        check()\n"
+           "        time.sleep(1.0)\n")
+    assert not any(f.rule == "DCFM1301"
+                   for f in lint_source(src, "mod.py"))
+
+
 def test_bad_locks_fires_1101_1102():
     assert _rules_fired("bad_locks.py") == {"DCFM1101", "DCFM1102"}
 
@@ -268,7 +290,7 @@ def test_every_rule_family_has_a_firing_fixture():
     "good_thread.py", "good_server.py", "good_robust.py",
     "good_multihost.py", "good_runtime.py", "good_obs.py",
     "good_handler.py", "good_locks.py", "good_lifetime.py",
-    "good_pragma.py"])
+    "good_pragma.py", "good_poll.py"])
 def test_good_fixture_is_clean(name):
     findings = lint_file(os.path.join(FIXTURES, name))
     assert findings == [], [str(f) for f in findings]

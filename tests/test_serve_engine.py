@@ -339,3 +339,34 @@ def test_artifact_without_crcs_serves_unverified(served, tmp_path):
     from dcfm_tpu.serve.artifact import PosteriorArtifact
     eng = QueryEngine(PosteriorArtifact.open(dst))
     assert eng.entry(5, 7) == np.float32(refs[(True, "mean")][5, 7])
+
+
+def test_hot_panels_and_prewarm_transfer_cache_heat(served):
+    """The hot-set pre-warmer's engine half: touch counts rank panels
+    hottest-first, prewarm() replays them into a COLD engine so its
+    first queries hit instead of dequantizing, and stale keys from an
+    older generation's grid are skipped, not crashed on."""
+    art, refs = served
+    hot_eng = QueryEngine(art, cache_bytes=4 << 20)
+    c0, c1 = _caller_in_shard(art, 0), _caller_in_shard(art, 1)
+    # skew the traffic: shard 0's diagonal panel is by far the hottest
+    for _ in range(10):
+        hot_eng.entry(c0, c0)              # panel ("mean", 0)
+    hot_eng.entry(c1, c1)                  # panel ("mean", 2), once
+    hot = hot_eng.hot_panels(8)
+    assert hot == [("mean", 0), ("mean", 2)]   # hottest first
+
+    cold = QueryEngine(art, cache_bytes=4 << 20)
+    warmed = cold.prewarm(hot)
+    assert warmed == len(hot)
+    s = cold.stats()
+    assert s["panels"] == len(hot)          # resident before any query
+    misses_after_warm = s["misses"]
+    # the prewarmed panel now serves from cache: hits, no new misses,
+    # and the value is still the bitwise offline reference
+    assert cold.entry(c0, c0) == np.float32(refs[(True, "mean")][c0, c0])
+    s2 = cold.stats()
+    assert s2["hits"] >= 1 and s2["misses"] == misses_after_warm
+
+    # keys beyond this artifact's grid (older/newer generation) skip
+    assert cold.prewarm([("mean", 99), ("nope", 0)]) == 0

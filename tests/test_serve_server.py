@@ -284,3 +284,40 @@ def test_degraded_mode_serves_identical_values(art):
         if proc.poll() is None:
             proc.kill()
             proc.communicate()
+
+
+def test_close_persists_hotset_and_restart_prewarms(art, tmp_path):
+    """A worker's close() writes this generation's hot set beside its
+    artifact; a restarted worker on the same artifact pre-warms from it
+    - first queries after a restart hit the cache instead of paying
+    dequantizes.  (The swap-time half of the pre-warmer is pinned in
+    test_serve_fleet.)"""
+    import shutil
+
+    from dcfm_tpu.serve.server import _hotset_path
+
+    a, ref, _ = art
+    path = str(tmp_path / "art")      # private copy: the hotset file
+    shutil.copytree(a.path, path)     # lands beside the artifact
+    srv = PosteriorServer(path, port=0)
+    srv.start()
+    try:
+        assert srv._prewarmed == 0    # nothing persisted yet
+        for _ in range(5):
+            srv.handle("/v1/entry", {"i": ["0"], "j": ["1"]})
+    finally:
+        srv.close()
+    assert os.path.exists(_hotset_path(path))
+
+    srv2 = PosteriorServer(path, port=0)
+    srv2.start()
+    try:
+        assert srv2._prewarmed >= 1
+        before = srv2.engine.stats()
+        st, e, _ = srv2.handle("/v1/entry", {"i": ["0"], "j": ["1"]})
+        assert st == 200
+        assert np.float32(e["value"]) == np.float32(ref[0, 1])
+        after = srv2.engine.stats()
+        assert after["misses"] == before["misses"]   # served warm
+    finally:
+        srv2.close()
