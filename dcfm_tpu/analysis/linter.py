@@ -1309,6 +1309,69 @@ def _check_poll_loops(mod: _Module, rep: _Reporter) -> None:
 
 
 # =====================================================================
+# DCFM1401 - chain-axis reduction discipline
+# =====================================================================
+
+def _chain_name(node: ast.AST) -> bool:
+    """A Name (or simple attribute access on one) whose identifier
+    declares chain-major provenance."""
+    if isinstance(node, ast.Name):
+        return "chain" in node.id.lower()
+    if isinstance(node, ast.Attribute):
+        return "chain" in node.attr.lower()
+    return False
+
+
+def _bare_axis0(call: ast.Call) -> bool:
+    """True when the reduction collapses the leading axis implicitly:
+    no axis argument at all, or a bare literal ``axis=0``.  An axis
+    spelled any other way (a named constant, a non-zero index, a tuple)
+    counts as the author naming the axis deliberately."""
+    for kw in call.keywords:
+        if kw.arg == "axis":
+            return (isinstance(kw.value, ast.Constant)
+                    and kw.value.value == 0)
+    return True
+
+
+def _check_chain_reductions(mod: _Module, rep: _Reporter) -> None:
+    """DCFM1401: a host reduction over a chain-major array without the
+    chain axis named.  Trace blocks, pooled Sigma, and draws are ALWAYS
+    chain-major (single-chain runs carry a length-1 leading axis), so a
+    bare ``.mean(axis=0)`` on a name containing 'chain' conflates
+    'average over chains' with 'average over draws'.  Functions whose
+    own name contains 'chain' (pool_chains, _pool_chain_axis) ARE the
+    sanctioned seam and are skipped."""
+
+    def visit(node: ast.AST, in_chain_fn: bool) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                visit(child, in_chain_fn
+                      or "chain" in child.name.lower())
+                continue
+            if isinstance(child, ast.Call) and not in_chain_fn:
+                target = None
+                fn = mod.resolve(child.func)
+                if fn in ("numpy.mean", "numpy.sum") and child.args:
+                    target = child.args[0]
+                elif (isinstance(child.func, ast.Attribute)
+                        and child.func.attr in ("mean", "sum")):
+                    target = child.func.value
+                if (target is not None and _chain_name(target)
+                        and _bare_axis0(child)):
+                    rep.emit(
+                        "DCFM1401", child,
+                        "host reduction over a chain-major array "
+                        "collapses the leading chain axis implicitly "
+                        "(bare axis=0 / no axis) - pool through "
+                        "pool_chains()/_pool_chain_axis() or name the "
+                        "chain axis in the reducing helper")
+            visit(child, in_chain_fn)
+
+    visit(mod.tree, False)
+
+
+# =====================================================================
 # DCFM002 - stale suppressions
 # =====================================================================
 
@@ -1371,6 +1434,7 @@ def lint_source(source: str, path: str = "<string>",
     _check_poll_loops(mod, rep)
     check_locks(mod, rep, project)
     check_lifetime(mod, rep, project)
+    _check_chain_reductions(mod, rep)
     _check_stale_pragmas(mod, rep)      # must stay last: reads the ledger
     rep.findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return rep.findings

@@ -218,4 +218,18 @@ RULES = {r.id: r for r in [
          "_owned_copy_jit / _copy_tree / np.ascontiguousarray while "
          "the source is still alive",
          library_only=True),
+    # ---- DCFM14xx: chain-axis reduction discipline -------------------
+    Rule("DCFM1401", "chain-axis-silent-reduction", "chains",
+         "a host-side reduction (np.mean/np.sum or .mean()/.sum()) "
+         "over a chain-major array (a name containing 'chain') "
+         "collapses the leading chain axis implicitly - bare axis=0 or "
+         "no axis at all.  Trace blocks, pooled Sigma, and draws are "
+         "ALWAYS chain-major (a single-chain run carries a length-1 "
+         "leading axis), so an ad-hoc axis-0 mean silently conflates "
+         "'average over chains' with 'average over draws' and breaks "
+         "the moment num_chains changes.  Pool through the named seam "
+         "(runtime.fetch.pool_chains / utils.estimate._pool_chain_axis) "
+         "or put 'chain' in the reducing helper's own name so the "
+         "intent is explicit",
+         library_only=True),
 ]}

@@ -44,12 +44,13 @@ from dcfm_tpu.models.sampler import (
     TRACE_SUMMARIES, ChainStats, chain_keys, effective_ranks, init_chain,
     run_chunk, schedule_array)
 from dcfm_tpu.models.state import num_upper_pairs, packed_pair_indices
-from dcfm_tpu.parallel.mesh import make_mesh, shards_per_device
+from dcfm_tpu.parallel.mesh import (
+    make_chain_mesh, make_mesh, shards_per_device)
 from dcfm_tpu.parallel.multihost import place_sharded_global
 from dcfm_tpu.parallel.shard import build_mesh_chain, place_sharded
 from dcfm_tpu.runtime.fetch import (
     accumulator_window, assemble_q8_sigma, cast_f32_jit, cast_for_link,
-    fetch_jit, fetch_sd_jit, owned_copy_jit, quant8_drain,
+    fetch_jit, fetch_sd_jit, owned_copy_jit, pool_chains, quant8_drain,
     quant8_fetch_assemble, quant8_start, replicate_jit, upload_host_array)
 from dcfm_tpu.runtime.pipeline import StreamingFetcher, run_chain
 from dcfm_tpu.runtime.resume import sidecar_esig
@@ -93,7 +94,10 @@ class FitResult:
     # fetch, which on a tunneled device fluctuates with link weather.
     chain_iters_per_sec: float = 0.0
     # (num_chains, executed_iters, len(TRACE_SUMMARIES)) per-iteration scalar
-    # chain summaries (models/sampler.TRACE_SUMMARIES order).  Each row is
+    # chain summaries (models/sampler.TRACE_SUMMARIES order).  ALWAYS
+    # chain-major - a single-chain run carries a length-1 leading axis, so
+    # downstream shape handling never branches on num_chains (squeeze at
+    # the CLI/report edge only).  Each row is
     # computed on the SWEEP's output state; on the rare burn-in iterations
     # where adaptive rank truncation fires (ModelConfig.rank_adapt), the
     # carried state may additionally have columns re-masked, so the trace
@@ -132,10 +136,13 @@ class FitResult:
     # entrywise-SD upper panels: see the lazy .sd_upper_panels property
     # (backing fields _sd_upper_f32 / _sd_q8_panels / _sd_q8_scales below,
     # mirroring the posterior-mean panels)
-    # Thinned posterior draws (RunConfig.store_draws): {"Lambda": (S, g, P,
-    # K), "ps": (S, g, P), "X": (S, n, K), "H": (S, g, g, K, K)} in shard
-    # coordinates (permuted / standardized; use .preprocess to map back),
-    # with a leading chain axis when num_chains > 1.  "H" holds the
+    # Thinned posterior draws (RunConfig.store_draws): {"Lambda": (C, S, g,
+    # P, K), "ps": (C, S, g, P), "X": (C, S, n, K), "H": (C, S, g, g, K,
+    # K)} in shard coordinates (permuted / standardized; use .preprocess
+    # to map back).  ALWAYS chain-major: C == num_chains, and a
+    # single-chain run carries a length-1 leading axis (pool with
+    # utils.estimate._pool_chain_axis; squeeze only at the CLI/report
+    # edge).  "H" holds the
     # per-draw factor cross-moments eta_r'eta_c/n under the default
     # estimator="scaled" (absent for "plain"), so draw-level covariance
     # reconstruction uses the same rule as the accumulated mean - see
@@ -173,6 +180,15 @@ class FitResult:
     # (FitConfig.stream_artifact), already finalized and openable; None
     # otherwise.  export_artifact() to the same path just opens it.
     artifact_path: Optional[str] = None
+    # R-hat early stop (RunConfig.early_stop="rhat"): the global
+    # iteration the run converged and stopped at (None: ran to
+    # total_iters, or early stop off), and the (boundaries, 3) array of
+    # [iteration, max split-R-hat, min pooled ESS] rows the decision was
+    # evaluated on at each chunk boundary (None when early stop is off).
+    # Diagnostics, the chain-averaged Sigma, checkpoints, and
+    # iters_per_sec all reflect the truncated count.
+    stopped_at_iter: Optional[int] = None
+    rhat_trajectory: Optional[np.ndarray] = None
     # Flight-recorder run directory (FitConfig.obs; dcfm_tpu/obs): the
     # append-only JSONL event log of this fit - chunk boundaries, stream
     # snapshots/drains, checkpoint saves, sentinel rewinds, resume
@@ -476,7 +492,8 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
                  phases={k: round(v, 4) for k, v in ph.items()},
                  stream=res.stream_stats,
                  sentinel_rewinds=res.sentinel_rewinds,
-                 checkpoint_error=res.checkpoint_error)
+                 checkpoint_error=res.checkpoint_error,
+                 stopped_at_iter=res.stopped_at_iter)
         res.events_path = rec.directory
         return res
     finally:
@@ -596,11 +613,16 @@ def _fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
     n_pairs = num_upper_pairs(m.num_shards)
     P_shard = pre.data.shape[2]
 
-    def _window(acc_start: int):
+    def _window(acc_start: int, total: Optional[int] = None):
         # shared with the post-hoc epilogue - see accumulator_window's
-        # docstring for why there is exactly one copy of this
+        # docstring for why there is exactly one copy of this.  ``total``
+        # overrides the window's END: an R-hat early stop truncates the
+        # run at a chunk boundary, and the streamed fetch's final
+        # divisor must count only the draws actually saved
+        # (StreamingFetcher.truncate feeds the stop iteration here).
         _, inv, bessel = accumulator_window(
-            run.total_iters, run.burnin, run.thin, acc_start, C)
+            run.total_iters if total is None else total,
+            run.burnin, run.thin, acc_start, C)
         return inv, bessel
 
     streamer_factory = None
@@ -626,7 +648,20 @@ def _fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
     t0 = time.perf_counter()
     with profile_ctx:
         if use_mesh:
-            mesh = make_mesh(n_mesh, devices)
+            # Chain packing (parallel.mesh.make_chain_mesh): with C > 1
+            # chains dividing the mesh evenly, lay the carry out over a
+            # 2-D (chains x shards) mesh - each chain row owns all g
+            # shards of its chains and the sweep's collectives span only
+            # that row's n_mesh/C devices.  HBM per chip is identical to
+            # the vmap layout (C*g/N shard-states either way); packing
+            # buys smaller collective groups.  Chains fold their keys
+            # from the GLOBAL chain index in both layouts, so the chains
+            # themselves are identical; single-process only (the
+            # multi-host mesh must span all processes' devices 1-D).
+            pack = (C > 1 and not multiproc and n_mesh % C == 0
+                    and m.num_shards % (n_mesh // C) == 0)
+            mesh = (make_chain_mesh(C, n_mesh, devices) if pack
+                    else make_mesh(n_mesh, devices))
             shards_per_device(m.num_shards, mesh)  # validates divisibility
             t_up = time.perf_counter()
             Y_up = upload_host_array(pre.data, cfg.backend.upload_dtype)
@@ -774,6 +809,10 @@ def _fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
                      "ps": np.asarray(d.ps), "X": np.asarray(d.X)}
             if d.H is not None:
                 draws["H"] = np.asarray(d.H)
+            if C == 1:
+                # uniform chain-major contract (see FitResult.draws):
+                # a single chain carries a length-1 leading axis
+                draws = {k: v[None] for k, v in draws.items()}
 
         # The accumulators hold raw sums over saved draws; the division
         # by the actual saved count happens on device at fetch (which is
@@ -796,7 +835,7 @@ def _fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
                 replicate_jit(mesh)(carry.y_imp_acc) if multiproc
                 else carry.y_imp_acc), np.float32)
             if C > 1:
-                yi = yi.mean(axis=0)    # pool the chains' posterior means
+                yi = pool_chains(yi)    # the chains' posterior means
             rec = restore_data_matrix(yi / max(n_saved, 1), pre,
                                       destandardize=True)
             # observed entries are the caller's exact values; only the
@@ -1012,6 +1051,9 @@ def _fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
         sentinel_rewinds=rewinds,
         stream_stats=stream_stats,
         artifact_path=artifact_path,
+        stopped_at_iter=rr.stopped_at_iter,
+        rhat_trajectory=(np.asarray(rr.rhat_trajectory, np.float64)
+                         if rr.rhat_trajectory is not None else None),
     )
     if cfg.stream_artifact and res.artifact_path is None:
         # The stream did not land (multi-process fit, a no-op finished

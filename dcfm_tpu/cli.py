@@ -198,9 +198,24 @@ def build_parser() -> argparse.ArgumentParser:
                    help="also write entrywise posterior standard deviations "
                         "to <out>_sd.npy (second-moment accumulation)")
     f.add_argument("--chains", type=int, default=1,
-                   help="independent MCMC chains (vmap axis); > 1 enables "
-                        "split-R-hat in the JSON report and pools the "
-                        "covariance estimate over chains")
+                   help="independent MCMC chains; > 1 enables split-R-hat "
+                        "in the report and pools the covariance estimate "
+                        "over chains.  On a mesh run whose device count "
+                        "divides evenly the chains become a 2-D mesh axis "
+                        "(chain rows x shard columns) with per-row "
+                        "collectives - same chains, smaller collective "
+                        "groups")
+    f.add_argument("--early-stop", default="off", choices=["off", "rhat"],
+                   help="'rhat': stop at the first chunk boundary where "
+                        "every trace summary's split-R-hat < threshold AND "
+                        "its pooled ESS >= target (needs --chains >= 2); "
+                        "'off' runs the full schedule, bit-identical to a "
+                        "build without the feature")
+    f.add_argument("--rhat-threshold", type=float, default=1.01,
+                   help="early-stop R-hat threshold (Vehtari et al. 2021 "
+                        "recommend 1.01)")
+    f.add_argument("--ess-target", type=float, default=400.0,
+                   help="early-stop pooled effective-sample-size target")
     f.add_argument("--seed", type=int, default=0)
     f.add_argument("--no-permute", action="store_true",
                    help="shard features in their given order instead of the "
@@ -457,7 +472,10 @@ def main(argv=None) -> int:
         run=RunConfig(burnin=args.burnin, mcmc=args.mcmc, thin=args.thin,
                       seed=args.seed, chunk_size=args.chunk_size,
                       num_chains=args.chains,
-                      store_draws=args.draws_out is not None),
+                      store_draws=args.draws_out is not None,
+                      early_stop=args.early_stop,
+                      rhat_threshold=args.rhat_threshold,
+                      ess_target=args.ess_target),
         backend=BackendConfig(backend=args.backend,
                               mesh_devices=args.mesh_devices,
                               fetch_dtype=args.fetch_dtype,
@@ -483,7 +501,12 @@ def main(argv=None) -> int:
     if write_files:
         np.save(args.out, Sigma)
     if args.draws_out and write_files:
-        np.savez(args.draws_out, **res.draws)
+        # the CLI edge is the ONE sanctioned squeeze point of the
+        # chain-major contract: single-chain draw files keep their
+        # pre-chain-axis layout
+        np.savez(args.draws_out,
+                 **{k: v[0] if v.shape[0] == 1 else v
+                    for k, v in res.draws.items()})
     if args.imputed_out and write_files:
         np.save(args.imputed_out, res.Y_imputed)
     sd_out = None
@@ -495,6 +518,39 @@ def main(argv=None) -> int:
         if write_files:
             np.save(sd_out, res.posterior_sd(destandardize=False)
                     if args.raw_coords else res.Sigma_sd)
+    # Convergence report: R-hat / ESS / ESS-per-second per trace summary
+    # (ESS/s is the statistical-throughput headline - effective samples
+    # per second of chain compute, not raw iterations), plus the
+    # early-stop decision.  The human-readable table goes to stderr so
+    # stdout stays one parseable JSON object.
+    chain_s = max(res.phase_seconds.get("chain_s", 0.0), 1e-9)
+    ess_per_sec = {k: v / chain_s if np.isfinite(v) else None
+                   for k, v in res.diagnostics["ess"].items()}
+    if write_files:
+        rows = []
+        for name, e in res.diagnostics["ess"].items():
+            r = res.diagnostics["rhat"].get(name, float("nan"))
+            rows.append((name,
+                         f"{r:.4f}" if np.isfinite(r) else "-",
+                         f"{e:.1f}" if np.isfinite(e) else "-",
+                         f"{e / chain_s:.2f}" if np.isfinite(e) else "-"))
+        w = max(len(r[0]) for r in rows) if rows else 8
+        print(f"{'summary':<{w}}  {'R-hat':>8}  {'ESS':>9}  {'ESS/s':>8}",
+              file=sys.stderr)
+        for name, r, e, eps in rows:
+            print(f"{name:<{w}}  {r:>8}  {e:>9}  {eps:>8}",
+                  file=sys.stderr)
+        if cfg.run.early_stop == "off":
+            print("early stop: off (full schedule, "
+                  f"{cfg.run.total_iters} iterations)", file=sys.stderr)
+        elif res.stopped_at_iter is not None:
+            print(f"early stop: converged at iteration "
+                  f"{res.stopped_at_iter}/{cfg.run.total_iters} "
+                  f"(R-hat < {cfg.run.rhat_threshold}, pooled ESS >= "
+                  f"{cfg.run.ess_target:g})", file=sys.stderr)
+        else:
+            print("early stop: did not trigger (ran the full "
+                  f"{cfg.run.total_iters} iterations)", file=sys.stderr)
     print(json.dumps({
         "out": args.out,
         "sd_out": sd_out,
@@ -517,6 +573,10 @@ def main(argv=None) -> int:
                  for k, v in res.diagnostics["rhat"].items()},
         "ess": {k: round(v, 1) if np.isfinite(v) else None
                 for k, v in res.diagnostics["ess"].items()},
+        "ess_per_sec": {k: round(v, 2) if v is not None else None
+                        for k, v in ess_per_sec.items()},
+        "early_stop": cfg.run.early_stop,
+        "stopped_at_iter": res.stopped_at_iter,
     }))
     return 0
 

@@ -210,6 +210,31 @@ class RunConfig:
     # device memory and, because buffer shapes are static, a compilation
     # per schedule (the default path is schedule-agnostic).
     store_draws: bool = False
+    # Convergence-driven early termination of the chain, decided at CHUNK
+    # BOUNDARIES only (the scan body is untouched, so "off" is bitwise-
+    # identical to a build without the knob):
+    #   "off"  - run the full burnin+mcmc schedule (default);
+    #   "rhat" - after each chunk, compute split-R-hat and pooled ESS on
+    #            the post-burn-in trace summaries (utils/diagnostics,
+    #            Vehtari et al. 2021) and stop once max R-hat <
+    #            ``rhat_threshold`` AND min pooled ESS >= ``ess_target``.
+    #            The truncated boundary is treated as the final one: the
+    #            streamed-fetch window divisor, the checkpoint, the
+    #            diagnostics, and the chain-averaged Sigma all use the
+    #            truncated iteration count, and the stop is recorded
+    #            (FitResult.stopped_at_iter / rhat_trajectory, an
+    #            ``early_stop`` flight-recorder event).  Requires
+    #            num_chains >= 2 (split-R-hat needs chains) and
+    #            chunk_size >= 1 (boundaries are the decision points);
+    #            refused with store_draws (the draw ring is statically
+    #            sized by the full schedule and would come back
+    #            zero-padded).
+    early_stop: str = "off"      # "off" | "rhat"
+    # Stopping thresholds for early_stop="rhat" (ignored when "off").
+    # Defaults follow Vehtari et al. 2021: R-hat < 1.01 on every trace
+    # summary, and a pooled-ESS floor on the worst-mixing summary.
+    rhat_threshold: float = 1.01
+    ess_target: float = 400.0
 
     @property
     def total_iters(self) -> int:
@@ -469,6 +494,31 @@ def validate(cfg: FitConfig, n: int, p: int) -> None:
         raise ValueError(
             "store_draws=True but the schedule saves no draws "
             f"(mcmc={cfg.run.mcmc}, thin={cfg.run.thin})")
+    if cfg.run.early_stop not in ("off", "rhat"):
+        raise ValueError(
+            f"unknown early_stop {cfg.run.early_stop!r} (off | rhat)")
+    if cfg.run.early_stop == "rhat":
+        if cfg.run.num_chains < 2:
+            raise ValueError(
+                "early_stop='rhat' requires num_chains >= 2 "
+                "(split-R-hat is undefined on one chain)")
+        if cfg.run.chunk_size < 1:
+            raise ValueError(
+                "early_stop='rhat' requires chunk_size >= 1: the stop is "
+                "a chunk-boundary decision, and chunk_size=0 runs the "
+                "whole schedule in one scan with no boundaries")
+        if cfg.run.store_draws:
+            raise ValueError(
+                "early_stop='rhat' is incompatible with store_draws: the "
+                "draw ring is statically sized by the full schedule and a "
+                "truncated run would return zero-padded draws")
+        if not (cfg.run.rhat_threshold > 1.0):
+            raise ValueError(
+                f"rhat_threshold must be > 1.0, got "
+                f"{cfg.run.rhat_threshold}")
+        if not (cfg.run.ess_target > 0):
+            raise ValueError(
+                f"ess_target must be > 0, got {cfg.run.ess_target}")
     if m.prior not in ("mgp", "horseshoe", "dl"):
         raise ValueError(f"unknown prior {m.prior!r}")
     if m.estimator not in ("plain", "scaled"):

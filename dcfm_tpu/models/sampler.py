@@ -227,15 +227,20 @@ def _trace_now(state: SamplerState, sse_j: jax.Array, reduce_fn: Callable,
                       ll / (p_total * n)])
 
 
-def chain_keys(key: jax.Array, num_chains: int) -> jax.Array:
-    """(num_chains,) per-chain PRNG keys, folded from the chain index.
+def chain_keys(key: jax.Array, num_chains: int, first=0) -> jax.Array:
+    """(num_chains,) per-chain PRNG keys, folded from the GLOBAL chain
+    index ``first + i``.
 
-    The ONE key derivation both execution layouts must share: the
-    single-device vmap path (api._local_fns) and the mesh path
-    (parallel.shard.build_mesh_chain) each call this, which is what keeps
-    the two layouts chain-for-chain bitwise identical."""
+    The ONE key derivation every execution layout must share: the
+    single-device vmap path (api._local_fns), the replicated mesh path,
+    and the chain-packed 2-D mesh (parallel.shard.build_mesh_chain, where
+    ``first`` is this device row's base chain index) each call this,
+    which is what keeps all layouts chain-for-chain bitwise identical -
+    chain c's stream is fold_in(key, c) no matter where c runs.
+    ``first`` may be a traced integer (lax.axis_index over the chain
+    mesh axis)."""
     return jax.vmap(lambda c: jax.random.fold_in(key, c))(
-        jnp.arange(num_chains))
+        first + jnp.arange(num_chains))
 
 
 def schedule_array(run: RunConfig) -> jax.Array:

@@ -61,6 +61,12 @@ def summarize(run_dir: str, events=None, torn: int = 0) -> dict:
                 "to_iteration": e.get("to_iteration"),
                 "acc_start": e.get("acc_start")}
                for e in by.get("sentinel_rewind", [])]
+    early_stops = [{"iteration": e.get("iteration"),
+                    "total_iters": e.get("total_iters"),
+                    "rhat": e.get("rhat"), "ess": e.get("ess"),
+                    "rhat_threshold": e.get("rhat_threshold"),
+                    "ess_target": e.get("ess_target")}
+                   for e in by.get("early_stop", [])]
     # "newest fit" must mean the newest REAL run: supervise()'s no-op
     # materialization resume (role "materialize", zero chunks) records
     # its own fit_done last, and its ~0 phase walls would otherwise
@@ -129,6 +135,7 @@ def summarize(run_dir: str, events=None, torn: int = 0) -> dict:
                                       if saves else None),
         "resume_decisions": resumes,
         "sentinel_rewinds": rewinds,
+        "early_stops": early_stops,
         "faults_injected": faults,
         "chunks": len(chunks),
         "chain_s": round(sum(float(e.get("dur_s", 0.0))
@@ -189,6 +196,11 @@ def _print_summary(s: dict, out: List[str]) -> None:
     for r in s["sentinel_rewinds"]:
         out.append(f"sentinel rewind: iteration {r['iteration']} -> "
                    f"{r['to_iteration']}")
+    for e in s["early_stops"]:
+        out.append(f"early stop: converged at iteration "
+                   f"{e['iteration']}/{e['total_iters']} "
+                   f"(R-hat {e['rhat']} < {e['rhat_threshold']}, "
+                   f"ESS {e['ess']} >= {e['ess_target']:g})")
     for f in s["faults_injected"]:
         out.append("fault injected: " + " ".join(
             f"{k}={v}" for k, v in f.items()))

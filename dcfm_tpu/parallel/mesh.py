@@ -1,4 +1,4 @@
-"""Device-mesh utilities for the shard axis.
+"""Device-mesh utilities for the shard and chain axes.
 
 The divide-and-conquer shard axis is the framework's one model-parallel
 axis (SURVEY.md section 2, parallelism inventory): shard m's state lives on
@@ -6,15 +6,28 @@ device m (or, when g > n_devices, a vmap-batch of g/n_devices shards per
 device - the config-5 "256 shards on 8 cores" layout).  Cross-shard traffic
 is exactly two psums per sweep (K x K and n x K, the X update) plus one
 all_gather of (P, K) loadings per saved draw - all riding ICI.
+
+Multiple MCMC chains add a second, embarrassingly-parallel axis: chains
+never communicate during the sweep, so a 2-D (chains x shards) mesh
+(``make_chain_mesh``) packs C chains x Q packed panels onto N devices with
+even HBM per chip - each chain row owns all g shards of its chain and its
+collectives span only that row's N/C devices.  Only the per-chunk
+health/trace reductions and the final accumulator fetch touch the chain
+axis, on the host.  Partition specs for the chain carry are declared by
+NAME via ``match_partition_rules`` (regex on the pytree key path) instead
+of hand-assembled per-leaf literals.
 """
 
 from __future__ import annotations
+
+import re
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 SHARD_AXIS = "shards"
+CHAIN_AXIS = "chains"
 
 
 def make_mesh(num_devices: int = 0, devices=None) -> Mesh:
@@ -97,6 +110,67 @@ def initialize_multihost(coordinator_address=None, num_processes=None,
             coordinator_address=coordinator_address,
             num_processes=num_processes, process_id=process_id)
     return make_mesh(0, jax.devices())
+
+
+def make_chain_mesh(num_chains: int, num_devices: int = 0,
+                    devices=None) -> Mesh:
+    """2-D (chains x shards) mesh: row c runs chain c's shards.
+
+    The device grid is (num_chains, n // num_chains): chain rows are the
+    MAJOR axis so each chain's shard sub-mesh is a contiguous device
+    block (ICI-adjacent on a real slice), and no sweep collective ever
+    crosses a row - chains are independent until the host-side trace
+    reduction at chunk boundaries.
+    """
+    if devices is None:
+        devices = jax.devices()
+    if num_devices:
+        if num_devices > len(devices):
+            raise ValueError(
+                f"requested {num_devices} devices, have {len(devices)}")
+        devices = devices[:num_devices]
+    n = len(devices)
+    if num_chains < 2:
+        raise ValueError(
+            f"make_chain_mesh needs num_chains >= 2, got {num_chains} "
+            "(a single chain is the plain 1-D shard mesh)")
+    if n % num_chains != 0:
+        raise ValueError(
+            f"{num_chains} chains must divide the {n}-device mesh evenly "
+            "(each chain row gets n/num_chains devices)")
+    grid = np.array(devices).reshape(num_chains, n // num_chains)
+    return Mesh(grid, (CHAIN_AXIS, SHARD_AXIS))
+
+
+def chain_rows(mesh: Mesh) -> int:
+    """Size of the chain mesh axis (1 on a plain 1-D shard mesh)."""
+    return mesh.shape.get(CHAIN_AXIS, 1) if CHAIN_AXIS in mesh.axis_names \
+        else 1
+
+
+def match_partition_rules(rules, tree):
+    """PartitionSpec pytree for ``tree``, chosen by NAME: each leaf's key
+    path (jax.tree_util.keystr, e.g. ``.state.Lambda`` or
+    ``.state.prior['tau']``) is matched against ``rules`` - an ordered
+    list of ``(regex, PartitionSpec)`` pairs - and the FIRST match wins.
+    Scalar and one-element leaves replicate (collectives over a scalar
+    cost more than they shard).  A leaf no rule matches raises: silence
+    here would mean a new carry field silently replicating p^2-sized
+    state onto every chip.
+    """
+    def spec_for(path, leaf):
+        shape = getattr(leaf, "shape", ())
+        if len(shape) == 0 or int(np.prod(shape)) == 1:
+            return P()
+        name = jax.tree_util.keystr(path)
+        for pattern, spec in rules:
+            if re.search(pattern, name):
+                return spec
+        raise ValueError(
+            f"no partition rule matches carry leaf {name!r} "
+            f"(shape {tuple(shape)}); add a rule - an unmatched leaf "
+            "must never silently replicate")
+    return jax.tree_util.tree_map_with_path(spec_for, tree)
 
 
 def shards_per_device(num_shards: int, mesh: Mesh) -> int:

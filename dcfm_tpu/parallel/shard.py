@@ -41,7 +41,8 @@ from dcfm_tpu.models.sampler import (
     ChainCarry, ChainStats, DrawBuffers, chain_keys, init_chain, run_chunk)
 from dcfm_tpu.models.state import num_padded_pairs, packed_pair_indices
 from dcfm_tpu.parallel.mesh import (
-    SHARD_AXIS, replicated_spec, shard_spec, shards_per_device)
+    CHAIN_AXIS, SHARD_AXIS, match_partition_rules, replicated_spec,
+    shard_spec, shards_per_device)
 
 
 def _mesh_reduce(x: jax.Array) -> jax.Array:
@@ -81,11 +82,22 @@ def build_mesh_chain(
     (carry, stats, trace) runs ``num_iters`` Gibbs iterations under the
     (burnin, thin) schedule pair from models.sampler.schedule_array.
 
-    With ``num_chains`` > 1, every carry leaf gains a leading chain axis -
-    chains are an inner vmap axis on each device (replicated over the mesh:
-    each device runs all chains for its local shards), with per-chain keys
-    folded from the chain index exactly as the single-device layout does,
-    so mesh and vmap runs stay chain-for-chain identical.
+    With ``num_chains`` > 1 the carry gains a leading chain axis, and the
+    LAYOUT of that axis follows the mesh:
+
+    * 1-D shard mesh: chains are an inner vmap axis on each device
+      (replicated over the mesh: each device runs all chains for its
+      local shards).
+    * 2-D (chains x shards) mesh (parallel.mesh.make_chain_mesh): the
+      chain axis is SPLIT over the chain mesh rows - row r runs chains
+      [r*c_loc, (r+1)*c_loc) over that row's shard sub-mesh, so no sweep
+      collective ever crosses a chain row and HBM stays even per chip
+      (each device holds C*g/N shard-states either way; packing trades
+      the chain vmap width for smaller collective groups).
+
+    Either way the per-chain keys fold from the GLOBAL chain index
+    (models.sampler.chain_keys), so mesh-packed, mesh-replicated, and
+    single-device vmap runs stay chain-for-chain identical.
 
     ``compiler_options`` passes XLA DebugOptions to both jits.  The one that
     matters on a *virtual* (host-platform) mesh at heavy per-device shapes:
@@ -98,6 +110,13 @@ def build_mesh_chain(
     gl = shards_per_device(g, mesh)
     C = num_chains
     n_dev = g // gl
+    # Chain packing: a 2-D mesh splits the C chains over its chain rows.
+    packed = CHAIN_AXIS in mesh.axis_names
+    c_rows = mesh.shape[CHAIN_AXIS] if packed else 1
+    if C % c_rows != 0:
+        raise ValueError(
+            f"num_chains={C} must divide over the {c_rows}-row chain mesh")
+    c_loc = C // c_rows                 # chains vmapped per device
     # Packed upper-panel layout: the padded pair count is a multiple of g
     # (models.state.num_padded_pairs), so it splits evenly over any legal
     # mesh; device d owns the contiguous packed slice
@@ -107,33 +126,43 @@ def build_mesh_chain(
 
     sh = shard_spec()       # leading global-shard axis -> split over mesh
     rep = replicated_spec()
-    # under a chain axis, the shard axis moves to position 1
-    sh_c = P(None, SHARD_AXIS) if C > 1 else sh
-    # draw buffers carry a leading draw axis before the shard axis (plus
-    # the chain axis when C > 1); X draws are replicated like state.X
-    sh_d = P(None, None, SHARD_AXIS) if C > 1 else P(None, SHARD_AXIS)
+    # Leading chain-axis placement: split over the chain mesh rows when
+    # packed, an unsharded (vmap) leading axis otherwise.
+    lead = ((CHAIN_AXIS,) if packed else (None,)) if C > 1 else ()
+
+    import jax.numpy as jnp  # noqa: F811
 
     def carry_specs() -> ChainCarry:
-        # Every SamplerState leaf is shard-major except the replicated X.
-        from dcfm_tpu.models.state import SamplerState
-        state_spec = SamplerState(Lambda=sh_c, Z=sh_c, X=rep, ps=sh_c,
-                                  prior=jax.tree.map(lambda _: sh_c, prior_leaf_tree),
-                                  active=sh_c if cfg.rank_adapt else None)
-        draws_spec = (DrawBuffers(Lambda=sh_d, ps=sh_d, X=rep,
-                                  H=(sh_d if cfg.estimator == "scaled"
-                                     else None))
-                      if num_stored_draws else None)
-        return ChainCarry(state=state_spec, sigma_acc=sh_c, iteration=rep,
-                          health=sh_c,
-                          sigma_sq_acc=sh_c if cfg.posterior_sd else None,
-                          draws=draws_spec,
-                          y_imp_acc=sh_c if cfg.impute_missing else None)
+        # Rule-based partition specs, matched by LEAF NAME against the
+        # carry template (parallel.mesh.match_partition_rules): the carry
+        # is shard-major by default; the named exceptions are the shared
+        # factor draws X (replicated across shards), the draw rings
+        # (draw axis between chain and shard), and the per-chain
+        # iteration counter.  A new carry field either matches the
+        # shard-major default or fails loudly here - it cannot silently
+        # replicate.
+        template = jax.eval_shape(_global_carry, jax.random.key(0))
+        rules = [
+            (r"\.state\.X$", P(*lead)),
+            (r"\.draws\.X$", P(*lead)),
+            (r"\.draws\.", P(*lead, None, SHARD_AXIS)),
+            (r"\.iteration$", P(*lead)),
+            (r".", P(*lead, SHARD_AXIS)),
+        ]
+        return match_partition_rules(rules, template)
 
-    # Build a template of the prior pytree structure to spec it out.
-    import jax.numpy as jnp  # noqa: F811
-    prior_leaf_tree = jax.eval_shape(
-        lambda k: prior.init(k, 4, cfg.factors_per_shard),
-        jax.random.key(0))
+    def _global_carry(key):
+        # Structure/scalar-ness template of the GLOBAL carry (dummy n/P:
+        # the spec rules read leaf names and ranks, never sizes).
+        Y_t = jnp.zeros((g, 4, 8), jnp.float32)
+
+        def one(k):
+            return init_chain(k, Y_t, cfg, prior, num_global_shards=g,
+                              num_stored_draws=num_stored_draws,
+                              num_local_pairs=num_padded_pairs(g))
+        if C == 1:
+            return one(key)
+        return jax.vmap(one)(chain_keys(key, C))
 
     def _init_one(key, Y):
         return init_chain(
@@ -164,10 +193,17 @@ def build_mesh_chain(
             gather_fn=_mesh_gather,
             unroll=unroll)
 
+    def _row_keys(key):
+        # per-chain keys of THIS device's chains, folded from the GLOBAL
+        # chain index (row * c_loc + i) - the shared chain_keys
+        # derivation, so packing never changes a chain's stream
+        first = lax.axis_index(CHAIN_AXIS) * c_loc if packed else 0
+        return chain_keys(key, c_loc, first=first)
+
     def _init(key, Y):
         if C == 1:
             return _init_one(key, Y)
-        return jax.vmap(_init_one, in_axes=(0, None))(chain_keys(key, C), Y)
+        return jax.vmap(_init_one, in_axes=(0, None))(_row_keys(key), Y)
 
     def _chunk(key, Y, carry, sched):
         if C == 1:
@@ -175,9 +211,11 @@ def build_mesh_chain(
         else:
             carry, stats, trace = jax.vmap(
                 _chunk_one, in_axes=(0, None, 0, None))(
-                    chain_keys(key, C), Y, carry, sched)
-        # Reduce diagnostics across the mesh so the replicated out_spec
-        # holds (trace is already mesh-reduced via the psum in reduce_fn).
+                    _row_keys(key), Y, carry, sched)
+        # Reduce diagnostics across the shard axis so the out_spec holds
+        # (trace is already shard-reduced via the psum in reduce_fn; on a
+        # chain-packed mesh both reductions span only this chain row's
+        # devices - the sweep never communicates across chains).
         stats = ChainStats(
             tau_log_max=lax.pmax(stats.tau_log_max, SHARD_AXIS),
             ps_min=lax.pmin(stats.ps_min, SHARD_AXIS),
@@ -192,6 +230,9 @@ def build_mesh_chain(
         return carry, stats, trace
 
     specs = carry_specs()
+    # Per-chunk health/trace outputs: chain-major on a packed mesh (each
+    # row contributes its chains' rows), replicated otherwise.
+    diag = P(CHAIN_AXIS) if packed else rep
     init_fn = jax.jit(shard_map(
         _init, mesh=mesh,
         in_specs=(rep, sh),
@@ -201,8 +242,8 @@ def build_mesh_chain(
     chunk_fn = jax.jit(shard_map(
         _chunk, mesh=mesh,
         in_specs=(rep, sh, specs, rep),
-        out_specs=(specs, ChainStats(*([rep] * len(ChainStats._fields))),
-                   rep)), donate_argnums=(2,),
+        out_specs=(specs, ChainStats(*([diag] * len(ChainStats._fields))),
+                   diag)), donate_argnums=(2,),
         compiler_options=compiler_options)
     # The carry PartitionSpec pytree is part of the public contract: a
     # RESUMED carry (host numpy from the checkpoint loader) must be

@@ -53,6 +53,19 @@ def accumulator_window(total_iters: int, burnin: int, thin: int,
     return n_saved, inv_count, bessel
 
 
+def pool_chains(chain_major: np.ndarray) -> np.ndarray:
+    """(C, ...) chain-major host array -> cross-chain pooled mean.
+
+    The ONE sanctioned host-side seam for averaging over the leading
+    chain axis (dcfm-lint DCFM1401 flags ad-hoc ``.mean(axis=0)`` over
+    chain-major arrays in library code): chains are independent
+    equal-weight posterior estimates, so the mixture mean IS the pooled
+    estimate.  Named so the reduction is auditable at every call site.
+    """
+    # already-host input: nothing to drain, so no async-copy prelude
+    return np.asarray(chain_major).mean(axis=0)  # dcfm: ignore[DCFM801]
+
+
 def cast_for_link(u, mode: str):
     """Down-cast upper panels for the device->host link - the single
     device-side home for the quantization convention that

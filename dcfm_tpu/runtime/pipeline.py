@@ -66,6 +66,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from dcfm_tpu.models.sampler import num_saved_draws
+from dcfm_tpu.utils.diagnostics import ess, split_rhat
 from dcfm_tpu.obs import metrics as obs_metrics
 from dcfm_tpu.obs.recorder import active as obs_active, record
 from dcfm_tpu.resilience.faults import fault_event, fault_plan
@@ -148,6 +149,7 @@ class StreamingFetcher:
         self._mean_fn = mean_fn
         self._sd_fn = sd_fn
         self._window_fn = window_fn
+        self._acc_start = acc_start
         self._inv_count, self._bessel = window_fn(acc_start)
         self._shape = tuple(shape)
         self._n_slices = n_slices
@@ -194,7 +196,17 @@ class StreamingFetcher:
         final divisor.  Already-queued snapshots of the pre-rewind
         accumulator drain harmlessly - snapshot semantics mean every
         stale landing is superseded by the final boundary's."""
+        self._acc_start = acc_start
         self._inv_count, self._bessel = self._window_fn(acc_start)
+
+    def truncate(self, total_iters: int) -> None:
+        """Early stop moved the window's END: recompute the final
+        divisor for the truncated iteration count (window_fn must
+        accept ``(acc_start, total_iters)`` - api.fit's does).  The
+        stop boundary's FINAL snapshot is the first submit after this
+        call, so every already-queued landing is superseded as usual."""
+        self._inv_count, self._bessel = self._window_fn(
+            self._acc_start, total_iters)
 
     def submit(self, acc, sq_acc=None, *, final: bool = False) -> bool:
         """Dispatch one boundary's snapshot: run the fetch jits, issue
@@ -316,6 +328,42 @@ class ChainRunResult:
     rewinds: int
     trace0: int
     streamer: Optional[StreamingFetcher]
+    # Early stop (RunConfig.early_stop="rhat"): the global iteration the
+    # run converged at (None: ran to total_iters or early stop off), and
+    # the per-boundary [iteration, max split-R-hat, min ESS] rows the
+    # decision was made from (None when early stop is off).
+    stopped_at_iter: Optional[int] = None
+    rhat_trajectory: Optional[list] = None
+
+
+def early_stop_metrics(traces: list, trace0: int, burnin: int):
+    """``(rhat_max, ess_min)`` over the trace summaries' post-burn-in
+    slice of the accumulated per-chunk trace rows - the convergence
+    check the chunk loop runs at each boundary under
+    ``RunConfig.early_stop="rhat"``.
+
+    ``traces`` is run_chain's ``(start_iteration, (C, ni, S) host
+    array)`` list; the concatenation covers global iterations
+    ``trace0+1 .. now``.  Returns NaNs while the post-burn-in window is
+    too short (< 4 draws) or single-chain - NaN never triggers a stop.
+    The reduction direction is conservative on purpose: the WORST
+    summary's R-hat must clear the threshold and the WORST summary's
+    pooled ESS must clear the target.
+    """
+    arr = np.concatenate([t if t.ndim == 3 else t[None] for _, t in traces],
+                         axis=1)
+    post = arr[:, max(burnin - trace0, 0):, :]
+    if post.shape[0] < 2 or post.shape[1] < 4:
+        return float("nan"), float("nan")
+    # np.max/np.min, not nanmax (or Python max, which drops NaN by
+    # comparison order): a NaN diagnostic (zero-variance summary,
+    # numerical trouble) must poison the decision toward "keep
+    # sampling", never be silently ignored
+    rhat_max = float(np.max([split_rhat(post[:, :, i])
+                             for i in range(post.shape[2])]))
+    ess_min = float(np.min([ess(post[:, :, i])
+                            for i in range(post.shape[2])]))
+    return rhat_max, ess_min
 
 
 def run_chain(*, cfg, model, run, sched, phase: dict, multiproc: bool,
@@ -468,6 +516,15 @@ def run_chain(*, cfg, model, run, sched, phase: dict, multiproc: bool,
                 if streamer_factory is not None and executed else None)
     queue_ = chunk_schedule(executed, chunk)
     qi = 0
+    # R-hat early stop (RunConfig.early_stop="rhat"): a HOST-side,
+    # chunk-boundary-only decision over the tiny (C, ni, summaries)
+    # trace block each chunk already fetches - the device program never
+    # changes, which is what keeps early_stop="off" bitwise-identical
+    # to a build without the feature (the entire machinery below is
+    # behind this one flag).
+    es_on = run.early_stop == "rhat"
+    stopped_at = None
+    rhat_traj = [] if es_on else None
     try:
         while qi < len(queue_):
             ni = queue_[qi]
@@ -479,6 +536,30 @@ def run_chain(*, cfg, model, run, sched, phase: dict, multiproc: bool,
             chunk_secs.append(time.perf_counter() - tc)
             it_now += ni
             traces.append((it_now - ni, trace_host))
+            if es_on:
+                rhat_max, ess_min = early_stop_metrics(
+                    traces, trace0, run.burnin)
+                rhat_traj.append([it_now, rhat_max, ess_min])
+                if (qi < len(queue_)
+                        and np.isfinite(rhat_max) and np.isfinite(ess_min)
+                        and rhat_max < run.rhat_threshold
+                        and ess_min >= run.ess_target):
+                    # Converged: truncate the schedule so THIS boundary
+                    # is the final one - the `last` flowing from here
+                    # drives the final stream submit, the final
+                    # checkpoint save, and the chunk record exactly as
+                    # a natural last boundary would.  The streamed
+                    # window divisor must follow the moved end BEFORE
+                    # that final submit quantizes with it.
+                    queue_ = queue_[:qi]
+                    stopped_at = it_now
+                    if streamer is not None:
+                        streamer.truncate(it_now)
+                    record("early_stop", iteration=it_now,
+                           rhat=round(rhat_max, 5), ess=round(ess_min, 2),
+                           rhat_threshold=run.rhat_threshold,
+                           ess_target=run.ess_target,
+                           total_iters=run.total_iters)
             last = qi == len(queue_)
             # flight recorder + progress gauges: one event and a few
             # gauge writes per boundary (host-side only; a no-op stays
@@ -540,6 +621,12 @@ def run_chain(*, cfg, model, run, sched, phase: dict, multiproc: bool,
                 # stream's window divisor follows the moved acc_start
                 # (stale queued snapshots are superseded, never summed).
                 traces = [(s, t) for s, t in traces if s < it_now]
+                if es_on:
+                    # a rewind voids any stop decision made against the
+                    # now-discarded chunks, and the trajectory keeps
+                    # only pre-rewind boundaries
+                    stopped_at = None
+                    rhat_traj = [r for r in rhat_traj if r[0] <= it_now]
                 key_chain = jax.random.fold_in(key_chain, sentinel.rewinds)
                 m_active = dataclasses.replace(
                     m_active, ridge_jitter=sentinel.escalated_jitter())
@@ -712,9 +799,15 @@ def run_chain(*, cfg, model, run, sched, phase: dict, multiproc: bool,
         if streamer is not None:
             streamer.abort()
         raise
+    if stopped_at is not None:
+        # the truncated count feeds everything downstream that divides
+        # or slices by it: the epilogue's accumulator_window(done +
+        # executed, ...), iters_per_sec, and the diagnostics' trace span
+        executed = it_now - done
     return ChainRunResult(
         carry=carry, stats=stats, executed=executed,
         traces=[t for _, t in traces], chunk_seconds=chunk_secs,
         done=done, acc_start=acc_start, checkpoint_error=ck_error,
         rewinds=sentinel.rewinds if sentinel is not None else 0,
-        trace0=trace0, streamer=streamer)
+        trace0=trace0, streamer=streamer,
+        stopped_at_iter=stopped_at, rhat_trajectory=rhat_traj)

@@ -35,7 +35,8 @@ def split_rhat(draws: np.ndarray) -> float:
     m, n = halves.shape
     chain_means = halves.mean(axis=1)
     chain_vars = halves.var(axis=1, ddof=1)
-    W = chain_vars.mean()
+    # W pools the 2C half-chain variances: the reduction IS the statistic
+    W = chain_vars.mean()          # dcfm: ignore[DCFM1401]
     B = n * chain_means.var(ddof=1)
     if W <= 0:
         return float("nan") if B > 0 else 1.0

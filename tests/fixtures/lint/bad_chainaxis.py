@@ -1,0 +1,23 @@
+"""Known-bad chain-axis reductions: DCFM1401 must fire (all spellings)."""
+import numpy as np
+
+
+def pooled_sigma(chain_sigmas):
+    # DCFM1401: np.mean with no axis flattens chains AND everything else
+    return np.mean(chain_sigmas)
+
+
+def pooled_trace(chain_traces):
+    # DCFM1401: bare axis=0 collapses the chain axis implicitly -
+    # 'average over chains' spelled identically to 'average over draws'
+    return chain_traces.mean(axis=0)
+
+
+def summed_draws(per_chain_draws):
+    # DCFM1401: np.sum over a chain-major name, bare axis=0
+    return np.sum(per_chain_draws, axis=0)
+
+
+def method_sum_no_axis(chain_block):
+    # DCFM1401: .sum() with no axis on a chain-major array
+    return chain_block.sum()

@@ -1,0 +1,31 @@
+"""Known-good chain-axis handling: pooling goes through named seams."""
+import numpy as np
+
+CHAIN_AXIS = 0
+
+
+def pool_chains(chain_major):
+    # the function's own name declares the reduction - this IS the
+    # sanctioned seam DCFM1401 points at
+    return np.asarray(chain_major).mean(axis=0)
+
+
+def pooled_via_seam(chain_sigmas):
+    # pooling through the named helper, no ad-hoc reduction
+    return pool_chains(chain_sigmas)
+
+
+def named_axis(chain_traces):
+    # the axis is spelled as a named constant, not a bare 0 - the
+    # author named the chain axis deliberately
+    return chain_traces.mean(axis=CHAIN_AXIS)
+
+
+def draw_axis_reduction(chain_draws):
+    # reducing a NON-leading axis leaves the chain axis intact
+    return chain_draws.mean(axis=1)
+
+
+def unrelated_reduction(values):
+    # nothing chain-major about this name: plain numerics stay silent
+    return np.mean(values, axis=0)

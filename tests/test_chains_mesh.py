@@ -1,0 +1,276 @@
+"""Mesh-packed multi-chain sampling + R-hat early stop (ROADMAP item 4).
+
+The contracts under test:
+
+* chains as a first-class mesh axis (parallel/mesh.make_chain_mesh +
+  the rule-based carry PartitionSpecs in parallel/shard) compute the
+  SAME chains as the vmap layouts - bitwise where the shard sub-mesh
+  is a single device (identical reduction order), documented 1e-3
+  association-order tolerance across different shard spans;
+* ``early_stop="off"`` (the default) is bit-exact with a build that
+  never heard of the feature - the decision machinery must not touch
+  the chain;
+* ``early_stop="rhat"`` truncation is a chunk-boundary decision whose
+  checkpoints resume correctly: continuing a truncated run under
+  ``early_stop="off"`` to the full schedule reproduces the
+  uninterrupted run bitwise;
+* the decision trail (stopped_at_iter / rhat_trajectory / the
+  early_stop flight-recorder event) is recorded, monotone in the
+  iteration column, and absent when the feature is off;
+* a real SIGKILL mid-run with chains >= 2 + supervised resume lands on
+  the bit-identical pooled Sigma (crash-isolated lane in CI).
+"""
+
+import dataclasses
+import json
+import os
+
+import numpy as np
+import pytest
+
+from tests.conftest import make_synthetic
+
+from dcfm_tpu import BackendConfig, FitConfig, ModelConfig, RunConfig, fit
+from dcfm_tpu.config import validate
+from dcfm_tpu.parallel.mesh import CHAIN_AXIS, make_chain_mesh
+from dcfm_tpu.resilience import faults
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _need_devices(n):
+    import jax
+
+    if len(jax.devices()) < n:
+        pytest.skip(f"needs {n} virtual devices")
+
+
+# ---------------------------------------------------------------------------
+# packed mesh == vmap layouts
+# ---------------------------------------------------------------------------
+
+def test_make_chain_mesh_layout():
+    _need_devices(8)
+    mesh = make_chain_mesh(2, 8)
+    assert mesh.axis_names[0] == CHAIN_AXIS
+    assert mesh.shape[CHAIN_AXIS] == 2
+    # chain rows major: each chain's shard sub-mesh is contiguous
+    assert mesh.devices.shape == (2, 4)
+    with pytest.raises(ValueError):
+        make_chain_mesh(3, 8)                    # 3 does not divide 8
+    with pytest.raises(ValueError):
+        make_chain_mesh(1, 8)                    # packing needs >= 2
+
+
+def test_packed_single_column_bitwise_matches_vmap():
+    """(C, 1)-grid packed mesh vs the single-device vmap layout: the
+    shard axis spans ONE device in both, so every reduction runs in the
+    identical order and the chains must agree BITWISE, chain for
+    chain."""
+    _need_devices(2)
+    Y, _ = make_synthetic(50, 32, 3, seed=47)
+    m = ModelConfig(num_shards=4, factors_per_shard=2, rho=0.7)
+    r = RunConfig(burnin=20, mcmc=20, thin=1, seed=2, num_chains=2)
+    res_vmap = fit(Y, FitConfig(model=m, run=r))
+    res_pack = fit(Y, FitConfig(model=m, run=r,
+                                backend=BackendConfig(mesh_devices=2)))
+    np.testing.assert_array_equal(res_vmap.sigma_blocks,
+                                  res_pack.sigma_blocks)
+    np.testing.assert_array_equal(res_vmap.traces, res_pack.traces)
+    np.testing.assert_array_equal(np.asarray(res_vmap.state.Lambda),
+                                  np.asarray(res_pack.state.Lambda))
+
+
+def test_packed_wide_grid_matches_vmap():
+    """(2, 4)-grid: the shard axis spans 4 devices, whose psum
+    associates differently from the vmap layout's jnp.sum - same
+    documented 1e-3/1e-4 bound class as test_chains/test_shard mesh
+    parity."""
+    _need_devices(8)
+    Y, _ = make_synthetic(50, 64, 3, seed=49)
+    m = ModelConfig(num_shards=4, factors_per_shard=2, rho=0.7)
+    r = RunConfig(burnin=20, mcmc=20, thin=1, seed=2, num_chains=2)
+    res_vmap = fit(Y, FitConfig(model=m, run=r))
+    res_pack = fit(Y, FitConfig(model=m, run=r,
+                                backend=BackendConfig(mesh_devices=8)))
+    np.testing.assert_allclose(res_vmap.sigma_blocks,
+                               res_pack.sigma_blocks,
+                               rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(res_vmap.traces, res_pack.traces,
+                               rtol=1e-3, atol=1e-4)
+
+
+def test_non_dividing_chains_fall_back_to_vmap():
+    """3 chains on a 4-device mesh can't pack (3 does not divide 4):
+    fit() silently falls back to the 1-D mesh + vmap layout and still
+    returns 3 chains."""
+    _need_devices(4)
+    Y, _ = make_synthetic(40, 32, 2, seed=51)
+    res = fit(Y, FitConfig(
+        model=ModelConfig(num_shards=4, factors_per_shard=2, rho=0.6),
+        run=RunConfig(burnin=10, mcmc=10, thin=1, seed=0, num_chains=3),
+        backend=BackendConfig(mesh_devices=4)))
+    assert res.traces.shape[0] == 3
+    assert np.isfinite(res.sigma_blocks).all()
+
+
+# ---------------------------------------------------------------------------
+# early stop: off is bit-exact, rhat truncates at a chunk boundary
+# ---------------------------------------------------------------------------
+
+def _es_shape():
+    Y, _ = make_synthetic(60, 24, 2, seed=57)
+    m = ModelConfig(num_shards=2, factors_per_shard=2, rho=0.7)
+    return Y, m
+
+
+def test_early_stop_off_bitwise_identical():
+    """Spelling out early_stop="off" (plus its inert thresholds) must be
+    bitwise-identical to a config that never mentions the feature - the
+    machinery is flag-gated out of the loop, not 'usually harmless'."""
+    Y, m = _es_shape()
+    run_plain = RunConfig(burnin=40, mcmc=80, thin=1, seed=0,
+                          chunk_size=40, num_chains=2)
+    run_off = dataclasses.replace(run_plain, early_stop="off",
+                                  rhat_threshold=1.2, ess_target=5.0)
+    res_plain = fit(Y, FitConfig(model=m, run=run_plain))
+    res_off = fit(Y, FitConfig(model=m, run=run_off))
+    np.testing.assert_array_equal(res_plain.sigma_blocks,
+                                  res_off.sigma_blocks)
+    np.testing.assert_array_equal(res_plain.traces, res_off.traces)
+    assert res_off.stopped_at_iter is None
+    assert res_off.rhat_trajectory is None
+
+
+def test_early_stop_truncates_and_matches_short_schedule():
+    """A triggered stop at iteration T must equal a run CONFIGURED for T
+    total iterations bitwise (per-iteration keys derive from the global
+    iteration; the fetch window divisor is recomputed for the truncated
+    count) - truncation is a schedule change, not a different chain."""
+    Y, m = _es_shape()
+    run_es = RunConfig(burnin=40, mcmc=400, thin=1, seed=0,
+                       chunk_size=40, num_chains=2, early_stop="rhat",
+                       rhat_threshold=1.5, ess_target=30.0)
+    res = fit(Y, FitConfig(model=m, run=run_es))
+    stopped = res.stopped_at_iter
+    assert stopped is not None and stopped < run_es.total_iters
+    assert stopped % 40 == 0                     # a chunk boundary
+    run_short = RunConfig(burnin=40, mcmc=stopped - 40, thin=1, seed=0,
+                          chunk_size=40, num_chains=2)
+    res_short = fit(Y, FitConfig(model=m, run=run_short))
+    np.testing.assert_array_equal(res.sigma_blocks,
+                                  res_short.sigma_blocks)
+    np.testing.assert_array_equal(res.traces, res_short.traces)
+
+
+def test_early_stop_checkpoint_resumes_to_full_schedule(tmp_path):
+    """The truncated run's checkpoint is a normal checkpoint: resuming
+    it with early_stop="off" and the original schedule continues the
+    SAME chain to the full length, bitwise equal to an uninterrupted
+    full run."""
+    Y, m = _es_shape()
+    ck = str(tmp_path / "es.ck.npz")
+    run_es = RunConfig(burnin=40, mcmc=160, thin=1, seed=0,
+                       chunk_size=40, num_chains=2, early_stop="rhat",
+                       rhat_threshold=1.5, ess_target=30.0)
+    res_es = fit(Y, FitConfig(model=m, run=run_es, checkpoint_path=ck,
+                              checkpoint_every_chunks=1))
+    assert res_es.stopped_at_iter is not None
+    assert res_es.stopped_at_iter < run_es.total_iters
+
+    run_full = dataclasses.replace(run_es, early_stop="off")
+    res_resumed = fit(Y, FitConfig(model=m, run=run_full,
+                                   checkpoint_path=ck, resume=True))
+    res_full = fit(Y, FitConfig(model=m, run=run_full))
+    np.testing.assert_array_equal(res_full.sigma_blocks,
+                                  res_resumed.sigma_blocks)
+    # A resumed run's traces cover only the iterations it executed
+    # itself (post-resume) - compare that window against the tail of
+    # the uninterrupted run, which must match bitwise.
+    n_post = res_resumed.traces.shape[1]
+    assert 0 < n_post < res_full.traces.shape[1]
+    np.testing.assert_array_equal(res_full.traces[:, -n_post:],
+                                  res_resumed.traces)
+
+
+def test_rhat_trajectory_recorded_and_monotone(tmp_path):
+    """The decision trail: one row per evaluated boundary, iteration
+    column strictly increasing, stop point == the last boundary, and
+    the flight recorder narrates why the run ended."""
+    Y, m = _es_shape()
+    run_es = RunConfig(burnin=40, mcmc=400, thin=1, seed=0,
+                       chunk_size=40, num_chains=2, early_stop="rhat",
+                       rhat_threshold=1.5, ess_target=30.0)
+    res = fit(Y, FitConfig(model=m, run=run_es,
+                           obs=str(tmp_path / "obs")))
+    traj = res.rhat_trajectory
+    assert traj is not None and traj.ndim == 2 and traj.shape[1] == 3
+    iters = traj[:, 0]
+    assert (np.diff(iters) > 0).all()            # strictly increasing
+    assert int(iters[-1]) == res.stopped_at_iter
+    # the deciding boundary's metrics actually met the thresholds
+    assert traj[-1, 1] < run_es.rhat_threshold
+    assert traj[-1, 2] >= run_es.ess_target
+    # traces really truncated at the stop point
+    assert res.traces.shape == (2, res.stopped_at_iter, 4)
+    # flight recorder: the early_stop event landed with the decision
+    assert res.events_path is not None
+    events = []
+    for name in os.listdir(res.events_path):
+        if name.endswith(".jsonl"):
+            with open(os.path.join(res.events_path, name)) as fh:
+                events += [json.loads(line) for line in fh if line.strip()]
+    stops = [e for e in events if e.get("event") == "early_stop"]
+    assert len(stops) == 1
+    assert stops[0]["iteration"] == res.stopped_at_iter
+
+
+def test_early_stop_config_validation():
+    Y, m = _es_shape()
+    with pytest.raises(ValueError, match="early_stop"):
+        fit(Y, FitConfig(model=m, run=RunConfig(
+            burnin=10, mcmc=10, thin=1, early_stop="sometimes")))
+    with pytest.raises(ValueError, match="num_chains"):
+        fit(Y, FitConfig(model=m, run=RunConfig(
+            burnin=10, mcmc=10, thin=1, early_stop="rhat",
+            num_chains=1)))
+    with pytest.raises(ValueError, match="store_draws"):
+        validate(FitConfig(model=m, run=RunConfig(
+            burnin=10, mcmc=10, thin=1, early_stop="rhat", num_chains=2,
+            chunk_size=10, store_draws=True)), 60, 24)
+
+
+# ---------------------------------------------------------------------------
+# SIGKILL mid-run with chains >= 2 (crash-isolated lane in CI)
+# ---------------------------------------------------------------------------
+
+def test_midrun_sigkill_supervised_resume_pooled_sigma(tmp_path,
+                                                       monkeypatch):
+    """A kill_event lands at a chunk boundary of a 2-chain run; the
+    supervisor relaunches, the resumed child continues BOTH chains from
+    the checkpoint, and the pooled Sigma is BIT-IDENTICAL to an
+    uninterrupted run."""
+    from dcfm_tpu.resilience import supervise
+
+    Y, _ = make_synthetic(n=40, p=24, k_true=3, seed=7)
+    small = dict(model=ModelConfig(num_shards=2, factors_per_shard=3,
+                                   rho=0.8),
+                 run=RunConfig(burnin=16, mcmc=16, thin=2, seed=3,
+                               chunk_size=8, num_chains=2))
+    ref = fit(Y, FitConfig(**small))
+
+    ck = str(tmp_path / "chains.ck.npz")
+    cfg = FitConfig(**small, checkpoint_path=ck,
+                    checkpoint_every_chunks=1, checkpoint_keep_last=2)
+    monkeypatch.setenv("JAX_COMPILATION_CACHE_DIR",
+                       os.path.join(REPO, ".jax_cache"))
+    monkeypatch.setenv(faults.ENV_VAR, json.dumps({"faults": [
+        {"op": "kill", "at_iteration": 16, "when": "post_save",
+         "at_launch": 1}]}))
+    # the PARENT must not execute the plan: neutralize it in-process
+    faults.install({"faults": []})
+    res = supervise(Y, cfg, backoff_base=0.05)
+    assert res.supervise_report.launches == 2
+    assert res.supervise_report.deaths[0][0] == -9   # a real SIGKILL
+    np.testing.assert_array_equal(res.Sigma, ref.Sigma)
+    np.testing.assert_array_equal(res.sigma_blocks, ref.sigma_blocks)

@@ -11,6 +11,7 @@ import pytest
 
 from dcfm_tpu import BackendConfig, FitConfig, ModelConfig, RunConfig, fit
 from dcfm_tpu.config import validate
+from dcfm_tpu.utils.estimate import _pool_chain_axis
 
 
 def _data(n=50, p=48, k_true=2, seed=0):
@@ -50,11 +51,13 @@ def _plain_sigma_from_draws(draws, rho):
 def test_draw_shapes_and_exact_reconstruction():
     Y = _data()
     res = fit(Y, _cfg(estimator="plain"))
-    d = res.draws
+    # draws are ALWAYS chain-major (FitResult.draws): a single-chain run
+    # carries a length-1 leading axis
     S = res.config.run.num_saved
-    assert d["Lambda"].shape == (S, 4, 12, 2)
-    assert d["ps"].shape == (S, 4, 12)
-    assert d["X"].shape == (S, 50, 2)
+    assert res.draws["Lambda"].shape == (1, S, 4, 12, 2)
+    assert res.draws["ps"].shape == (1, S, 4, 12)
+    assert res.draws["X"].shape == (1, S, 50, 2)
+    d = _pool_chain_axis(res.draws)
     assert all(np.isfinite(v).all() for v in d.values())
     # no stored draw is the all-zero placeholder (every slot was written)
     assert (np.abs(d["Lambda"]).sum(axis=(1, 2, 3)) > 0).all()
@@ -87,9 +90,9 @@ def test_scaled_draws_reconstruct_accumulator_exactly():
     mean == sigma_acc (VERDICT item 8)."""
     Y = _data()
     res = fit(Y, _cfg(estimator="scaled"))
-    d = res.draws
     S = res.config.run.num_saved
-    assert d["H"].shape == (S, 4, 4, 2, 2)
+    assert res.draws["H"].shape == (1, S, 4, 4, 2, 2)
+    d = _pool_chain_axis(res.draws)
     from dcfm_tpu.utils.estimate import stitch_blocks
     acc = stitch_blocks(res.sigma_blocks)
     rebuilt = _scaled_sigma_from_draws(d)
@@ -109,7 +112,8 @@ def test_draw_covariance_entries_match_reconstruction():
 
     Y = _data()
     res = fit(Y, _cfg())
-    full = _scaled_sigma_from_draws(res.draws)        # draw MEAN, (p, p)
+    full = _scaled_sigma_from_draws(
+        _pool_chain_axis(res.draws))                  # draw MEAN, (p, p)
     rows = np.array([0, 5, 13, 30, 47, 7])
     cols = np.array([0, 5, 40, 2, 47, 7])
     vals = draw_covariance_entries(res.draws, rows, cols)

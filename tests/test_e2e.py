@@ -26,10 +26,14 @@ def test_single_shard_recovers_sigma():
     Y, St = make_synthetic(200, 64, 4, seed=1)
     cfg = FitConfig(
         model=ModelConfig(num_shards=1, factors_per_shard=5, rho=0.5),
-        run=RunConfig(burnin=300, mcmc=300, thin=1, seed=0))
+        run=RunConfig(burnin=300, mcmc=300, thin=1, seed=0, num_chains=2))
     res = fit(Y, cfg)
     assert res.Sigma.shape == (64, 64)
-    assert _rel_frob(res.Sigma, St) < 0.25
+    # chain-pooled Sigma (num_chains=2): pooling averages out the MC
+    # jitter the old single-chain 0.25 bound had to absorb.  Measured
+    # pooled error 0.135 (bias-dominated: C=1/2/4 all land 0.134-0.135),
+    # so 0.20 keeps 1.5x headroom while actually guarding accuracy.
+    assert _rel_frob(res.Sigma, St) < 0.20
     # diagnostics populated and finite
     assert np.isfinite(res.stats.tau_log_max)
     assert res.stats.ps_min > 0
@@ -39,14 +43,17 @@ def test_multishard_recovers_sigma():
     Y, St = make_synthetic(150, 96, 4, seed=3)
     cfg = FitConfig(
         model=ModelConfig(num_shards=4, factors_per_shard=4, rho=0.95),
-        run=RunConfig(burnin=300, mcmc=300, thin=2, seed=0))
+        run=RunConfig(burnin=300, mcmc=300, thin=2, seed=0, num_chains=2))
     res = fit(Y, cfg)
     err = _rel_frob(res.Sigma, St)
-    # D&C approximates cross-blocks by rho*Lam_r Hx Lam_c'; looser than g=1
-    assert err < 0.35
+    # D&C approximates cross-blocks by rho*Lam_r Hx Lam_c'; looser than
+    # g=1.  Chain-pooled (num_chains=2): measured 0.164 full / 0.102
+    # diagonal, bias-dominated (stable across C=1/2/4), so the bounds
+    # tighten from the single-chain 0.35/0.2 with ~1.5x headroom kept.
+    assert err < 0.25
     # diagonal entries (variances) must be solid regardless
     diag_err = _rel_frob(np.diag(np.diag(res.Sigma)), np.diag(np.diag(St)))
-    assert diag_err < 0.2
+    assert diag_err < 0.15
 
 
 def test_parity_with_numpy_twin():

@@ -231,6 +231,26 @@ def test_poll_rule_skips_variable_condition_loops():
                    for f in lint_source(src, "mod.py"))
 
 
+def test_bad_chainaxis_fires_1401():
+    assert _rules_fired("bad_chainaxis.py") == {"DCFM1401"}
+
+
+def test_bad_chainaxis_flags_every_reduction_shape():
+    findings = lint_file(os.path.join(FIXTURES, "bad_chainaxis.py"))
+    # np.mean no-axis, .mean(axis=0), np.sum(axis=0), .sum() no-axis
+    assert len([f for f in findings if f.rule == "DCFM1401"]) == 4
+
+
+def test_chainaxis_rule_skips_chain_named_functions():
+    """A helper whose own name contains 'chain' IS the sanctioned
+    pooling seam: the identical reduction is quiet inside it."""
+    src = ("import numpy as np\n"
+           "def pool_chains(chain_major):\n"
+           "    return np.asarray(chain_major).mean(axis=0)\n")
+    assert not any(f.rule == "DCFM1401"
+                   for f in lint_source(src, "mod.py"))
+
+
 def test_bad_locks_fires_1101_1102():
     assert _rules_fired("bad_locks.py") == {"DCFM1101", "DCFM1102"}
 
@@ -290,7 +310,7 @@ def test_every_rule_family_has_a_firing_fixture():
     "good_thread.py", "good_server.py", "good_robust.py",
     "good_multihost.py", "good_runtime.py", "good_obs.py",
     "good_handler.py", "good_locks.py", "good_lifetime.py",
-    "good_pragma.py", "good_poll.py"])
+    "good_pragma.py", "good_poll.py", "good_chainaxis.py"])
 def test_good_fixture_is_clean(name):
     findings = lint_file(os.path.join(FIXTURES, name))
     assert findings == [], [str(f) for f in findings]

@@ -51,24 +51,24 @@ def test_plain_estimator_twin_parity():
     cfg = FitConfig(
         model=ModelConfig(num_shards=g, factors_per_shard=K, rho=rho,
                           estimator="plain"),
-        run=RunConfig(burnin=400, mcmc=400, thin=1, seed=0))
+        run=RunConfig(burnin=400, mcmc=400, thin=1, seed=0, num_chains=4))
     res = fit(Y, cfg)
     S_np = stitch_blocks(blocks_np)
     S_jx = stitch_blocks(res.sigma_blocks.astype(np.float64))
     # Looser than the scaled-estimator parity test (0.05): the plain rule is
-    # NOT invariant to the slow-mixing Lambda<->eta scale ridge, so two
-    # independent chains' Monte Carlo averages sit at visibly different
-    # ridge points.  Measured spread at this schedule (400+400): the twin
-    # against ITSELF across seeds 1-5 lands at 0.083-0.156 rel Frobenius,
-    # and the jax chain against those twins at 0.089-0.151 - i.e. the jax
-    # sampler agrees with the twin exactly as well as the twin agrees with
-    # itself, which is all "parity" can mean for a ridge-sensitive rule.
-    # The bound is set above the measured cross-chain maximum (0.156); the
-    # old 0.12 sat INSIDE the Monte Carlo spread and failed or passed by
-    # seed luck.  (Exactness of the plain rule itself is pinned separately:
+    # NOT invariant to the slow-mixing Lambda<->eta scale ridge, so a single
+    # chain's Monte Carlo average sits wherever its ridge walk happened to
+    # wander.  De-flaked by cross-chain pooling: sigma_blocks is the
+    # equal-weight average over num_chains=4 independent chains, so the
+    # pooled estimate averages four independent ridge points instead of
+    # betting the test on one.  Measured at this schedule (400+400, seed
+    # 0): pooled-vs-twin 0.073 (single chain: 0.123) - the 0.15 bound has
+    # 2x headroom over the pooled measurement where the old single-chain
+    # 0.20 had 1.6x, and the pooled statistic is stabler by construction.
+    # (Exactness of the plain rule itself is pinned separately:
     # tests/test_draws.py rebuilds the accumulated plain Sigma from the
     # stored draws with the reference formula to 2e-4.)
-    assert _rel_frob(S_jx, S_np) < 0.20
+    assert _rel_frob(S_jx, S_np) < 0.15
 
 
 def test_plain_vs_scaled_differ_offdiagonal():
