@@ -56,6 +56,98 @@ ES_ESS = float(os.environ.get("BENCH_ES_ESS", 300.0))
 SERVE_QUERIES = int(os.environ.get("BENCH_SERVE_QUERIES", 2000))
 SERVE_CLIENTS = int(os.environ.get("BENCH_SERVE_CLIENTS", 8))
 
+# Ingest-phase probe shape (scale-out ingestion, ROADMAP item 5): the
+# streaming sparse preprocess vs the dense pipeline on the SAME logical
+# matrix, each in its own subprocess so ru_maxrss is a clean per-pipeline
+# high-water mark (the parent's accumulated RSS would mask both).
+# BENCH_INGEST=0 disables; the wall/RSS gates only bind at the default
+# shape, where the dense pipeline's working set (~150 MB of (n, p) copies
+# at p=2e5) towers over the streaming pass's block scratch.
+INGEST_P = int(os.environ.get("BENCH_INGEST_P", 200_000))
+INGEST_N = int(os.environ.get("BENCH_INGEST_N", 64))
+INGEST_DENSITY = float(os.environ.get("BENCH_INGEST_DENSITY", 0.01))
+
+
+def _ingest_probe(kind):
+    """Subprocess body of the ingest phase (``bench.py --ingest-probe
+    {sparse,dense}``): build the synthetic ~1%-density matrix, baseline
+    ``ru_maxrss`` AFTER the build (the input is the caller's to hold;
+    what the probe charges is the PIPELINE's working set), run the
+    streaming or dense preprocess over the same logical values, touch a
+    shard block so lazy output is proven usable, and print one JSON line
+    with the wall and the RSS delta.  Runs fresh per pipeline because
+    ru_maxrss is a process-lifetime high-water mark - inside the parent
+    bench the dense phase's footprint would mask the sparse one."""
+    import resource
+
+    from dcfm_tpu.utils.preprocess import SparseMatrix, preprocess
+
+    n, p, density = INGEST_N, INGEST_P, INGEST_DENSITY
+    rng = np.random.default_rng(0)
+    counts = np.zeros(p, np.int64)
+    rows_parts, data_parts = [], []
+    for lo in range(0, p, 50_000):
+        w = min(50_000, p - lo)
+        m = rng.random((n, w)) < density
+        empty = np.flatnonzero(~m.any(axis=0))
+        if empty.size:                 # >= 1 entry/col: every column kept
+            m[rng.integers(0, n, empty.size), empty] = True
+        cols_b, rows_b = np.nonzero(m.T)
+        counts[lo:lo + w] = np.bincount(cols_b, minlength=w)
+        rows_parts.append(rows_b.astype(np.int64))
+        data_parts.append(rng.standard_normal(rows_b.size).astype(np.float32))
+    indptr = np.zeros(p + 1, np.int64)
+    np.cumsum(counts, out=indptr[1:])
+    indices = np.concatenate(rows_parts)
+    data = np.concatenate(data_parts)
+    stored_mb = (data.nbytes + indices.nbytes + indptr.nbytes) / 1e6
+    if kind == "sparse":
+        inp = SparseMatrix(indptr=indptr, indices=indices, data=data,
+                           shape=(n, p), format="csc")
+    else:
+        inp = np.zeros((n, p), np.float32)
+        inp[indices, np.repeat(np.arange(p, dtype=np.int64),
+                               np.diff(indptr))] = data
+    g = max(-(-p // 196), 1)
+
+    base_kb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    t0 = time.perf_counter()
+    pre = preprocess(inp, g, seed=0)
+    blk = pre.data.block(0) if pre.is_lazy else pre.data[0]
+    wall_s = time.perf_counter() - t0
+    peak_kb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    assert np.isfinite(blk).all() and pre.is_lazy == (kind == "sparse")
+    print(json.dumps({
+        "kind": kind, "p": p, "n": n, "p_used": pre.p_used,
+        "nnz": int(indptr[-1]), "stored_mb": round(stored_mb, 2),
+        "wall_s": round(wall_s, 4),
+        "MBps": round(stored_mb / max(wall_s, 1e-9), 1),
+        "rss_delta_kb": int(peak_kb - base_kb)}))
+    return 0
+
+
+def _run_ingest_phase():
+    """Parent side of the ingest phase: one subprocess per pipeline,
+    CPU-pinned (the preprocess is host-side numpy; no device needed)."""
+    import subprocess
+
+    out = {}
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["PYTHONPATH"] = os.pathsep.join(
+        [os.path.dirname(os.path.abspath(__file__))]
+        + [q for q in env.get("PYTHONPATH", "").split(os.pathsep) if q])
+    for kind in ("sparse", "dense"):
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--ingest-probe", kind],
+            capture_output=True, text=True, timeout=900, env=env)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"ingest probe ({kind}) failed rc={proc.returncode}:\n"
+                f"{proc.stdout[-2000:]}\n{proc.stderr[-2000:]}")
+        out[kind] = json.loads(proc.stdout.strip().splitlines()[-1])
+    return out
+
 
 def _serve_probe(res):
     """One serve-phase round: export `res` to a fresh artifact, start the
@@ -446,6 +538,12 @@ def main():
     # served latency), one round at the small probe shape.
     refit = _refit_probe()
 
+    # Ingest-phase probe (scale-out ingestion): streaming sparse vs dense
+    # preprocess of the same logical ~1%-density matrix, one subprocess
+    # each for clean ru_maxrss high-water marks.  Host CPU only.
+    ingest = (None if os.environ.get("BENCH_INGEST", "1") == "0"
+              else _run_ingest_phase())
+
     # ESS/s on the chain traces (utils/diagnostics.ess via
     # FitResult.diagnostics): iterations/sec says nothing about MIXING -
     # a sampler change can keep iters/s and halve the information per
@@ -599,6 +697,18 @@ def main():
         "refit_cold_s": round(refit["refit_cold_s"], 2),
         "warm_cold_ratio": round(refit["warm_cold_ratio"], 4),
         "data_to_serving_s": round(refit["data_to_serving_s"], 2),
+        # Ingest phase (null under BENCH_INGEST=0): streaming sparse vs
+        # dense preprocess of the same logical matrix, each pipeline's
+        # wall + subprocess-clean peak-RSS delta.  ingest_s/ingest_MBps
+        # are the sparse pipeline's numbers (stored bytes per second);
+        # peak_rss_mb pairs both pipelines so the O(n*p)-vs-O(block)
+        # working-set gap is in the record, not just the gate.
+        "ingest_s": (ingest["sparse"]["wall_s"] if ingest else None),
+        "ingest_MBps": (ingest["sparse"]["MBps"] if ingest else None),
+        "ingest_peak_rss_mb": (
+            {k: round(v["rss_delta_kb"] / 1024, 1)
+             for k, v in ingest.items()} if ingest else None),
+        "ingest": ingest,
         # Chains-packing probe (null when the device count can't express
         # the 4-packed-vs-quarter-mesh comparison): per-iteration cost
         # ratio of 4 packed chains to 1 chain with the same per-device
@@ -691,6 +801,30 @@ def main():
     #   1.35x allows real row interference (shared HBM bandwidth, the
     #   trace fetch) while failing a layout that serializes chains
     #   (~4x).  Skipped when the device count can't express the probe.
+    # * ingest: the streaming pass earns its keep only if it beats the
+    #   dense pipeline's working set AND stays in the same wall-clock
+    #   class.  At the default probe shape the dense preprocess holds
+    #   ~150 MB of (n, p) copies while the streaming pass holds one
+    #   column block - an RSS delta at or above dense means the sparse
+    #   path silently densified.  2x wall headroom: the streaming pass
+    #   does gather work per block the dense path amortizes, but an
+    #   order-of-magnitude slip means the one-pass structure broke.
+    default_ingest = (INGEST_P, INGEST_N, INGEST_DENSITY) == (
+        200_000, 64, 0.01)
+    if ingest is not None and default_ingest:
+        sp_probe, de_probe = ingest["sparse"], ingest["dense"]
+        if sp_probe["rss_delta_kb"] >= de_probe["rss_delta_kb"]:
+            print(f"INGEST RSS REGRESSION: streaming preprocess peak-RSS "
+                  f"delta {sp_probe['rss_delta_kb']} kB >= dense "
+                  f"{de_probe['rss_delta_kb']} kB - the sparse path is "
+                  f"densifying", file=sys.stderr)
+            status = 1
+        if sp_probe["wall_s"] > 2.0 * de_probe["wall_s"]:
+            print(f"INGEST WALL REGRESSION: streaming preprocess "
+                  f"{sp_probe['wall_s']:.3f}s > 2x dense "
+                  f"{de_probe['wall_s']:.3f}s at the probe shape",
+                  file=sys.stderr)
+            status = 1
     if pack is not None and pack["ratio"] > 1.35:
         print(f"CHAIN PACKING REGRESSION: packed/single chain_s ratio "
               f"{pack['ratio']:.3f} > 1.35 (packed "
@@ -719,4 +853,6 @@ def main():
 
 
 if __name__ == "__main__":
+    if len(sys.argv) > 2 and sys.argv[1] == "--ingest-probe":
+        sys.exit(_ingest_probe(sys.argv[2]))
     sys.exit(main())

@@ -1372,6 +1372,60 @@ def _check_chain_reductions(mod: _Module, rep: _Reporter) -> None:
 
 
 # =====================================================================
+# DCFM1501 - dense-quadratic materialization
+# =====================================================================
+
+_ALLOC_FNS = frozenset(
+    f"{m}.{a}" for m in ("numpy", "jax.numpy")
+    for a in ("zeros", "empty", "ones", "full"))
+
+
+def _check_dense_quadratic(mod: _Module, rep: _Reporter) -> None:
+    """DCFM1501: an allocation whose shape tuple repeats a symbolic
+    dimension - the (p, p) / (pairs, P, P) dense-buffer signature.  At
+    the scale-out shapes the streaming ingest targets (p >= 1e6) such a
+    buffer is hundreds of GB of host RAM, so library code routes
+    through the packed-panel seams; the handful of sanctioned assembly
+    sites (force=True restores, the reference implementation, device-
+    side packed accumulators) carry inline pragmas.  Constant dims are
+    ignored: np.zeros((3, 3)) repeats no *symbol*."""
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        if mod.resolve(node.func) not in _ALLOC_FNS:
+            continue
+        shape = node.args[0]
+        if not isinstance(shape, ast.Tuple) or len(shape.elts) < 2:
+            continue
+        dims = [(ast.dump(e), getattr(e, "lineno", None))
+                for e in shape.elts if not isinstance(e, ast.Constant)]
+        seen: dict = {}
+        repeated = None
+        for dump, _ in dims:
+            if dump in seen:
+                repeated = dump
+                break
+            seen[dump] = True
+        if repeated is None:
+            continue
+        try:
+            dim_src = ast.unparse(
+                next(e for e in shape.elts
+                     if not isinstance(e, ast.Constant)
+                     and ast.dump(e) == repeated))
+        except Exception:  # dcfm: ignore[DCFM601] - cosmetic unparse only; the finding still emits
+            dim_src = "<dim>"
+        rep.emit(
+            "DCFM1501", node,
+            f"shape tuple repeats the symbolic dimension '{dim_src}' - "
+            "a dense O(d^2) buffer that is hundreds of GB at the "
+            "scale-out shapes (p >= 1e6) the streaming ingest "
+            "supports.  Route through the packed-panel / sigma_block / "
+            "artifact seams, or annotate a sanctioned assembly site "
+            "with `# dcfm: ignore[DCFM1501] - <why>`")
+
+
+# =====================================================================
 # DCFM002 - stale suppressions
 # =====================================================================
 
@@ -1435,6 +1489,7 @@ def lint_source(source: str, path: str = "<string>",
     check_locks(mod, rep, project)
     check_lifetime(mod, rep, project)
     _check_chain_reductions(mod, rep)
+    _check_dense_quadratic(mod, rep)
     _check_stale_pragmas(mod, rep)      # must stay last: reads the ledger
     rep.findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return rep.findings

@@ -218,6 +218,19 @@ RULES = {r.id: r for r in [
          "_owned_copy_jit / _copy_tree / np.ascontiguousarray while "
          "the source is still alive",
          library_only=True),
+    # ---- DCFM15xx: scale-out discipline ------------------------------
+    Rule("DCFM1501", "dense-quadratic-materialization", "scale",
+         "a host allocation (np/jnp zeros/empty/ones/full) whose shape "
+         "tuple repeats the same symbolic dimension - an O(d^2) dense "
+         "buffer such as (p, p) or (n_pairs, P, P) with a repeated "
+         "panel axis.  At the scale-out shapes the streaming ingest "
+         "targets (p >= 1e6) a quadratic host buffer is hundreds of GB, "
+         "so library code must route through the packed-panel / "
+         "sigma_block / artifact seams instead of densifying.  The few "
+         "sanctioned assembly sites (the materialize_sigma='always' "
+         "path, force=True restores) carry an inline "
+         "`# dcfm: ignore[DCFM1501] - <why>`",
+         library_only=True),
     # ---- DCFM14xx: chain-axis reduction discipline -------------------
     Rule("DCFM1401", "chain-axis-silent-reduction", "chains",
          "a host-side reduction (np.mean/np.sum or .mean()/.sum()) "

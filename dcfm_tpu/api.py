@@ -47,7 +47,8 @@ from dcfm_tpu.models.state import num_upper_pairs, packed_pair_indices
 from dcfm_tpu.parallel.mesh import (
     make_chain_mesh, make_mesh, shards_per_device)
 from dcfm_tpu.parallel.multihost import place_sharded_global
-from dcfm_tpu.parallel.shard import build_mesh_chain, place_sharded
+from dcfm_tpu.parallel.shard import (
+    build_mesh_chain, place_sharded, place_sharded_streaming)
 from dcfm_tpu.runtime.fetch import (
     accumulator_window, assemble_q8_sigma, cast_f32_jit, cast_for_link,
     fetch_jit, fetch_sd_jit, owned_copy_jit, pool_chains, quant8_drain,
@@ -60,8 +61,14 @@ from dcfm_tpu.utils.estimate import (
     assemble_from_upper, dequantize_panels, draw_covariance_entries,
     full_blocks_from_upper)
 from dcfm_tpu.utils.preprocess import (
-    PreprocessResult, caller_to_shard_index, preprocess,
-    restore_data_matrix)
+    LazyMaterializationError, PreprocessResult, caller_to_shard_index,
+    is_streaming_input, preprocess, restore_data_matrix)
+
+# materialize_sigma="auto" densifies the (p, p) posterior mean only up to
+# this many (used) columns AND only for eagerly-ingested (dense) inputs;
+# past it - or on any sparse/out-of-core ingest - fit() keeps the packed
+# panels and serves Sigma through .sigma_block / the serve artifact.
+_AUTO_MATERIALIZE_MAX_P = 100_000
 
 
 @dataclasses.dataclass
@@ -78,9 +85,14 @@ class FitResult:
     the time this object exists (:attr:`artifact_path`).
     """
 
-    Sigma: np.ndarray              # (p, p) posterior-mean covariance in the
-                                   # caller's coordinates (de-permuted,
-                                   # de-standardized, zero cols reinserted)
+    # (p, p) posterior-mean covariance in the caller's coordinates
+    # (de-permuted, de-standardized, zero cols reinserted) - or None when
+    # the fit skipped the dense assembly (FitConfig.materialize_sigma:
+    # "never", or "auto" with a sparse/out-of-core input or
+    # p_used > api._AUTO_MATERIALIZE_MAX_P).  The posterior is still fully
+    # held as packed panels: query blocks via .sigma_block or export the
+    # serve artifact.
+    Sigma: Optional[np.ndarray]
     preprocess: PreprocessResult
     state: Any                     # final SamplerState (host pytree); leaves
                                    # gain a leading chain axis if num_chains>1
@@ -246,10 +258,45 @@ class FitResult:
                                       self.config.model.num_shards)
 
     def covariance(self, *, destandardize=True, reinsert_zero_cols=False):
+        # a lazily-ingested fit refuses the dense assembly unless the
+        # config opted into it (materialize_sigma="always")
         return assemble_from_upper(
             self.upper_panels, self.preprocess,
             destandardize=destandardize,
-            reinsert_zero_cols=reinsert_zero_cols)
+            reinsert_zero_cols=reinsert_zero_cols,
+            force=self.config.materialize_sigma == "always")
+
+    def sigma_block(self, i: int, j: int, *,
+                    destandardize: bool = True) -> np.ndarray:
+        """The (P, P) posterior-mean covariance block for shard pair
+        (i, j) WITHOUT assembling the dense (p, p) matrix - the query
+        path for lazy results (``.Sigma is None``).
+
+        Coordinates are SHARD coordinates: row axis is shard ``i``'s P
+        columns, col axis shard ``j``'s (permuted / padded; map caller
+        columns with utils.preprocess.caller_to_shard_index).  Blocks
+        come from the packed upper panels: (j, i) is served as the
+        transpose of (i, j), and diagonal blocks are symmetrized exactly
+        as the dense assembly does (estimate.full_blocks_from_upper).
+        ``destandardize`` scales rows by shard i's col_scale and columns
+        by shard j's, matching dense-Sigma entries bit-for-bit on the
+        native-free path.
+        """
+        g = self.config.model.num_shards
+        if not (0 <= i < g and 0 <= j < g):
+            raise IndexError(f"shard pair ({i}, {j}) out of range for "
+                             f"g={g} shards")
+        lo, hi = (i, j) if i <= j else (j, i)
+        pair = lo * g - lo * (lo - 1) // 2 + (hi - lo)
+        block = np.array(self.upper_panels[pair], np.float32, copy=True)
+        if i == j:
+            block = 0.5 * (block + block.T)
+        elif i > j:
+            block = np.ascontiguousarray(block.T)
+        if destandardize:
+            scale = np.asarray(self.preprocess.col_scale, np.float32)
+            block *= scale[i][:, None] * scale[j][None, :]
+        return block
 
     def covariance_credible_interval(self, rows, cols, *, alpha=0.05,
                                      destandardize=True):
@@ -316,7 +363,8 @@ class FitResult:
         return assemble_from_upper(
             self.sd_upper_panels, self.preprocess,
             destandardize=destandardize,
-            reinsert_zero_cols=reinsert_zero_cols)
+            reinsert_zero_cols=reinsert_zero_cols,
+            force=self.config.materialize_sigma == "always")
 
 
 @functools.lru_cache(maxsize=32)
@@ -503,10 +551,21 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
 
 def _fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
     """The fit body (``fit`` wraps it with the flight-recorder session)."""
-    Y = np.asarray(Y)  # dcfm: ignore[DCFM701] - Y is the caller's host matrix, never a global array
-    if Y.ndim != 2:
-        raise ValueError(f"Y must be an (n, p) matrix, got shape {Y.shape}")
-    n, p = Y.shape
+    if is_streaming_input(Y):
+        # Sparse / out-of-core ingest (utils/preprocess.SparseMatrix,
+        # scipy.sparse, np.memmap): never densified here - preprocess
+        # streams it column-wise, and the host only ever holds per-shard
+        # (n, P) blocks at device-placement time.
+        if len(Y.shape) != 2:
+            raise ValueError(
+                f"Y must be an (n, p) matrix, got shape {tuple(Y.shape)}")
+        n, p = (int(d) for d in Y.shape)
+    else:
+        Y = np.asarray(Y)  # dcfm: ignore[DCFM701] - Y is the caller's host matrix, never a global array
+        if Y.ndim != 2:
+            raise ValueError(
+                f"Y must be an (n, p) matrix, got shape {Y.shape}")
+        n, p = Y.shape
     validate(cfg, n, p)
     m, run = cfg.model, cfg.run
 
@@ -516,6 +575,15 @@ def _fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
         permute=cfg.permute, standardize=cfg.standardize,
         pad_to_shards=cfg.pad_to_shards, seed=run.seed)
     preprocess_s = time.perf_counter() - t_pre
+    # Dense (p, p) posterior-mean assembly decision (FitConfig.
+    # materialize_sigma).  "auto" keeps the pre-scale-out behavior for
+    # eager (dense) inputs up to _AUTO_MATERIALIZE_MAX_P used columns and
+    # skips the quadratic assembly otherwise; the packed panels always
+    # survive in the FitResult, so .sigma_block and export_artifact work
+    # either way.
+    want_sigma = (cfg.materialize_sigma == "always"
+                  or (cfg.materialize_sigma == "auto" and not pre.is_lazy
+                      and pre.p_used <= _AUTO_MATERIALIZE_MAX_P))
     if pre.n_missing and not m.impute_missing:
         # NaN entries in Y: enable the per-sweep imputation site
         # (models/conditionals.impute_missing_y).  Applied to the internal
@@ -664,9 +732,17 @@ def _fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
                     else make_mesh(n_mesh, devices))
             shards_per_device(m.num_shards, mesh)  # validates divisibility
             t_up = time.perf_counter()
-            Y_up = upload_host_array(pre.data, cfg.backend.upload_dtype)
-            Yd = (place_sharded_global(Y_up, mesh) if multiproc
-                  else place_sharded(Y_up, mesh))
+            if pre.is_lazy:
+                # Streaming placement: per-device (shards, n, P) blocks
+                # materialize one at a time and are dropped once resident
+                # on device - host peak is O(n * P * shards_per_device),
+                # never the full (g, n, P) tensor.
+                Yd = place_sharded_streaming(
+                    pre.data, mesh, upload_dtype=cfg.backend.upload_dtype)
+            else:
+                Y_up = upload_host_array(pre.data, cfg.backend.upload_dtype)
+                Yd = (place_sharded_global(Y_up, mesh) if multiproc
+                      else place_sharded(Y_up, mesh))
             if Yd.dtype != jnp.float32:
                 Yd = cast_f32_jit()(Yd)  # jit preserves the sharding
             jax.block_until_ready(Yd)
@@ -706,7 +782,9 @@ def _fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
                 t_up = time.perf_counter()
                 Yd = jax.device_put(
                     jnp.asarray(upload_host_array(
-                        pre.data, cfg.backend.upload_dtype)), devices[0])
+                        pre.data.materialize() if pre.is_lazy
+                        else pre.data, cfg.backend.upload_dtype)),
+                    devices[0])
                 if Yd.dtype != jnp.float32:
                     Yd = cast_f32_jit()(Yd)
                 jax.block_until_ready(Yd)
@@ -830,7 +908,10 @@ def _fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
         # force impute_missing=True on complete data (the carry then has
         # the accumulator leaf), but the FitResult contract is "set when
         # the input had missing entries"
-        if carry.y_imp_acc is not None and pre.n_missing:
+        # ... and never on a lazy ingest: the completed matrix is the
+        # dense (n, p) allocation the streaming path exists to avoid
+        # (restore_data_matrix refuses it with LazyMaterializationError).
+        if carry.y_imp_acc is not None and pre.n_missing and not pre.is_lazy:
             yi = np.asarray(jax.device_get(
                 replicate_jit(mesh)(carry.y_imp_acc) if multiproc
                 else carry.y_imp_acc), np.float32)
@@ -930,28 +1011,34 @@ def _fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
                              / total_drain)) if total_drain > 0 else 0.0),
         }
         q8_panels, q8_scales = streamed["q8"], streamed["scales"]
-        t_as = time.perf_counter()
-        Sigma = assemble_q8_sigma(np.ascontiguousarray(q8_panels),
-                                  q8_scales, pre)
-        if Sigma is None:
-            # no native library: dequantize once, keep f32 panels (the
-            # landed buffer is already host memory - plain array or the
-            # artifact memmap)
-            upper = dequantize_panels(q8_panels, q8_scales)
-            q8_panels = q8_scales = None
-            Sigma = assemble_from_upper(upper, pre, reinsert_zero_cols=True)
-        phase["assemble_s"] += time.perf_counter() - t_as
+        Sigma = None
+        if want_sigma:
+            t_as = time.perf_counter()
+            Sigma = assemble_q8_sigma(np.ascontiguousarray(q8_panels),
+                                      q8_scales, pre)
+            if Sigma is None:
+                # no native library: dequantize once, keep f32 panels (the
+                # landed buffer is already host memory - plain array or the
+                # artifact memmap)
+                upper = dequantize_panels(q8_panels, q8_scales)
+                q8_panels = q8_scales = None
+                Sigma = assemble_from_upper(upper, pre,
+                                            reinsert_zero_cols=True,
+                                            force=True)
+            phase["assemble_s"] += time.perf_counter() - t_as
         if want_sd and streamed["sd_scales"] is not None:
             sd_q8, sd_q8_scales = streamed["sd_q8"], streamed["sd_scales"]
-            t_as = time.perf_counter()
-            Sigma_sd = assemble_q8_sigma(np.ascontiguousarray(sd_q8),
-                                         sd_q8_scales, pre)
-            if Sigma_sd is None:
-                sd_upper = dequantize_panels(sd_q8, sd_q8_scales)
-                sd_q8 = sd_q8_scales = None
-                Sigma_sd = assemble_from_upper(sd_upper, pre,
-                                               reinsert_zero_cols=True)
-            phase["assemble_s"] += time.perf_counter() - t_as
+            if want_sigma:
+                t_as = time.perf_counter()
+                Sigma_sd = assemble_q8_sigma(np.ascontiguousarray(sd_q8),
+                                             sd_q8_scales, pre)
+                if Sigma_sd is None:
+                    sd_upper = dequantize_panels(sd_q8, sd_q8_scales)
+                    sd_q8 = sd_q8_scales = None
+                    Sigma_sd = assemble_from_upper(sd_upper, pre,
+                                                   reinsert_zero_cols=True,
+                                                   force=True)
+                phase["assemble_s"] += time.perf_counter() - t_as
         if cfg.stream_artifact:
             # panels already landed in the artifact's memmaps; finalize
             # writes the O(p) maps + metadata - fit -> export is free
@@ -991,10 +1078,10 @@ def _fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
                                         inv_count, bessel)
             sd_started = quant8_start(qsd_dev, ssd_dev)
         Sigma, q8_panels, q8_scales, upper = quant8_fetch_assemble(
-            mean_started, q_dev.shape, pre, phase)
+            mean_started, q_dev.shape, pre, phase, assemble=want_sigma)
         if want_sd:
             Sigma_sd, sd_q8, sd_q8_scales, sd_upper = quant8_fetch_assemble(
-                sd_started, qsd_dev.shape, pre, phase)
+                sd_started, qsd_dev.shape, pre, phase, assemble=want_sigma)
         # += not =: on the drain-failure fallback the join wall already
         # spent blocked in finish() is in exposed_fetch_s and must not
         # be discarded (never-streamed runs start from 0.0, so += is
@@ -1004,19 +1091,24 @@ def _fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
         t_f = time.perf_counter()
         upper = _fetch_upper(carry.sigma_acc)
         phase["fetch_s"] += time.perf_counter() - t_f
-        t_as = time.perf_counter()
-        Sigma = assemble_from_upper(upper, pre, reinsert_zero_cols=True)
-        phase["assemble_s"] += time.perf_counter() - t_as
+        Sigma = None
+        if want_sigma:
+            t_as = time.perf_counter()
+            Sigma = assemble_from_upper(upper, pre, reinsert_zero_cols=True,
+                                        force=True)
+            phase["assemble_s"] += time.perf_counter() - t_as
         if want_sd:
             t_f = time.perf_counter()
             sd_upper = np.asarray(sd_fetch(
                 carry.sigma_acc, carry.sigma_sq_acc, inv_count,
                 bessel)).astype(np.float32, copy=False)
             phase["fetch_s"] += time.perf_counter() - t_f
-            t_as = time.perf_counter()
-            Sigma_sd = assemble_from_upper(sd_upper, pre,
-                                           reinsert_zero_cols=True)
-            phase["assemble_s"] += time.perf_counter() - t_as
+            if want_sigma:
+                t_as = time.perf_counter()
+                Sigma_sd = assemble_from_upper(sd_upper, pre,
+                                               reinsert_zero_cols=True,
+                                               force=True)
+                phase["assemble_s"] += time.perf_counter() - t_as
         phase["exposed_fetch_s"] += phase["fetch_s"]
 
     seconds = time.perf_counter() - t0
@@ -1060,18 +1152,26 @@ def _fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
         # resume that executed zero chunks, or a drain-failure fallback):
         # export post-hoc so the contract - the artifact exists at
         # stream_artifact after fit() returns - holds unconditionally.
-        # One writer on multi-process runs (the fetch is replicated),
-        # and a collective barrier before ANY process returns: without
-        # it a peer could hand the path to a consumer while process 0
-        # is still mid-write with meta.json deleted.  Like checkpoint
-        # discovery, this assumes a shared artifact filesystem.
-        if not multiproc or jax.process_index() == 0:
-            from dcfm_tpu.serve.artifact import export_fit_result
-            export_fit_result(res, cfg.stream_artifact)
+        # Multi-process runs assemble the artifact COOPERATIVELY: the
+        # fetch is replicated (every host holds the full panels), so
+        # each host writes only its contiguous pair-slice of the panel
+        # binaries and host 0 finishes maps + meta after a barrier -
+        # O(n_pairs / hosts) bytes written per host instead of one host
+        # streaming the whole thing (serve/artifact.py
+        # write_artifact_cooperative).  Like checkpoint discovery, this
+        # assumes a shared artifact filesystem.
         if multiproc:
             from jax.experimental import multihost_utils
-            multihost_utils.sync_global_devices(
-                "dcfm-stream-artifact-export")
+
+            from dcfm_tpu.serve.artifact import export_fit_result_cooperative
+            export_fit_result_cooperative(
+                res, cfg.stream_artifact,
+                process_index=jax.process_index(),
+                process_count=jax.process_count(),
+                barrier=multihost_utils.sync_global_devices)
+        else:
+            from dcfm_tpu.serve.artifact import export_fit_result
+            export_fit_result(res, cfg.stream_artifact)
         res.artifact_path = cfg.stream_artifact
     return res
 

@@ -20,9 +20,25 @@ import sys
 import numpy as np
 
 
-def _load(path: str) -> np.ndarray:
+def _load(path: str, *, sparse: bool = False, mmap: bool = False):
+    if sparse:
+        # scipy-format sparse container (scipy.sparse.save_npz); stays
+        # sparse through fit() - preprocess streams it column-wise and
+        # never densifies the (n, p) matrix on the host.
+        if not path.endswith(".npz"):
+            raise SystemExit(
+                f"--sparse expects a scipy.sparse .npz file, got {path}")
+        try:
+            from scipy import sparse as sp
+        except ImportError:
+            raise SystemExit(
+                "--sparse requires scipy (scipy.sparse.load_npz); "
+                "convert to dense .npy or install scipy")
+        return sp.load_npz(path)
     if path.endswith(".npy"):
-        return np.load(path)
+        # mmap keeps the file out-of-core: fit() streams per-shard
+        # columns instead of loading the whole (n, p) matrix
+        return np.load(path, mmap_mode="r" if mmap else None)
     if path.endswith(".csv"):
         return np.loadtxt(path, delimiter=",")
     raise SystemExit(f"unsupported input format: {path} (use .npy or .csv)")
@@ -217,6 +233,26 @@ def build_parser() -> argparse.ArgumentParser:
     f.add_argument("--ess-target", type=float, default=400.0,
                    help="early-stop pooled effective-sample-size target")
     f.add_argument("--seed", type=int, default=0)
+    f.add_argument("--sparse", action="store_true",
+                   help="input is a scipy-format sparse .npz "
+                        "(scipy.sparse.save_npz).  The matrix is ingested "
+                        "by the streaming preprocess - the dense (n, p) "
+                        "matrix never materializes on the host - and the "
+                        "fit defaults to the lazy posterior (no dense "
+                        "Sigma .npy; see --materialize-sigma)")
+    f.add_argument("--mmap", action="store_true",
+                   help="open a .npy input memory-mapped (out-of-core): "
+                        "preprocess streams columns from disk instead of "
+                        "loading the whole matrix")
+    f.add_argument("--materialize-sigma", default="auto",
+                   choices=["auto", "always", "never"],
+                   help="whether fit assembles the dense (p, p) posterior "
+                        "mean.  'auto' materializes for dense inputs up "
+                        "to 100k used columns and keeps sparse/mmap fits "
+                        "lazy; 'never' skips the quadratic assembly (no "
+                        "Sigma .npy is written - export an artifact "
+                        "instead); 'always' forces the dense matrix "
+                        "regardless of input")
     f.add_argument("--no-permute", action="store_true",
                    help="shard features in their given order instead of the "
                         "reference's random permutation.  When features have "
@@ -436,8 +472,13 @@ def main(argv=None) -> int:
     # everywhere); a no-op otherwise.
     initialize_from_env()
 
-    Y = _load(args.data)
-    if args.imputed_out and not np.isnan(Y).any():
+    Y = _load(args.data, sparse=args.sparse, mmap=args.mmap)
+    if args.imputed_out and (args.sparse or args.mmap):
+        # the completed (n, p) matrix is exactly the dense allocation the
+        # streaming ingest exists to avoid
+        raise SystemExit("--imputed-out is unsupported with --sparse/"
+                         "--mmap (the completed matrix is dense (n, p))")
+    if args.imputed_out and not np.isnan(np.asarray(Y)).any():  # dcfm: ignore[DCFM701] - Y is the caller's host matrix from _load, never a global array
         # fail BEFORE the fit, not after a multi-minute chain has run
         raise SystemExit("--imputed-out set but Y has no missing (NaN) "
                          "entries")
@@ -489,16 +530,27 @@ def main(argv=None) -> int:
         checkpoint_full_every=args.checkpoint_full_every,
         checkpoint_keep_last=args.keep_last,
         sentinel=args.sentinel,
+        materialize_sigma=args.materialize_sigma,
     )
     res = fit(Y, cfg)
-    Sigma = (res.covariance(destandardize=False)
-             if args.raw_coords else res.Sigma)
+    if res.Sigma is None and not args.raw_coords:
+        Sigma = None
+        print("covariance not materialized (materialize_sigma="
+              f"{cfg.materialize_sigma!r}, "
+              f"{'lazy' if res.preprocess.is_lazy else 'dense'} input); "
+              "no Sigma .npy written - query FitResult.sigma_block or "
+              "serve via `dcfm-tpu export`", file=sys.stderr)
+    else:
+        # --raw-coords on a lazy fit raises the typed
+        # LazyMaterializationError unless --materialize-sigma always
+        Sigma = (res.covariance(destandardize=False)
+                 if args.raw_coords else res.Sigma)
     # Multi-host runs compute the identical Sigma on every process; only
     # process 0 writes, so concurrent processes on a shared filesystem
     # cannot race on the same output file.
     import jax
     write_files = jax.process_index() == 0
-    if write_files:
+    if write_files and Sigma is not None:
         np.save(args.out, Sigma)
     if args.draws_out and write_files:
         # the CLI edge is the ONE sanctioned squeeze point of the
@@ -552,10 +604,11 @@ def main(argv=None) -> int:
             print("early stop: did not trigger (ran the full "
                   f"{cfg.run.total_iters} iterations)", file=sys.stderr)
     print(json.dumps({
-        "out": args.out,
+        "out": args.out if Sigma is not None else None,
         "sd_out": sd_out,
         "draws_out": args.draws_out,
-        "shape": list(Sigma.shape),
+        "shape": (list(Sigma.shape) if Sigma is not None
+                  else [res.preprocess.p_original] * 2),
         "seconds": round(res.seconds, 3),
         "iters_per_sec": round(res.iters_per_sec, 2),
         "chain_iters_per_sec": round(res.chain_iters_per_sec, 2),

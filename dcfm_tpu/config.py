@@ -450,6 +450,19 @@ class FitConfig:
     # checkpoint of THIS run exists.  Single-process runs only (the
     # multi-process path keeps cold init).
     warm_start: Optional[WarmStart] = None
+    # Dense (p, p) posterior-covariance assembly policy - the scale-out
+    # knob (ROADMAP item 5).  The packed upper panels are always fetched;
+    # this decides whether fit() ALSO stitches them into the dense
+    # FitResult.Sigma:
+    #   "auto"   - materialize when p_used <= api._AUTO_MATERIALIZE_MAX_P
+    #              AND the input was dense; skip for streaming (sparse /
+    #              memmap) ingestion or wider problems.
+    #   "always" - materialize regardless (the pre-scale-out behavior;
+    #              O(p^2) host memory, refuse-guards bypassed).
+    #   "never"  - never materialize: FitResult.Sigma is None and Sigma is
+    #              served via .sigma_block(i, j) / the export seams, which
+    #              need only the packed panels.
+    materialize_sigma: str = "auto"
 
 
 def validate_obs(obs) -> None:
@@ -640,6 +653,10 @@ def validate(cfg: FitConfig, n: int, p: int) -> None:
         raise ValueError(
             f"DL concentration a={m.dl.a} must be in (0, 1] "
             "(1/K <= a <= 1/2 is the usual range)")
+    if cfg.materialize_sigma not in ("auto", "always", "never"):
+        raise ValueError(
+            f"unknown materialize_sigma {cfg.materialize_sigma!r} "
+            "(auto | always | never)")
     if cfg.warm_start is not None:
         ws = cfg.warm_start
         if not isinstance(ws.checkpoint, str) or not ws.checkpoint:

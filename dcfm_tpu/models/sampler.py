@@ -295,14 +295,14 @@ def init_chain(
         rank_adapt=cfg.rank_adapt, dtype=dtype)
     if num_local_pairs is None:
         num_local_pairs = num_padded_pairs(num_global_shards)
-    sigma_acc = jnp.zeros((num_local_pairs, P, P), dtype)
+    sigma_acc = jnp.zeros((num_local_pairs, P, P), dtype)  # dcfm: ignore[DCFM1501] - the packed accumulator IS the sanctioned panel store (device HBM, sharded over the mesh)
     draws = None
     if num_stored_draws:
         draws = DrawBuffers(
             Lambda=jnp.zeros((num_stored_draws, Gl, P, K), dtype),
             ps=jnp.zeros((num_stored_draws, Gl, P), dtype),
             X=jnp.zeros((num_stored_draws, n, K), dtype),
-            H=(jnp.zeros((num_stored_draws, Gl, num_global_shards, K, K),
+            H=(jnp.zeros((num_stored_draws, Gl, num_global_shards, K, K),  # dcfm: ignore[DCFM1501] - K x K factor cross-moments; K is the factor count, << p
                          dtype) if cfg.estimator == "scaled" else None))
     return ChainCarry(state=state, sigma_acc=sigma_acc,
                       iteration=jnp.zeros((), jnp.int32),

@@ -261,3 +261,34 @@ def place_sharded(Y_shard_major, mesh: Mesh):
     """Host (g, n, P) array -> device array split over the mesh shard axis."""
     return jax.device_put(
         Y_shard_major, NamedSharding(mesh, P(SHARD_AXIS)))
+
+
+def place_sharded_streaming(source, mesh: Mesh, *,
+                            upload_dtype: str = "float32"):
+    """Lazy (g, n, P) shard source -> mesh-sharded device array, streamed.
+
+    The scale-out twin of :func:`place_sharded`: instead of device_put on a
+    fully materialized host array (O(n*p) host RSS), each addressable
+    device's shard slice is materialized from ``source`` (any object with
+    ``.shape`` (g, n, P) and ``.chunk(lo, hi)`` -> dense block, i.e.
+    utils.preprocess.LazyShardData) and uploaded on its own, so peak host
+    memory is O(n * P * shards_per_device).  The resulting global array has
+    exactly the `P(SHARD_AXIS)` NamedSharding of place_sharded with
+    bitwise-identical bytes, on single-host AND multi-host meshes alike
+    (each process contributes only its addressable shards).
+    """
+    from dcfm_tpu.runtime.fetch import upload_host_array
+
+    sharding = NamedSharding(mesh, P(SHARD_AXIS))
+    shape = tuple(source.shape)
+    singles = []
+    out_dtype = None
+    for dev, idx in sharding.addressable_devices_indices_map(shape).items():
+        sl = idx[0]
+        lo = 0 if sl.start is None else sl.start
+        hi = shape[0] if sl.stop is None else sl.stop
+        block = upload_host_array(source.chunk(lo, hi), upload_dtype)
+        singles.append(jax.device_put(block, dev))
+        del block
+    return jax.make_array_from_single_device_arrays(
+        shape, sharding, singles)

@@ -233,20 +233,28 @@ def quant8_drain(slices, shape, out: np.ndarray = None) -> np.ndarray:
     return q_host
 
 
-def quant8_fetch_assemble(started, shape, pre: PreprocessResult, phase):
+def quant8_fetch_assemble(started, shape, pre: PreprocessResult, phase,
+                          *, assemble: bool = True):
     """Drain a started quant8 fetch + native one-pass assembly to the
     final caller-coordinate matrix - the shared path for the posterior-
     mean and posterior-SD panels.  ``started`` is a :func:`quant8_start`
     result.  Returns ``(out, q8_panels, q8_scales, upper)`` with exactly
     one of the (int8 panels+scales, float32 upper) backings set for the
     FitResult's lazy panel storage; updates ``phase`` fetch/assemble
-    entries in place."""
+    entries in place.
+
+    ``assemble=False`` is the lazy-Sigma path (FitConfig.
+    materialize_sigma): the drain still lands the int8 panels - the
+    FitResult backing and export source - but the dense O(p^2) stitch is
+    skipped and ``out`` is None."""
     slices, scale_dev = started
     t_f = time.perf_counter()
     # async already issued in quant8_start; the scales arrive first
     scales = np.asarray(scale_dev)  # dcfm: ignore[DCFM801] - the drain half: asyncs were dispatched in quant8_start
     q8 = quant8_drain(slices, shape)
     phase["fetch_s"] += time.perf_counter() - t_f
+    if not assemble:
+        return None, q8, scales, None
     t_as = time.perf_counter()
     out = assemble_q8_sigma(q8, scales, pre)
     upper = None
@@ -255,7 +263,8 @@ def quant8_fetch_assemble(started, shape, pre: PreprocessResult, phase):
         # the FitResult backing store (they exist anyway)
         upper = dequantize_panels(q8, scales)
         q8 = scales = None
-        out = assemble_from_upper(upper, pre, reinsert_zero_cols=True)
+        out = assemble_from_upper(upper, pre, reinsert_zero_cols=True,
+                                  force=True)
     phase["assemble_s"] += time.perf_counter() - t_as
     return out, q8, scales, upper
 
@@ -264,6 +273,9 @@ def assemble_q8_sigma(q8: np.ndarray, scales: np.ndarray,
                       pre: PreprocessResult):
     """Native one-pass int8 panels -> caller-coordinate matrix (None when
     the native library is unavailable; callers fall back to the f32
-    dequant + numpy assembly)."""
+    dequant + numpy assembly).  Callers gate on materialize_sigma, so
+    reaching here IS the decision to densify - force past the lazy
+    guard."""
     return assemble_from_q8(q8, scales, pre,
-                            destandardize=True, reinsert_zero_cols=True)
+                            destandardize=True, reinsert_zero_cols=True,
+                            force=True)

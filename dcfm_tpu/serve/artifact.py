@@ -543,6 +543,168 @@ def write_artifact(
     return PosteriorArtifact.open(path)
 
 
+def cooperative_pair_slice(n_pairs: int, process_index: int,
+                           process_count: int) -> tuple[int, int]:
+    """This process's contiguous [lo, hi) slice of the canonical triu
+    panel order - the write ownership map of the cooperative export.
+    Balanced to within one panel for any (n_pairs, process_count)."""
+    lo = process_index * n_pairs // process_count
+    hi = (process_index + 1) * n_pairs // process_count
+    return lo, hi
+
+
+def write_artifact_cooperative(
+    path: str,
+    *,
+    mean_q8: np.ndarray,
+    mean_scale: np.ndarray,
+    pre: PreprocessResult,
+    sd_q8: Optional[np.ndarray] = None,
+    sd_scale: Optional[np.ndarray] = None,
+    provenance: Optional[dict] = None,
+    process_index: int = 0,
+    process_count: int = 1,
+    barrier=None,
+) -> PosteriorArtifact:
+    """Multi-host cooperative artifact export: each host writes ONLY its
+    packed-panel slice; no process ever funnels the full payload.
+
+    Every host calls this with the same arguments (the fetch replicates
+    panels across processes; a host holding only its slice still passes
+    the full-shape array view it has).  The protocol, phased by
+    ``barrier`` (a ``callable(tag)`` - ``multihost_utils.
+    sync_global_devices`` on a real pod, a no-op or test double
+    otherwise):
+
+    1. host 0 invalidates any existing ``meta.json`` and pre-sizes the
+       panel files with ``truncate`` (fresh inodes, like the streamed
+       export - a crash at any later point leaves a directory
+       :meth:`PosteriorArtifact.open` refuses);
+    2. barrier; every host memmaps the files ``r+`` and writes panels
+       ``[lo, hi)`` (:func:`cooperative_pair_slice`) at their byte
+       offsets ``lo*P*P``, then flushes;
+    3. barrier (unanimity: every slice landed); host 0 re-reads the
+       STITCHED file, records per-panel CRC32s of the bytes actually on
+       disk - so the recorded integrity covers the cooperative stitch,
+       not host 0's in-RAM copy - and writes maps + meta LAST;
+    4. barrier; every host opens the finished artifact.
+
+    The panel binaries are byte-identical to a single-host
+    :func:`write_artifact` of the same panels, and ``meta.json``
+    (CRCs, fingerprint) matches exactly; only the ``maps.npz`` zip
+    container timestamps can differ."""
+    if barrier is None:
+        def barrier(tag):
+            return None
+    n_pairs, P, P2 = np.shape(mean_q8)
+    g = pre.num_shards
+    if P != P2 or n_pairs != _num_pairs(g):
+        raise ValueError(
+            f"mean panels {np.shape(mean_q8)} are not the full "
+            f"g(g+1)/2={_num_pairs(g)} upper-triangle set for g={g}")
+    if g * P != pre.p_used:
+        raise ValueError(f"g={g} panels of width {P} != p_used {pre.p_used}")
+    if not 0 <= process_index < process_count:
+        raise ValueError(
+            f"process_index {process_index} not in [0, {process_count})")
+    if (sd_q8 is None) != (sd_scale is None):
+        raise ValueError("sd_q8 and sd_scale must be passed together")
+    has_sd = sd_q8 is not None
+    names = [MEAN_PANELS_FILE] + ([SD_PANELS_FILE] if has_sd else [])
+    if process_index == 0:
+        os.makedirs(path, exist_ok=True)
+        meta_path = os.path.join(path, META_FILE)
+        if os.path.exists(meta_path):
+            os.unlink(meta_path)
+        if not has_sd and os.path.exists(os.path.join(path, SD_PANELS_FILE)):
+            os.unlink(os.path.join(path, SD_PANELS_FILE))
+        for name in names:
+            fp = os.path.join(path, name)
+            if os.path.exists(fp):
+                # fresh inode, never truncate-in-place: a prior export's
+                # live memmaps must keep their bytes (see
+                # begin_streamed_artifact)
+                os.unlink(fp)
+            with open(fp, "wb") as f:
+                f.truncate(n_pairs * P * P)
+    barrier("dcfm-coop-artifact-prepare")
+    lo, hi = cooperative_pair_slice(n_pairs, process_index, process_count)
+    for name, panels in ((MEAN_PANELS_FILE, mean_q8),
+                         (SD_PANELS_FILE, sd_q8))[:1 + has_sd]:
+        if hi > lo:
+            mm = np.memmap(os.path.join(path, name), dtype=np.int8,
+                           mode="r+", shape=(n_pairs, P, P))
+            mm[lo:hi] = np.asarray(panels)[lo:hi]
+            mm.flush()
+            del mm
+    barrier("dcfm-coop-artifact-panels")
+    if process_index == 0:
+        crc = {}
+        for kind, name in (("mean", MEAN_PANELS_FILE),
+                           ("sd", SD_PANELS_FILE))[:1 + has_sd]:
+            stitched = np.memmap(os.path.join(path, name), dtype=np.int8,
+                                 mode="r", shape=(n_pairs, P, P))
+            crc[kind] = [int(panel_crc32(q)) for q in stitched]
+            del stitched
+        np.savez(os.path.join(path, MAPS_FILE),
+                 **_build_maps(pre, mean_scale, sd_scale))
+        meta = {
+            "format": ARTIFACT_FORMAT,
+            "version": ARTIFACT_VERSION,
+            "g": int(g),
+            "P": int(P),
+            "p_original": int(pre.p_original),
+            "n_pad": int(pre.n_pad),
+            "has_sd": has_sd,
+            "panel_crc": crc,
+            "provenance": provenance or {},
+        }
+        meta["fingerprint"] = artifact_fingerprint(meta)
+        _write_meta_last(path, meta)
+        record("artifact_write", path=os.path.basename(path),
+               source="cooperative", fingerprint=meta["fingerprint"],
+               processes=process_count)
+    barrier("dcfm-coop-artifact-meta")
+    return PosteriorArtifact.open(path)
+
+
+def export_fit_result_cooperative(res, path: str, *, process_index: int,
+                                  process_count: int,
+                                  barrier=None) -> PosteriorArtifact:
+    """Cooperative twin of :func:`export_fit_result`: the multi-host
+    fit->export seam.  Same panel sourcing (int8 panels reused as-is
+    under the quant8 fetch, host-side quantization otherwise - a
+    deterministic pure function, so every host derives identical
+    panels from the replicated fetch), written via
+    :func:`write_artifact_cooperative`."""
+    if res._q8_panels is not None:
+        mean_q8 = np.asarray(res._q8_panels)
+        mean_scale = np.asarray(res._q8_scales, np.float32)
+    else:
+        mean_q8, mean_scale = quantize_panels(res.upper_panels)
+    sd_q8 = sd_scale = None
+    if res._sd_q8_panels is not None:
+        sd_q8 = np.asarray(res._sd_q8_panels)
+        sd_scale = np.asarray(res._sd_q8_scales, np.float32)
+    elif res.sd_upper_panels is not None:
+        sd_q8, sd_scale = quantize_panels(res.sd_upper_panels)
+    m, run = res.config.model, res.config.run
+    provenance = {
+        "source": "fit",
+        "num_shards": m.num_shards,
+        "factors_per_shard": m.factors_per_shard,
+        "prior": m.prior,
+        "estimator": m.estimator,
+        "seed": run.seed,
+        "total_iters": run.total_iters,
+    }
+    return write_artifact_cooperative(
+        path, mean_q8=mean_q8, mean_scale=mean_scale, pre=res.preprocess,
+        sd_q8=sd_q8, sd_scale=sd_scale, provenance=provenance,
+        process_index=process_index, process_count=process_count,
+        barrier=barrier)
+
+
 def create_sparse_artifact(path: str, *, g: int, P: int,
                            has_sd: bool = False) -> str:
     """Synthesize an artifact with ZERO-filled sparse panel files.

@@ -177,12 +177,31 @@ def _acc_leaf_indices(carry: Any) -> list:
             if id(l) not in keep]
 
 
-def data_fingerprint(data: np.ndarray) -> str:
-    """Cheap content hash of the sharded data (shape + strided sample)."""
+def data_fingerprint(data) -> str:
+    """Cheap content hash of the sharded data (shape + strided sample).
+
+    Accepts the dense (g, n, P) array or a lazy shard source
+    (utils.preprocess.LazyShardData): the lazy walk samples the same
+    C-order flat indices block by block, so both forms of the same data
+    hash identically and a sparse-ingested refit can resume a dense
+    checkpoint (and vice versa).
+    """
     h = hashlib.sha256()
-    h.update(str(data.shape).encode())
-    flat = np.ascontiguousarray(data).reshape(-1)
-    h.update(flat[:: max(1, flat.size // 65536)].tobytes())
+    h.update(str(tuple(data.shape)).encode())
+    if isinstance(data, np.ndarray):
+        flat = np.ascontiguousarray(data).reshape(-1)
+        h.update(flat[:: max(1, flat.size // 65536)].tobytes())
+    else:
+        g, n, P = data.shape
+        size = g * n * P
+        step = max(1, size // 65536)
+        idx = np.arange(0, size, step, dtype=np.int64)
+        block_elems = n * P
+        for s in range(g):
+            sel = idx[(idx >= s * block_elems) & (idx < (s + 1) * block_elems)]
+            if sel.size:
+                h.update(data.block(s).reshape(-1)[sel - s * block_elems]
+                         .tobytes())
     return h.hexdigest()[:16]
 
 

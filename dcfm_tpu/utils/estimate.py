@@ -17,7 +17,8 @@ from typing import Optional
 import numpy as np
 
 from dcfm_tpu import native
-from dcfm_tpu.utils.preprocess import PreprocessResult, restore_covariance
+from dcfm_tpu.utils.preprocess import (
+    LazyMaterializationError, PreprocessResult, restore_covariance)
 
 
 def upper_pair_indices(g: int) -> tuple[np.ndarray, np.ndarray]:
@@ -39,7 +40,7 @@ def full_blocks_from_upper(upper: np.ndarray, g: int) -> np.ndarray:
     symmetrization pass (reference ``divideconquer.m:195``)."""
     n_pairs, P, _ = upper.shape
     r, c = upper_pair_indices(g)
-    blocks = np.empty((g, g, P, P), upper.dtype)
+    blocks = np.empty((g, g, P, P), upper.dtype)  # dcfm: ignore[DCFM1501] - the sanctioned dense unpacking seam; every caller sits behind a force=/materialize_sigma gate
     blocks[r, c] = upper
     blocks[c, r] = np.transpose(upper, (0, 2, 1))
     diag = np.arange(g)
@@ -97,6 +98,7 @@ def assemble_from_upper(
     *,
     destandardize: bool = True,
     reinsert_zero_cols: bool = False,
+    force: bool = False,
 ) -> np.ndarray:
     """Upper block panels -> final covariance in caller coordinates.
 
@@ -105,7 +107,16 @@ def assemble_from_upper(
     into a single sweep over the panels, ~4x the NumPy pass chain at
     p=10k.  Falls back to the NumPy path (bit-compatible: same operation
     order per entry) when the native library is unavailable.
+
+    Refuses on a lazily-ingested ``pre`` unless ``force=True``
+    (materialize_sigma='always' sets it): the output is the dense O(p^2)
+    matrix the streaming path exists to avoid.
     """
+    if pre.is_lazy and not force:
+        raise LazyMaterializationError(
+            "refusing the dense (p, p) assembly for a lazily-ingested "
+            "(sparse/out-of-core) fit; set FitConfig.materialize_sigma="
+            "'always' or query FitResult.sigma_block / the serve artifact")
     n_pairs, P, _ = upper.shape
     g = native.g_from_pairs(n_pairs)
     if native.available():
@@ -121,7 +132,7 @@ def assemble_from_upper(
     return restore_covariance(
         stitch_blocks(full_blocks_from_upper(upper, g), symmetrize=False),
         pre, destandardize=destandardize,
-        reinsert_zero_cols=reinsert_zero_cols)
+        reinsert_zero_cols=reinsert_zero_cols, force=force)
 
 
 def dequantize_panels(q_panels: np.ndarray,
@@ -140,6 +151,7 @@ def assemble_from_q8(
     *,
     destandardize: bool = True,
     reinsert_zero_cols: bool = False,
+    force: bool = False,
 ) -> Optional[np.ndarray]:
     """Final covariance STRAIGHT from int8-quantized panels (native path).
 
@@ -148,6 +160,11 @@ def assemble_from_q8(
     q8 kernel is unavailable - the caller dequantizes
     (:func:`dequantize_panels`) and uses :func:`assemble_from_upper`.
     """
+    if pre.is_lazy and not force:
+        raise LazyMaterializationError(
+            "refusing the dense (p, p) assembly for a lazily-ingested "
+            "(sparse/out-of-core) fit; set FitConfig.materialize_sigma="
+            "'always' or query FitResult.sigma_block / the serve artifact")
     if not native.available():
         return None
     n_pairs, P, _ = q_panels.shape
@@ -155,7 +172,7 @@ def assemble_from_q8(
     scale, out_map, p_out = assembly_maps(
         pre, g, P, destandardize=destandardize,
         reinsert_zero_cols=reinsert_zero_cols)
-    out = np.zeros((p_out, p_out), np.float32)
+    out = np.zeros((p_out, p_out), np.float32)  # dcfm: ignore[DCFM1501] - q8 assembly output, behind the force=/materialize_sigma gate above
     if native.assemble_q8(q_panels, panel_scale, scale, out_map, out):
         return out
     return None

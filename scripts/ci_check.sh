@@ -58,12 +58,16 @@ python -m dcfm_tpu.analysis --check-readme README.md || exit 1
 # test_chains_mesh.py rides the lane: its resilience test SIGKILLs a
 # real supervised multi-chain run mid-stream, so a runaway child must
 # fail one file with its signal named.
+# test_sparse_ingest.py rides the lane: the cooperative-export test runs
+# two barrier-synchronized writer threads over one memmapped artifact
+# and the RSS-guard test forks a measurement subprocess - a deadlocked
+# barrier or runaway child must fail one file, not wedge the suite.
 echo "== serve + chaos tests incl. crash-fuzz smoke (crash-isolated lane) =="
 for f in tests/test_serve_artifact.py tests/test_serve_engine.py \
          tests/test_serve_server.py tests/test_serve_fleet.py \
          tests/test_resilience.py tests/test_online.py \
          tests/test_runtime_stream.py tests/test_obs.py \
-         tests/test_chains_mesh.py; do
+         tests/test_chains_mesh.py tests/test_sparse_ingest.py; do
     JAX_PLATFORMS=cpu python -m dcfm_tpu.analysis.isolate "$f" \
         -- -q -m 'not slow' --continue-on-collection-errors \
         -p no:cacheprovider -p no:xdist -p no:randomly || exit 1

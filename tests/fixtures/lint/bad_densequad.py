@@ -1,0 +1,23 @@
+"""Known-bad dense quadratic materializations: DCFM1501 must fire."""
+import numpy as np
+import jax.numpy as jnp
+
+
+def dense_covariance(p):
+    # DCFM1501: (p, p) host buffer - hundreds of GB at p >= 1e6
+    return np.zeros((p, p), np.float32)
+
+
+def dense_grid(g, P, n):
+    # DCFM1501: repeated panel axis (g, g, P, P) is the O(p^2) block grid
+    return np.empty((g, g, P, P), np.float32)
+
+
+def device_quadratic(dim, dtype):
+    # DCFM1501: jnp spelling of the same quadratic buffer
+    return jnp.zeros((dim, dim), dtype)
+
+
+def attribute_dims(pre):
+    # DCFM1501: repeated attribute access counts as the same symbol
+    return np.ones((pre.p_used, pre.p_used))

@@ -1,0 +1,28 @@
+"""Known-good allocations: distinct dims, constants, and sanctioned seams."""
+import numpy as np
+import jax.numpy as jnp
+
+
+def panel_buffer(n_pairs, P, width):
+    # distinct symbols per axis: linear in every dimension
+    return np.zeros((n_pairs, P, width), np.float32)
+
+
+def constant_dims():
+    # constants repeat no *symbol*: a (3, 3) stencil is not a p x p matrix
+    return np.zeros((3, 3))
+
+
+def shard_block(n, P):
+    # the streaming ingest's working set: one (n, P) shard at a time
+    return jnp.zeros((n, P), jnp.float32)
+
+
+def sanctioned_assembly(p_out):
+    # the force=True/materialize_sigma='always' seam carries the pragma
+    return np.zeros((p_out, p_out), np.float32)  # dcfm: ignore[DCFM1501] - sanctioned dense assembly seam behind the materialize_sigma gate
+
+
+def flat_sized(p):
+    # a 1-D buffer over p entries is linear, not quadratic
+    return np.empty(p, np.float32)

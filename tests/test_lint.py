@@ -281,6 +281,38 @@ def test_bad_lifetime_fires_1201_for_all_three_shipped_shapes():
     assert any("device_put" in m for m in msgs)
 
 
+def test_bad_densequad_fires_1501():
+    assert _rules_fired("bad_densequad.py") == {"DCFM1501"}
+
+
+def test_bad_densequad_flags_every_allocation_shape():
+    findings = lint_file(os.path.join(FIXTURES, "bad_densequad.py"))
+    # np.zeros (p, p), np.empty (g, g, P, P), jnp.zeros (dim, dim),
+    # np.ones on repeated attribute dims
+    assert len([f for f in findings if f.rule == "DCFM1501"]) == 4
+
+
+def test_densequad_names_the_repeated_dimension():
+    src = ("import numpy as np\n"
+           "def f(p_used):\n"
+           "    return np.zeros((p_used, p_used), np.float32)\n")
+    findings = [f for f in lint_source(src, "mod.py")
+                if f.rule == "DCFM1501"]
+    assert len(findings) == 1
+    assert "'p_used'" in findings[0].message
+
+
+def test_densequad_skips_scripts_and_tests():
+    src = ("import numpy as np\n"
+           "def f(p):\n"
+           "    return np.zeros((p, p))\n")
+    assert any(f.rule == "DCFM1501" for f in lint_source(src, "mod.py"))
+    assert not any(f.rule == "DCFM1501"
+                   for f in lint_source(src, "test_mod.py"))
+    assert not any(f.rule == "DCFM1501"
+                   for f in lint_source(src, "scripts/demo.py"))
+
+
 def test_bad_pragma_fires_002_for_dead_and_unknown():
     findings = lint_file(os.path.join(FIXTURES, "bad_pragma.py"))
     assert {f.rule for f in findings} == {"DCFM002"}
@@ -310,7 +342,8 @@ def test_every_rule_family_has_a_firing_fixture():
     "good_thread.py", "good_server.py", "good_robust.py",
     "good_multihost.py", "good_runtime.py", "good_obs.py",
     "good_handler.py", "good_locks.py", "good_lifetime.py",
-    "good_pragma.py", "good_poll.py", "good_chainaxis.py"])
+    "good_pragma.py", "good_poll.py", "good_chainaxis.py",
+    "good_densequad.py"])
 def test_good_fixture_is_clean(name):
     findings = lint_file(os.path.join(FIXTURES, name))
     assert findings == [], [str(f) for f in findings]

@@ -1,0 +1,329 @@
+"""Scale-out ingestion tests: sparse/CSR Y, out-of-core preprocessing,
+lazy results, and cooperative artifact assembly.
+
+The contract under test is BITWISE equality: the streaming preprocess
+mirrors the dense pipeline's exact operation order (same rng draws, same
+reduction axes, same final cast), so a densified sparse input must
+produce byte-identical shard blocks, stats, and - through a short fit -
+byte-identical posterior panels and (under materialize_sigma='always')
+the byte-identical dense Sigma.
+"""
+
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from dcfm_tpu.api import fit
+from dcfm_tpu.config import FitConfig, ModelConfig, RunConfig
+from dcfm_tpu.utils.preprocess import (
+    LazyMaterializationError, SparseMatrix, is_streaming_input, preprocess,
+    restore_covariance, restore_data_matrix)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _csr_from_dense(Y):
+    """Dependency-free CSR triple that keeps stored NaNs AND treats the
+    dense array's zeros as implicit (not stored) - the canonical
+    densify-inverse used for the parity tests."""
+    n, p = Y.shape
+    indptr = np.zeros(n + 1, np.int64)
+    indices, data = [], []
+    for i in range(n):
+        row = Y[i]
+        nz = np.flatnonzero((row != 0) | np.isnan(row))
+        indices.append(nz)
+        data.append(row[nz])
+        indptr[i + 1] = indptr[i] + nz.size
+    return SparseMatrix(indptr, np.concatenate(indices),
+                        np.concatenate(data), (n, p), format="csr")
+
+
+def _toy(rng, n=40, p=36, *, nan=True, zero_col=True):
+    Y = rng.normal(size=(n, p))
+    Y[Y < -0.5] = 0.0
+    if nan:
+        Y[0, 3] = np.nan
+        Y[5, 11] = np.nan
+    if zero_col:
+        Y[:, 7] = 0.0
+    return Y
+
+
+CFG = FitConfig(
+    model=ModelConfig(num_shards=4, factors_per_shard=3, rho=0.5),
+    run=RunConfig(burnin=10, mcmc=20, thin=2, seed=3))
+
+
+# ---------------------------------------------------------------------------
+# streaming preprocess: bitwise parity with the dense pipeline
+# ---------------------------------------------------------------------------
+
+def _assert_pre_equal(pre_d, pre_s):
+    assert pre_s.is_lazy and not pre_d.is_lazy
+    np.testing.assert_array_equal(pre_d.perm, pre_s.perm)
+    np.testing.assert_array_equal(pre_d.kept_cols, pre_s.kept_cols)
+    np.testing.assert_array_equal(pre_d.zero_cols, pre_s.zero_cols)
+    assert pre_d.n_missing == pre_s.n_missing
+    np.testing.assert_array_equal(pre_d.col_mean, pre_s.col_mean)
+    np.testing.assert_array_equal(pre_d.col_scale, pre_s.col_scale)
+    dense = pre_s.data.materialize()
+    assert dense.dtype == pre_d.data.dtype
+    np.testing.assert_array_equal(pre_d.data, dense)
+
+
+def test_csr_preprocess_bitwise_equals_dense(rng):
+    Y = _toy(rng)
+    pre_d = preprocess(Y, 4, seed=2)
+    pre_s = preprocess(_csr_from_dense(Y), 4, seed=2)
+    _assert_pre_equal(pre_d, pre_s)
+
+
+def test_csc_and_scipy_inputs_match_dense(rng):
+    sp = pytest.importorskip("scipy.sparse")
+    Y = _toy(rng, nan=False)   # scipy csr_matrix(dense) drops NaNs' zeros
+    pre_d = preprocess(Y, 4, seed=5)
+    csr = _csr_from_dense(Y)
+    from dcfm_tpu.utils.preprocess import _csr_to_csc
+    indptr, indices, data = _csr_to_csc(
+        csr.indptr, csr.indices, csr.data, csr.shape)
+    csc = SparseMatrix(indptr, indices, data, csr.shape, format="csc")
+    _assert_pre_equal(pre_d, preprocess(csc, 4, seed=5))
+    _assert_pre_equal(pre_d, preprocess(sp.csr_matrix(Y), 4, seed=5))
+
+
+def test_nan_vs_explicit_zero_semantics(rng):
+    """Stored NaN = missing (imputed); explicit stored zero behaves
+    exactly like a dense zero - a column of only stored zeros is dropped
+    with the all-zero columns."""
+    n, p = 12, 8
+    Y = rng.normal(size=(n, p))
+    Y[:, 2] = 0.0
+    Y[0, 5] = np.nan
+    csr = _csr_from_dense(Y)
+    # add explicit stored zeros into column 2 (dense densify drops them)
+    extra_rows = [1, 4]
+    indptr = csr.indptr.copy()
+    indices, data = list(csr.indices), list(csr.data)
+    for r in sorted(extra_rows, reverse=True):
+        at = int(np.searchsorted(indices[indptr[r]:indptr[r + 1]], 2)
+                 + indptr[r])
+        indices.insert(at, 2)
+        data.insert(at, 0.0)
+        indptr[r + 1:] += 1
+    stuffed = SparseMatrix(indptr, np.array(indices), np.array(data),
+                           (n, p), format="csr")
+    pre_d = preprocess(Y, 2, seed=0)
+    pre_s = preprocess(stuffed, 2, seed=0)
+    _assert_pre_equal(pre_d, pre_s)      # the stored zeros changed nothing
+    assert 2 in pre_s.zero_cols          # still dropped
+    assert pre_s.n_missing == 1          # the NaN is missing, zeros are data
+
+
+def test_memmap_input_streams(rng, tmp_path):
+    Y = _toy(rng)
+    path = tmp_path / "y.npy"
+    np.save(path, Y)
+    Ymm = np.load(path, mmap_mode="r")
+    assert is_streaming_input(Ymm)
+    pre_d = preprocess(Y, 4, seed=2)
+    pre_s = preprocess(Ymm, 4, seed=2)
+    _assert_pre_equal(pre_d, pre_s)
+
+
+def test_inf_refused_on_streaming_path(rng):
+    Y = _toy(rng, nan=False)
+    Y[1, 1] = np.inf
+    with pytest.raises(ValueError, match="infinite"):
+        preprocess(_csr_from_dense(Y), 4, seed=0)
+
+
+def test_lazy_restores_refuse_with_typed_error(rng):
+    Y = _toy(rng)
+    pre = preprocess(_csr_from_dense(Y), 4, seed=2)
+    S = np.eye(pre.p_used, dtype=np.float32)
+    with pytest.raises(LazyMaterializationError, match="materialize_sigma"):
+        restore_covariance(S, pre)
+    with pytest.raises(LazyMaterializationError, match="materialize_sigma"):
+        restore_data_matrix(np.zeros(pre.data.shape, np.float32), pre)
+    # force=True is the explicit escape hatch
+    out = restore_covariance(S, pre, force=True)
+    assert out.shape == (pre.p_used - pre.n_pad,) * 2
+
+
+# ---------------------------------------------------------------------------
+# fit: lazy results, sigma_block, and bitwise sparse/dense parity
+# ---------------------------------------------------------------------------
+
+def test_sparse_fit_bitwise_matches_dense(rng):
+    Y = _toy(rng)
+    res_d = fit(Y, CFG)
+    res_s = fit(_csr_from_dense(Y), CFG)
+    assert res_d.Sigma is not None      # dense auto materializes
+    assert res_s.Sigma is None          # lazy auto does not
+    np.testing.assert_array_equal(res_d.upper_panels, res_s.upper_panels)
+    # the explicit opt-in reproduces the dense Sigma bit-for-bit
+    res_a = fit(_csr_from_dense(Y),
+                dataclasses.replace(CFG, materialize_sigma="always"))
+    np.testing.assert_array_equal(res_d.Sigma, res_a.Sigma)
+
+
+def test_sigma_block_serves_lazy_posterior(rng):
+    from dcfm_tpu.utils.estimate import full_blocks_from_upper
+    Y = _toy(rng)
+    res = fit(_csr_from_dense(Y), CFG)
+    g = CFG.model.num_shards
+    blocks = full_blocks_from_upper(res.upper_panels, g)
+    scale = np.asarray(res.preprocess.col_scale, np.float32)
+    for i, j in [(0, 0), (1, 3), (3, 1), (2, 2)]:
+        want = blocks[i, j] * (scale[i][:, None] * scale[j][None, :])
+        np.testing.assert_array_equal(res.sigma_block(i, j), want)
+    # (j, i) is exactly the transpose of (i, j)
+    np.testing.assert_array_equal(res.sigma_block(3, 1),
+                                  res.sigma_block(1, 3).T)
+    with pytest.raises(IndexError):
+        res.sigma_block(0, g)
+
+
+def test_lazy_result_refusals_and_artifact_export(rng, tmp_path):
+    Y = _toy(rng)
+    res = fit(_csr_from_dense(Y), CFG)
+    with pytest.raises(LazyMaterializationError, match="materialize_sigma"):
+        res.covariance()
+    # the serve artifact needs no dense Sigma
+    art = res.export_artifact(str(tmp_path / "art"))
+    assert art.meta["p_original"] == Y.shape[1]
+
+
+def test_materialize_never_on_dense_input(rng):
+    Y = _toy(rng, nan=False)
+    res = fit(Y, dataclasses.replace(CFG, materialize_sigma="never"))
+    assert res.Sigma is None
+    # an EAGER pre still answers an explicit covariance() query
+    C = res.covariance(reinsert_zero_cols=True)
+    res_d = fit(Y, CFG)
+    np.testing.assert_array_equal(C, res_d.Sigma)
+
+
+def test_materialize_sigma_validated():
+    with pytest.raises(ValueError, match="materialize_sigma"):
+        fit(np.zeros((4, 4)) + 1.0,
+            dataclasses.replace(CFG, materialize_sigma="sometimes"))
+
+
+# ---------------------------------------------------------------------------
+# peak-RSS regression guard: streaming ingest never densifies
+# ---------------------------------------------------------------------------
+
+_RSS_PROBE = r"""
+import json, resource, sys
+import numpy as np
+sys.path.insert(0, {repo!r})
+from dcfm_tpu.utils.preprocess import SparseMatrix, preprocess
+
+n, p, g = 16, 800_000, 200
+rng = np.random.default_rng(0)
+nnz_per_row = p // 300
+indptr = np.arange(n + 1, dtype=np.int64) * nnz_per_row
+indices = np.concatenate(
+    [np.sort(rng.choice(p, nnz_per_row, replace=False)) for _ in range(n)])
+data = rng.standard_normal(indices.size)
+Y = SparseMatrix(indptr, indices, data, (n, p), format="csr")
+before = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+pre = preprocess(Y, g, seed=0)
+for s in range(g):                  # stream every shard block once
+    pre.data.block(s)
+after = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+print(json.dumps({{"delta_kb": int(after - before)}}))
+"""
+
+
+@pytest.mark.slow
+def test_streaming_ingest_peak_rss_stays_bounded(tmp_path):
+    """At a toy-wide shape (p=800k, n=16) the dense pipeline would hold
+    the (n, p) float64 matrix (~100 MB) plus the (g, n, P) float32
+    tensor (~51 MB); the streaming path touches O(p) stats and one
+    (n, P) block (~0.25 MB) at a time.  The guard bounds the streaming
+    path's RSS growth at a fraction of the dense tensor alone, so any
+    regression that densifies inside _preprocess_streaming trips it.
+    ru_maxrss is a process-lifetime high-water mark, so the probe runs
+    in its own subprocess with the baseline taken after input build."""
+    probe = tmp_path / "rss_probe.py"
+    probe.write_text(_RSS_PROBE.format(repo=REPO))
+    out = subprocess.run(
+        [sys.executable, str(probe)], capture_output=True,
+        text=True, timeout=300)
+    assert out.returncode == 0, out.stderr
+    sparse_kb = json.loads(out.stdout)["delta_kb"]
+    # the (g, n, P) float32 tensor alone is ~51 MB; half of it is
+    # generous headroom for allocator noise while still catching any
+    # dense materialization
+    assert sparse_kb < 24_000, f"streaming ingest peaked at {sparse_kb} kB"
+
+
+# ---------------------------------------------------------------------------
+# cooperative (multi-host) artifact assembly
+# ---------------------------------------------------------------------------
+
+def test_cooperative_pair_slice_partitions_exactly():
+    from dcfm_tpu.serve.artifact import cooperative_pair_slice
+    for n_pairs in (1, 7, 10, 55):
+        for pc in (1, 2, 3, 8):
+            spans = [cooperative_pair_slice(n_pairs, i, pc)
+                     for i in range(pc)]
+            assert spans[0][0] == 0 and spans[-1][1] == n_pairs
+            for (alo, ahi), (blo, bhi) in zip(spans, spans[1:]):
+                assert ahi == blo
+
+
+def test_two_process_cooperative_export_byte_identical(rng, tmp_path):
+    """Two 'hosts' (threads with a real barrier - the phase protocol is
+    what multihost_utils.sync_global_devices provides on a pod) writing
+    their pair slices + the host-0 finalize produce byte-identical
+    panel binaries and meta.json to the single-host export, and the
+    stitched artifact passes the full promotion CRC sweep."""
+    import threading
+
+    from dcfm_tpu.serve.artifact import (
+        MEAN_PANELS_FILE, META_FILE, export_fit_result,
+        export_fit_result_cooperative)
+    from dcfm_tpu.serve.promote import verify_candidate
+
+    Y = _toy(rng)
+    res = fit(_csr_from_dense(Y), CFG)
+    single = str(tmp_path / "single")
+    coop = str(tmp_path / "coop")
+    export_fit_result(res, single)
+    sync = threading.Barrier(2, timeout=60)
+    tags, errs = [], []
+
+    def host(pi):
+        try:
+            export_fit_result_cooperative(
+                res, coop, process_index=pi, process_count=2,
+                barrier=lambda tag: (tags.append(tag), sync.wait()))
+        except Exception as e:  # pragma: no cover - surfaced below
+            errs.append(e)
+            sync.abort()
+
+    threads = [threading.Thread(target=host, args=(pi,)) for pi in (0, 1)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        # unbounded join is safe: the Barrier's own timeout=60 breaks any
+        # stuck phase, which aborts both hosts into errs
+        t.join()
+    assert not errs, errs
+    # each of the three phase barriers fired once per host
+    assert len(tags) == 6 and len(set(tags)) == 3
+    for name in (MEAN_PANELS_FILE, META_FILE):
+        a = open(os.path.join(single, name), "rb").read()
+        b = open(os.path.join(coop, name), "rb").read()
+        assert a == b, f"{name} differs between single-host and cooperative"
+    art = verify_candidate(coop)      # full per-panel CRC sweep
+    assert art.fingerprint == verify_candidate(single).fingerprint
