@@ -67,6 +67,25 @@ INGEST_P = int(os.environ.get("BENCH_INGEST_P", 200_000))
 INGEST_N = int(os.environ.get("BENCH_INGEST_N", 64))
 INGEST_DENSITY = float(os.environ.get("BENCH_INGEST_DENSITY", 0.01))
 
+# Sweep microbench phase (mixed-precision compute path): per-stage
+# ms/iter of the Gibbs sweep's five conditionals (Z / X / Lambda / psi /
+# accumulate) plus the REAL fused gibbs_sweep jit, each timed in f32 AND
+# bf16 at the headline per-chain shape, so the record shows WHERE the
+# iteration budget goes and what the reduced-precision path buys (or
+# costs - on a CPU box bf16 has no MXU to feed, and the casts are pure
+# overhead; the per-backend number is the point).  A reduced-shape fit
+# pair (identical data/schedule, only compute_dtype differs) rides along
+# so the f32-vs-bf16 rel_frob_err delta lands in the same JSON record as
+# the speedup.  BENCH_SWEEP=0 disables; the ms/iter gate binds only at
+# the default north-star shape.
+SWEEP_REPS = int(os.environ.get("BENCH_SWEEP_REPS", 30))
+SWEEP_MS_BUDGET = float(os.environ.get("BENCH_SWEEP_MS", 3.0))
+SWEEP_FIT_P = int(os.environ.get("BENCH_SWEEP_FIT_P", 1024))
+SWEEP_FIT_G = int(os.environ.get("BENCH_SWEEP_FIT_G", 16))
+SWEEP_FIT_N = int(os.environ.get("BENCH_SWEEP_FIT_N", 200))
+SWEEP_FIT_K = int(os.environ.get("BENCH_SWEEP_FIT_K", 64))
+SWEEP_FIT_ITERS = int(os.environ.get("BENCH_SWEEP_FIT_ITERS", 400))
+
 
 def _ingest_probe(kind):
     """Subprocess body of the ingest phase (``bench.py --ingest-probe
@@ -362,6 +381,198 @@ def _pack_probe():
             "chain_s_single": out["single"]}
 
 
+def _sweep_probe():
+    """Fused-sweep microbench: ms/iter per conditional, f32 vs bf16.
+
+    ``sweep_ms_per_iter`` times the REAL :func:`gibbs_sweep` jit (the
+    exact function the chain scans over, including the prior update) at
+    the headline per-chain shape - G local shards of p/G features and
+    k/G factors each - so the number is directly comparable to the
+    1 ms/iter north-star wall and to chain_s/ITERS.  The per-stage
+    samples time standalone jits of the five conditionals' contractions
+    (same formulas, same ops - sample_mvn_precision_*, the batched
+    K x K solve dispatch, gamma_rate, covariance_panels - same ``mm``
+    bf16-inputs/f32-accumulation pattern as models/conditionals.py);
+    they are a BREAKDOWN diagnostic, not a second headline: stage jits
+    lose the fused sweep's cross-stage fusion, so the stage sum runs a
+    little over the fused number by construction.
+
+    Operands come from one real warm-up sweep (not the all-zero Lambda
+    start, whose degenerate products flatter every stage), and the
+    accumulate stage uses the same packed upper-triangle panels and
+    scaled-estimator H path the chain accumulates.  Returns None under
+    BENCH_SWEEP=0.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from dcfm_tpu import BackendConfig, FitConfig, ModelConfig, RunConfig, fit
+    from dcfm_tpu.models.conditionals import covariance_panels, gibbs_sweep
+    from dcfm_tpu.models.priors import make_prior
+    from dcfm_tpu.models.state import init_state, packed_pair_indices
+    from dcfm_tpu.ops.batched_solve import chol_solve_sample_batched
+    from dcfm_tpu.ops.gamma import gamma_rate
+    from dcfm_tpu.ops.gaussian import (sample_mvn_precision_batched,
+                                       sample_mvn_precision_shared)
+
+    if os.environ.get("BENCH_SWEEP", "1") == "0":
+        return None
+    Gl, Pp, K, n = G, P_TOTAL // G, K_TOTAL // G, N
+    rho = 0.9
+    rng = np.random.default_rng(11)
+    Y = jnp.asarray(rng.standard_normal((Gl, n, Pp)), jnp.float32)
+    pair_rows, pair_cols = packed_pair_indices(Gl)
+    sq_r, sq_1mr = float(np.sqrt(rho)), float(np.sqrt(1.0 - rho))
+
+    def _time_ms(fn, *args):
+        jax.block_until_ready(fn(*args))          # compile + warm
+        t0 = time.perf_counter()
+        for _ in range(SWEEP_REPS):
+            out = fn(*args)
+        jax.block_until_ready(out)
+        return round((time.perf_counter() - t0) / SWEEP_REPS * 1e3, 4)
+
+    def _hi(fn):
+        # the sweep's own matmul-precision scope, so the stage mirrors
+        # compile the same bf16_3x contractions the fused path does
+        def wrapped(*a):
+            with jax.default_matmul_precision("high"):
+                return fn(*a)
+        return jax.jit(wrapped)
+
+    def _one_dtype(dtype):
+        bf16 = dtype == "bf16"
+        cfg_m = ModelConfig(num_shards=Gl, factors_per_shard=K, rho=rho,
+                            compute_dtype=dtype)
+        prior = make_prior(cfg_m)
+        key = jax.random.key(17)
+        state = init_state(key, prior, num_local_shards=Gl, n=n, P=Pp, K=K,
+                           as_=cfg_m.as_, bs=cfg_m.bs)
+        sweep = jax.jit(lambda k, y, s: gibbs_sweep(k, y, s, cfg_m, prior))
+        state, _ = sweep(key, Y, state)           # realistic operands
+
+        def mm(a, b):
+            if bf16:
+                return jnp.matmul(a.astype(jnp.bfloat16),
+                                  b.astype(jnp.bfloat16),
+                                  preferred_element_type=jnp.float32)
+            return a @ b
+
+        def z_stage(kz, Ym, Lam, ps, X):
+            def one(kg, Ym, Lam, ps, X):
+                W = Lam * ps[:, None]
+                Q = jnp.eye(K, dtype=Ym.dtype) + (1.0 - rho) * mm(Lam.T, W)
+                R = Ym - sq_r * mm(X, Lam.T)
+                return sample_mvn_precision_shared(kg, Q, sq_1mr * mm(R, W))
+            return jax.vmap(one, in_axes=(0, 0, 0, 0, None))(
+                kz, Ym, Lam, ps, X)
+
+        def x_stage(kx, Ym, Lam, ps, Zs):
+            def terms(Ym, Lam, ps, Zm):
+                W = Lam * ps[:, None]
+                R = Ym - sq_1mr * mm(Zm, Lam.T)
+                return mm(Lam.T, W), mm(R, W)
+            A_loc, B_loc = jax.vmap(terms)(Ym, Lam, ps, Zs)
+            Qx = (cfg_m.x_prior_precision * jnp.eye(K, dtype=Ym.dtype)
+                  + rho * jnp.sum(A_loc, axis=0))
+            return sample_mvn_precision_shared(
+                kx, Qx, sq_r * jnp.sum(B_loc, axis=0))
+
+        def lam_terms(Ym, eta_m, ps, plam_m):
+            E = mm(eta_m.T, eta_m)
+            EY = mm(eta_m.T, Ym)
+            Q = jax.vmap(jnp.diag)(plam_m) + ps[:, None, None] * E[None]
+            return Q, ps[:, None] * EY.T
+
+        def lam_stage(kl, Ym, eta_m, ps, plam_m):
+            if bf16:
+                # the bf16 dispatch: ONE flattened batched factor-solve-
+                # sample over all G*P rows (ops/batched_solve.py)
+                Zn = jax.vmap(lambda k: jax.random.normal(k, (Pp, K)))(kl)
+                Q, B = jax.vmap(lam_terms)(Ym, eta_m, ps, plam_m)
+                return chol_solve_sample_batched(
+                    Q.reshape(Gl * Pp, K, K), B.reshape(Gl * Pp, K),
+                    Zn.reshape(Gl * Pp, K)).reshape(Gl, Pp, K)
+
+            def one(kg, Ym, e, ps, pl):
+                Q, B = lam_terms(Ym, e, ps, pl)
+                return sample_mvn_precision_batched(
+                    kg, Q, B, impl=cfg_m.lambda_kernel)
+            return jax.vmap(one)(kl, Ym, eta_m, ps, plam_m)
+
+        def ps_stage(ks, Ym, eta_m, Lam):
+            def one(kg, Ym, e, L):
+                resid = Ym - e @ L.T              # f32 in BOTH modes
+                sse = jnp.sum(resid * resid, axis=0)
+                return gamma_rate(kg, cfg_m.as_ + 0.5 * n,
+                                  cfg_m.bs + 0.5 * sse)
+            return jax.vmap(one)(ks, Ym, eta_m, Lam)
+
+        c_dtype = jnp.bfloat16 if bf16 else None
+
+        def acc_stage(Lam, ps, eta_m):
+            return covariance_panels(Lam, ps, rho, pair_rows, pair_cols,
+                                     eta_all=eta_m, compute_dtype=c_dtype)
+
+        eta = sq_r * state.X[None] + sq_1mr * state.Z
+        plam = jax.vmap(prior.row_precision)(state.prior)
+        keys = jax.vmap(lambda s: jax.random.split(
+            jax.random.fold_in(key, s), Gl))(jnp.arange(4))
+        stage_ms = {
+            "z": _time_ms(_hi(z_stage), keys[0], Y, state.Lambda,
+                          state.ps, state.X),
+            "x": _time_ms(_hi(x_stage), keys[1][0], Y, state.Lambda,
+                          state.ps, state.Z),
+            "lambda": _time_ms(_hi(lam_stage), keys[2], Y, eta,
+                               state.ps, plam),
+            "psi": _time_ms(_hi(ps_stage), keys[3], Y, eta, state.Lambda),
+            "accumulate": _time_ms(_hi(acc_stage), state.Lambda,
+                                   state.ps, eta),
+        }
+        return {"sweep_ms_per_iter": _time_ms(sweep, key, Y, state),
+                "stage_ms": stage_ms}
+
+    out = {"shape": {"p": P_TOTAL, "g": Gl, "n": n, "k": K_TOTAL},
+           "reps": SWEEP_REPS,
+           "f32": _one_dtype("f32"), "bf16": _one_dtype("bf16")}
+    out["bf16_speedup"] = round(
+        out["f32"]["sweep_ms_per_iter"]
+        / max(out["bf16"]["sweep_ms_per_iter"], 1e-9), 4)
+
+    # Accuracy rider: identical data and schedule, only compute_dtype
+    # differs - the delta must be MC noise, not a bias (the tight parity
+    # band lives in tests/test_precision.py; this records the measured
+    # numbers next to the measured speedup).
+    rngf = np.random.default_rng(5)
+    k_true = 4
+    L = (rngf.standard_normal((SWEEP_FIT_P, k_true))
+         / np.sqrt(k_true)).astype(np.float32)
+    F = rngf.standard_normal((SWEEP_FIT_N, k_true)).astype(np.float32)
+    Yf = (F @ L.T + 0.3 * rngf.standard_normal(
+        (SWEEP_FIT_N, SWEEP_FIT_P))).astype(np.float32)
+    Sigma_true = L @ L.T + 0.09 * np.eye(SWEEP_FIT_P, dtype=np.float32)
+    half = max(SWEEP_FIT_ITERS // 2, 1)
+    errs = {}
+    for dtype in ("f32", "bf16"):
+        cfg = FitConfig(
+            model=ModelConfig(num_shards=SWEEP_FIT_G,
+                              factors_per_shard=SWEEP_FIT_K // SWEEP_FIT_G,
+                              rho=0.9),
+            run=RunConfig(burnin=SWEEP_FIT_ITERS - half, mcmc=half, thin=1,
+                          seed=0, chunk_size=half),
+            backend=BackendConfig(compute_dtype=dtype))
+        r = fit(Yf, cfg)
+        errs[dtype] = round(float(
+            np.linalg.norm(r.Sigma - Sigma_true)
+            / np.linalg.norm(Sigma_true)), 4)
+    out["fit_rel_frob_err"] = dict(
+        errs, delta=round(errs["bf16"] - errs["f32"], 4))
+    out["fit_shape"] = {"p": SWEEP_FIT_P, "g": SWEEP_FIT_G,
+                        "n": SWEEP_FIT_N, "k": SWEEP_FIT_K,
+                        "iters": SWEEP_FIT_ITERS}
+    return out
+
+
 def main():
     import jax
 
@@ -574,6 +785,12 @@ def main():
     # device count can't express it (e.g. the 1-chip TPU lane).
     pack = _pack_probe()
 
+    # Sweep microbench phase (BENCH_SWEEP=0 disables): per-stage ms/iter
+    # of the five conditionals + the real fused gibbs_sweep jit, f32 vs
+    # bf16, with the reduced-shape accuracy pair riding along.  Runs
+    # AFTER the timed runs so its extra compiles never pollute them.
+    sweep = _sweep_probe()
+
     # Early-stop phase: the SAME north-star workload under
     # early_stop="rhat" with chunk boundaries every ITERS/8 iterations.
     # The run must converge before the full schedule (stopped_at_iter
@@ -722,6 +939,18 @@ def main():
         # cost in accuracy, and the full per-boundary decision trail.
         "early_stop": es,
         "stopped_at_iter": (es or {}).get("stopped_at_iter"),
+        # Sweep microbench (null under BENCH_SWEEP=0): ms/iter of the
+        # REAL fused gibbs_sweep jit at the headline per-chain shape -
+        # the number the 1 ms/iter north-star wall is about - plus the
+        # per-stage (Z/X/Lambda/psi/accumulate) breakdown and the
+        # f32-vs-bf16 speedup + rel_frob_err delta, so a precision-path
+        # claim is always paired with its measured accuracy cost.  On a
+        # CPU lane bf16_speedup < 1 is EXPECTED (no MXU; the casts are
+        # pure overhead) - the record, not a gate, carries that verdict.
+        "sweep_ms_per_iter": (sweep["f32"]["sweep_ms_per_iter"]
+                              if sweep else None),
+        "sweep_bf16_speedup": (sweep["bf16_speedup"] if sweep else None),
+        "sweep": sweep,
     }
     print(json.dumps(result))
     # Regression gates - this script exits non-zero so the driver FAILS on
@@ -849,6 +1078,20 @@ def main():
                   f"thresholds rhat<{ES_RHAT} ess>={ES_ESS})",
                   file=sys.stderr)
             status = 1
+    # * sweep ms/iter: the default (f32) fused-sweep cost at the gated
+    #   shape.  Budget 3.0 ms/iter tracks the chain_s budget (3.5 s /
+    #   1000 iters, which also carries the accumulate and trace) - a
+    #   sweep that alone eats the whole chain budget has genuinely
+    #   regressed.  Like chain_s this only binds at the default
+    #   north-star shape, i.e. the accelerator lane; a CPU box never
+    #   reaches this gate without first failing chain_s.
+    if (default_shape and sweep is not None
+            and sweep["f32"]["sweep_ms_per_iter"] > SWEEP_MS_BUDGET):
+        print(f"SWEEP REGRESSION: f32 fused sweep "
+              f"{sweep['f32']['sweep_ms_per_iter']:.3f} ms/iter > "
+              f"{SWEEP_MS_BUDGET} ms/iter budget (stages: "
+              f"{sweep['f32']['stage_ms']})", file=sys.stderr)
+        status = 1
     return status
 
 

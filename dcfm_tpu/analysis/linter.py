@@ -1426,6 +1426,95 @@ def _check_dense_quadratic(mod: _Module, rep: _Reporter) -> None:
 
 
 # =====================================================================
+# DCFM16xx - mixed-precision discipline
+# =====================================================================
+
+_LOWP_DTYPES = {"jnp.bfloat16", "jax.numpy.bfloat16",
+                "jnp.float16", "jax.numpy.float16"}
+_LOWP_STRS = {"bfloat16", "float16", "bf16", "fp16"}
+_MATMUL_FNS = {"jnp.dot", "jax.numpy.dot",
+               "jnp.matmul", "jax.numpy.matmul",
+               "jnp.einsum", "jax.numpy.einsum",
+               "jnp.tensordot", "jax.numpy.tensordot"}
+
+
+def _is_lowp_dtype_expr(mod: _Module, node) -> bool:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value in _LOWP_STRS
+    return mod.resolve(node) in _LOWP_DTYPES
+
+
+def _is_lowp_cast(mod: _Module, node) -> bool:
+    """``x.astype(jnp.bfloat16)`` / ``jnp.asarray(x, dtype='float16')``
+    and friends - an expression that PRODUCES a low-precision array."""
+    if not isinstance(node, ast.Call):
+        return False
+    if (isinstance(node.func, ast.Attribute) and node.func.attr == "astype"
+            and node.args and _is_lowp_dtype_expr(mod, node.args[0])):
+        return True
+    full = mod.resolve(node.func)
+    if full.startswith("jnp.") or full.startswith("jax.numpy."):
+        for k in node.keywords:
+            if k.arg == "dtype" and _is_lowp_dtype_expr(mod, k.value):
+                return True
+    return False
+
+
+def _check_precision_matmul(mod: _Module, rep: _Reporter) -> None:
+    """DCFM1601: a contraction over bf16/f16-cast operands without
+    ``preferred_element_type`` accumulates in the LOW precision - the
+    one way the mixed-precision sweep (BackendConfig.compute_dtype=
+    "bf16") can silently void its accuracy contract, since every other
+    piece (state, RNG, K x K factorizations) stays f32 by construction.
+
+    Taint is name-based per module: names assigned from a low-precision
+    cast anywhere in the file, plus inline cast expressions used
+    directly as operands.  Scope-blind on purpose - a name that holds
+    bf16 in ANY scope deserves the annotation everywhere it is
+    contracted; shadowing false positives carry an inline pragma."""
+    tainted: set = set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Assign) and _is_lowp_cast(mod, node.value):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    tainted.add(t.id)
+        elif (isinstance(node, ast.AnnAssign) and node.value is not None
+              and _is_lowp_cast(mod, node.value)
+              and isinstance(node.target, ast.Name)):
+            tainted.add(node.target.id)
+
+    def lowp_operand(a) -> bool:
+        return ((isinstance(a, ast.Name) and a.id in tainted)
+                or _is_lowp_cast(mod, a))
+
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.MatMult):
+            if lowp_operand(node.left) or lowp_operand(node.right):
+                rep.emit(
+                    "DCFM1601", node,
+                    "`@` on a bfloat16/float16-cast operand accumulates "
+                    "in the low input precision - use jnp.matmul(..., "
+                    "preferred_element_type=jnp.float32) (the "
+                    "models/conditionals.py `mm` pattern)")
+        elif isinstance(node, ast.Call):
+            full = mod.resolve(node.func)
+            if full not in _MATMUL_FNS:
+                continue
+            if any(k.arg == "preferred_element_type"
+                   for k in node.keywords):
+                continue
+            if any(lowp_operand(a) for a in node.args):
+                rep.emit(
+                    "DCFM1601", node,
+                    f"{full} on a bfloat16/float16-cast operand without "
+                    "preferred_element_type - the contraction "
+                    "accumulates in the low input precision; pass "
+                    "preferred_element_type=jnp.float32 so only the "
+                    "MULTIPLY runs low-precision (f32 accumulation, "
+                    "README 'Precision policy')")
+
+
+# =====================================================================
 # DCFM002 - stale suppressions
 # =====================================================================
 
@@ -1490,6 +1579,7 @@ def lint_source(source: str, path: str = "<string>",
     check_lifetime(mod, rep, project)
     _check_chain_reductions(mod, rep)
     _check_dense_quadratic(mod, rep)
+    _check_precision_matmul(mod, rep)
     _check_stale_pragmas(mod, rep)      # must stay last: reads the ledger
     rep.findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return rep.findings

@@ -245,4 +245,16 @@ RULES = {r.id: r for r in [
          "or put 'chain' in the reducing helper's own name so the "
          "intent is explicit",
          library_only=True),
+    # ---- DCFM16xx: mixed-precision discipline ------------------------
+    Rule("DCFM1601", "precision-unsafe-matmul", "precision",
+         "a jnp.dot/jnp.matmul/jnp.einsum call or `@` operator takes an "
+         "operand cast to bfloat16/float16 (`.astype(jnp.bfloat16)`, "
+         "`dtype='bfloat16'`, ...) without `preferred_element_type` - "
+         "the contraction then ACCUMULATES in the low input precision "
+         "instead of float32, which is how the mixed-precision sweep "
+         "silently loses the accuracy contract (README 'Precision "
+         "policy').  Pass preferred_element_type=jnp.float32 at every "
+         "low-precision matmul, as models/conditionals.py's `mm` helper "
+         "and the combine-step einsum do",
+         library_only=True),
 ]}

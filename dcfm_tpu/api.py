@@ -367,6 +367,51 @@ class FitResult:
             force=self.config.materialize_sigma == "always")
 
 
+def _pin_carry_layouts(chunk_callable):
+    """Wrap a chunk function so the carry's OUTPUT placement is pinned
+    to its INPUT placement across the jit boundary.
+
+    The chunk jit donates its carry - the accumulator panels are the
+    dominant device buffers - and XLA only aliases a donated buffer
+    when the matching output has the SAME sharding and device-local
+    layout.  Left unconstrained, layout assignment is free to pick a
+    different result layout (it optimizes the program in isolation, not
+    the chunk-to-chunk feedback loop), which silently turns EVERY chunk
+    boundary into a full relayout copy of the carry.  The pin closes
+    the loop: on the first call the concrete carry's layouts are read
+    off the arrays (metadata only) and compiled in as ``in_shardings``
+    / ``out_shardings`` for the carry argument and carry output, so
+    out == in by construction and donation aliases at steady state.
+    runtime/pipeline.py's ``dcfm_fit_carry_relayouts`` gauge verifies
+    the invariant (tests/test_precision.py pins it at 0).
+
+    One pinned jit is cached per distinct carry placement signature
+    (resume paths can present a different committed placement than a
+    fresh init); anything that defeats the metadata read falls back to
+    the plain donating jit unchanged.
+    """
+    cache = {}
+
+    def call(key, Y, carry, sched):
+        try:
+            lcar = jax.tree.map(lambda a: a.layout, carry)
+            sig = tuple(repr(l) for l in jax.tree.leaves(lcar))
+        except Exception:  # dcfm: ignore[DCFM601] - optional layout probe: non-array leaves / older jax fall back to the unpinned donating jit
+            lcar, sig = None, None
+        jf = cache.get(sig)
+        if jf is None:
+            if lcar is None:
+                jf = jax.jit(chunk_callable, donate_argnums=(2,))
+            else:
+                jf = jax.jit(chunk_callable, donate_argnums=(2,),
+                             in_shardings=(None, None, lcar, None),
+                             out_shardings=(lcar, None, None))
+            cache[sig] = jf
+        return jf(key, Y, carry, sched)
+
+    return call
+
+
 @functools.lru_cache(maxsize=32)
 def _local_fns(model: ModelConfig, num_iters: int, num_chains: int = 1,
                num_stored_draws: int = 0, unroll: int = 1):
@@ -399,7 +444,7 @@ def _local_fns(model: ModelConfig, num_iters: int, num_chains: int = 1,
     # (p^2/g bytes single-device); donation lets XLA update it in place
     # instead of holding old + new across every chunk call.
     if num_chains == 1:
-        return jax.jit(init_one), jax.jit(chunk_one, donate_argnums=(2,))
+        return jax.jit(init_one), _pin_carry_layouts(chunk_one)
 
     def init_fn(key, Y):
         return jax.vmap(init_one, in_axes=(0, None))(
@@ -409,7 +454,7 @@ def _local_fns(model: ModelConfig, num_iters: int, num_chains: int = 1,
         return jax.vmap(chunk_one, in_axes=(0, None, 0, None))(
             chain_keys(key, num_chains), Y, carry, sched)
 
-    return jax.jit(init_fn), jax.jit(chunk_fn, donate_argnums=(2,))
+    return jax.jit(init_fn), _pin_carry_layouts(chunk_fn)
 
 
 @functools.lru_cache(maxsize=32)
@@ -525,6 +570,7 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
                  chunk_size=cfg.run.chunk_size, seed=cfg.run.seed,
                  num_chains=cfg.run.num_chains,
                  fetch_dtype=cfg.backend.fetch_dtype,
+                 compute_dtype=cfg.backend.compute_dtype,
                  checkpoint=bool(cfg.checkpoint_path),
                  resume=str(cfg.resume))
         try:
@@ -591,6 +637,14 @@ def _fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
         # the user's config round-trips unchanged through checkpoints, and
         # complete-data fits compile exactly their usual code.
         m = dataclasses.replace(m, impute_missing=True)
+    if m.compute_dtype != cfg.backend.compute_dtype:
+        # Thread the backend's sweep-precision knob into the INTERNAL model
+        # config (same pattern as impute_missing above / the pallas
+        # -interpret substitution below): the frozen ModelConfig keys every
+        # jit cache, so a dtype change retraces instead of reusing the f32
+        # graph, while the user's config - and the checkpoint fingerprint
+        # built from it - round-trips unchanged.
+        m = dataclasses.replace(m, compute_dtype=cfg.backend.compute_dtype)
     key = jax.random.key(run.seed)
     k_init, k_chain = jax.random.split(key)
     if cfg.warm_start is not None:

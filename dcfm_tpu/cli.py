@@ -285,6 +285,16 @@ def build_parser() -> argparse.ArgumentParser:
                    help="input dtype of the combine-step block matmuls; "
                         "bfloat16 feeds the TPU MXU at native rate with "
                         "float32 accumulation")
+    f.add_argument("--compute-dtype", default="f32",
+                   choices=["f32", "bf16"],
+                   help="input dtype of the LARGE Gibbs-sweep matmuls "
+                        "(Z/X/Lambda updates and the covariance-panel "
+                        "accumulation).  'bf16' feeds them to the MXU at "
+                        "native rate with float32 accumulation; all chain "
+                        "state, RNG draws, and every K x K factorization "
+                        "stay float32 (see README 'Precision policy').  "
+                        "'f32' (default) compiles graphs bitwise-identical "
+                        "to a build without the knob")
     f.add_argument("--combine-chunks", type=int, default=1,
                    help="split each saved draw's combine into this many "
                         "column chunks with a cross-shard rendezvous between "
@@ -521,6 +531,7 @@ def main(argv=None) -> int:
                               mesh_devices=args.mesh_devices,
                               fetch_dtype=args.fetch_dtype,
                               upload_dtype=args.upload_dtype,
+                              compute_dtype=args.compute_dtype,
                               profile_dir=args.profile_dir),
         permute=not args.no_permute,
         checkpoint_path=args.checkpoint,
@@ -610,6 +621,7 @@ def main(argv=None) -> int:
         "shape": (list(Sigma.shape) if Sigma is not None
                   else [res.preprocess.p_original] * 2),
         "seconds": round(res.seconds, 3),
+        "compute_dtype": cfg.backend.compute_dtype,
         "iters_per_sec": round(res.iters_per_sec, 2),
         "chain_iters_per_sec": round(res.chain_iters_per_sec, 2),
         "phase_seconds": {k: round(v, 3)

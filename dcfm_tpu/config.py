@@ -121,6 +121,13 @@ class ModelConfig:
     # Gibbs sweep itself always runs float32 (K x K Cholesky in bf16 is
     # unusable - SURVEY.md section 7 "Numerics").
     combine_dtype: str = "float32"  # "float32" | "bfloat16"
+    # INTERNAL mirror of BackendConfig.compute_dtype: fit() copies the
+    # backend knob here (dataclasses.replace, like impute_missing and the
+    # pallas -interpret substitution) so the jit caches - keyed on this
+    # frozen config - retrace when the sweep precision changes, while the
+    # user-facing config round-trips unchanged through checkpoints.  Set
+    # it on BackendConfig, not here.
+    compute_dtype: str = "f32"  # "f32" | "bf16"
     # Implementation of the Lambda-update batched K x K Cholesky sampler
     # (SURVEY.md C10).  "auto" picks the statically-unrolled elementwise
     # XLA path for K <= 16 and lax.linalg beyond - use it.  The profiled
@@ -290,6 +297,20 @@ class BackendConfig:
     #            fetch dtypes);
     #   "off"  - the pre-streaming post-hoc fetch.
     fetch_stream: str = "auto"   # "auto" | "on" | "off"
+    # Input dtype for the LARGE sweep matmuls (models/conditionals.py:
+    # `weighted`, the z_update/x_terms/lam_terms tall-skinny products,
+    # and the covariance_panels accumulation inputs).  "f32" - the
+    # default - compiles graphs bitwise-identical to a build without
+    # the knob.  "bf16" casts only those matmul INPUTS to bfloat16 with
+    # `preferred_element_type=float32` (MXU-native rate, f32
+    # accumulation); all sampler state, accumulators, RNG draws, and
+    # every K x K sampling precision / Cholesky stay float32 end-to-end
+    # (K x K Cholesky in bf16 is unusable - SURVEY.md section 7).
+    # Accuracy contract: bf16 fits land inside the measured cross-chain
+    # MC spread of f32 fits (tests/test_precision.py pins it);
+    # checkpoint meta records the dtype and resume refuses a mismatched
+    # donor.
+    compute_dtype: str = "f32"   # "f32" | "bf16"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -605,6 +626,15 @@ def validate(cfg: FitConfig, n: int, p: int) -> None:
         raise ValueError(
             f"unknown upload_dtype {cfg.backend.upload_dtype!r} "
             "(float32 | float16 | bfloat16)")
+    if cfg.backend.compute_dtype not in ("f32", "bf16"):
+        raise ValueError(
+            f"unknown compute_dtype {cfg.backend.compute_dtype!r} "
+            "(f32 | bf16)")
+    if m.compute_dtype not in ("f32", "bf16"):
+        raise ValueError(
+            f"unknown compute_dtype {m.compute_dtype!r} (f32 | bf16); "
+            "set it on BackendConfig - the ModelConfig field is the "
+            "internal mirror fit() threads for jit-cache keying")
     if cfg.backend.fetch_stream not in ("auto", "on", "off"):
         raise ValueError(
             f"unknown fetch_stream {cfg.backend.fetch_stream!r} "

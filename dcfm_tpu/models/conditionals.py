@@ -144,6 +144,26 @@ def _gibbs_sweep(key, Y, state, cfg, prior, *, shard_offset, reduce_fn):
     rho = cfg.rho
     sq_r, sq_1mr = jnp.sqrt(rho), jnp.sqrt(1.0 - rho)
 
+    # Mixed-precision compute path (ModelConfig.compute_dtype, the internal
+    # mirror of BackendConfig.compute_dtype).  Guarded at TRACE time like
+    # the ridge_jitter below: the "f32" default takes the `a @ b` branch in
+    # `mm` and compiles exactly the pre-knob graph - bit-identical fits -
+    # while "bf16" casts only the LARGE matmul inputs to bfloat16 with
+    # preferred_element_type=f32 (MXU-native rate, f32 accumulation).  All
+    # state, RNG draws, accumulators, and every K x K sampling precision /
+    # Cholesky stay float32 (K x K Cholesky in bf16 is unusable - SURVEY.md
+    # section 7 "Numerics"); the per-op rounding this buys is ~2^-8 on the
+    # tall-skinny products only, inside the cross-chain MC spread of f32
+    # fits (tests/test_precision.py pins the parity band).
+    bf16 = cfg.compute_dtype == "bf16"
+
+    def mm(a, b):
+        if bf16:
+            return jnp.matmul(a.astype(jnp.bfloat16),
+                              b.astype(jnp.bfloat16),
+                              preferred_element_type=jnp.float32)
+        return a @ b
+
     # Omega^{-1} Lambda, the precision-weighted loadings used by Z and X
     # (the reference weights by Omega, which holds *variances* after iter 1 -
     # quirk Q1; ``divideconquer.m:98,:114,:123``).
@@ -164,11 +184,11 @@ def _gibbs_sweep(key, Y, state, cfg, prior, *, shard_offset, reduce_fn):
 
     def z_update(kg, Ym, Lam, ps, X):
         W = weighted(Lam, ps)                                   # (P, K)
-        Q = jnp.eye(K, dtype=Ym.dtype) + (1.0 - rho) * (Lam.T @ W)
+        Q = jnp.eye(K, dtype=Ym.dtype) + (1.0 - rho) * mm(Lam.T, W)
         if jit_eps:
             Q = Q + jit_eps * jnp.eye(K, dtype=Ym.dtype)
-        R = Ym - sq_r * (X @ Lam.T)                             # (n, P)
-        B = sq_1mr * (R @ W)                                    # (n, K)
+        R = Ym - sq_r * mm(X, Lam.T)                            # (n, P)
+        B = sq_1mr * mm(R, W)                                   # (n, K)
         return sample_mvn_precision_shared(kg, Q, B)
 
     with jax.named_scope("z_update"):
@@ -179,9 +199,9 @@ def _gibbs_sweep(key, Y, state, cfg, prior, *, shard_offset, reduce_fn):
     # ---- II) X | rest - the one cross-shard update (``:111-129``) ------
     def x_terms(Ym, Lam, ps, Zm):
         W = weighted(Lam, ps)
-        A = Lam.T @ W                                           # (K, K)
-        R = Ym - sq_1mr * (Zm @ Lam.T)                          # (n, P)
-        B = R @ W                                               # (n, K)
+        A = mm(Lam.T, W)                                        # (K, K)
+        R = Ym - sq_1mr * mm(Zm, Lam.T)                         # (n, P)
+        B = mm(R, W)                                            # (n, K)
         return A, B
 
     with jax.named_scope("x_update"):
@@ -218,8 +238,8 @@ def _gibbs_sweep(key, Y, state, cfg, prior, *, shard_offset, reduce_fn):
     eta_lam = eta if state.active is None else eta * state.active[:, None, :]
 
     def lam_terms(Ym, eta_m, ps, plam_m):
-        E = eta_m.T @ eta_m                                     # (K, K)
-        EY = eta_m.T @ Ym                                       # (K, P)
+        E = mm(eta_m.T, eta_m)                                  # (K, K)
+        EY = mm(eta_m.T, Ym)                                    # (K, P)
         Q = (jax.vmap(jnp.diag)(plam_m)
              + ps[:, None, None] * E[None])                     # (P, K, K)
         B = ps[:, None] * EY.T                                  # (P, K)
@@ -270,6 +290,21 @@ def _gibbs_sweep(key, Y, state, cfg, prior, *, shard_offset, reduce_fn):
                     Q.reshape(Gl * P, K, K), B.reshape(Gl * P, K),
                     Zn.reshape(Gl * P, K), interpret=interp
                 ).reshape(Gl, P, K)
+        elif bf16:
+            # Mixed-precision path: flatten shards x rows into ONE batched
+            # factor-solve-sample dispatch (ops/batched_solve.py - Pallas
+            # on TPU, fused elementwise recurrence elsewhere) instead of
+            # the vmap-per-shard sampler.  Q and the Cholesky stay f32;
+            # only lam_terms' inputs above ran bf16.  Same per-shard noise
+            # keys as every other path.
+            from dcfm_tpu.ops.batched_solve import chol_solve_sample_batched
+            Zn = jax.vmap(
+                lambda k, s: jax.random.normal(k, s.shape, s.dtype))(
+                    kl, state.Lambda)
+            Q, B = jax.vmap(lam_terms)(Y, eta_lam, state.ps, plam)
+            Lam = chol_solve_sample_batched(
+                Q.reshape(Gl * P, K, K), B.reshape(Gl * P, K),
+                Zn.reshape(Gl * P, K)).reshape(Gl, P, K)
         else:
             Lam = jax.vmap(lam_update)(kl, Y, eta_lam, state.ps, plam)
         if state.active is not None:

@@ -417,8 +417,14 @@ def run_chunk(
                 eta_all = gather_fn(eta)
             else:
                 eta = eta_all = None
+            # combine-step input dtype: the explicit combine_dtype knob,
+            # OR the sweep-wide mixed-precision policy (compute_dtype=
+            # "bf16" runs the accumulation inputs bf16 too - the combine
+            # einsum is the largest matmul of a save iteration).  f32
+            # accumulation either way via preferred_element_type.
             c_dtype = (jnp.bfloat16
-                       if cfg.combine_dtype == "bfloat16" else None)
+                       if (cfg.combine_dtype == "bfloat16"
+                           or cfg.compute_dtype == "bf16") else None)
             if cfg.combine_chunks <= 1:
                 blocks = covariance_panels(
                     Lam_all, ps_all, cfg.rho, p_rows, p_cols,

@@ -1,0 +1,313 @@
+"""Batched K x K Cholesky SOLVES: one dispatch for a whole shard's solves.
+
+The Gibbs sweep's small-matrix linear algebra comes in two shapes:
+
+* per-feature systems (the Lambda update): ~10^4 DIFFERENT K x K SPD
+  precisions per sweep, one per loading row, each with one right-hand
+  side; and
+* per-row systems (the Z / X updates): ONE K x K precision shared by
+  thousands of rows - factor once, solve a (K, n) right-hand block.
+
+ops/gaussian.py owns the *sampling* kernels (factor + solve + normal
+draw).  This module is the plain SOLVE x = Q^{-1} b as its own seam: the
+mixed-precision compute path (ModelConfig.compute_dtype="bf16") keeps
+every K x K factorization in f32 while the big matmuls run bf16, and
+routes the per-feature solves of an entire shard group through ONE
+flattened (G*P, K, K) dispatch here instead of a vmap-of-vmap over
+`cho_solve`.
+
+Implementations (``impl``):
+
+* ``"unrolled"`` - K statically-unrolled elementwise recurrence steps
+  (the ops/gaussian.py `_chol_unrolled` technique): the batch axis is
+  pure vectorized arithmetic, sequential depth K.  K <= 16.  The
+  fallback runs the kernels' OWN ``_lane_*`` recurrence helpers on the
+  same padded lane-major operands (only the pallas_call wrapper
+  removed), so it is BITWISE-identical to ``"pallas-interpret"`` -
+  identical XLA graph, hence identical fused-multiply-add contraction
+  choices; tests/test_precision.py pins it.
+* ``"pallas"`` / ``"pallas-interpret"`` - the fused TPU kernel below
+  (batch on the lane dimension, the pallas_gaussian.py layout);
+  interpreter mode off-TPU.  Division by the diagonal, never
+  multiply-by-reciprocal, matching the unrolled op order exactly.
+  K <= 16.
+* ``"lax"`` - lax.linalg.cholesky + two triangular solves (any K).
+* ``"auto"`` - unrolled for K <= 16 (pallas adds nothing off-TPU and
+  measures at parity on it - the lambda_kernel lesson), lax beyond.
+
+Every path factors in the INPUT dtype (f32 throughout the sweep: K x K
+Cholesky in bf16 is unusable - SURVEY.md section 7 "Numerics").
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from dcfm_tpu.ops.gaussian import _tri_solve
+
+_MAX_K = 16   # statically-unrolled recurrence bound (= gaussian._UNROLL_MAX_K)
+_TILE_B = 512
+
+_IMPLS = ("auto", "unrolled", "lax", "pallas", "pallas-interpret")
+
+
+def cho_solve_batched(
+    Q: jax.Array,
+    B: jax.Array,
+    *,
+    impl: str = "auto",
+) -> jax.Array:
+    """Solve x_j = Q_j^{-1} b_j for per-row SPD precisions, one dispatch.
+
+    Args:
+      Q: (Bn, K, K) SPD matrices (a whole shard group flattened - the
+        caller reshapes (G, P, K, K) -> (G*P, K, K) so the batch is ONE
+        kernel launch, not a vmap'd per-shard dispatch).
+      B: (Bn, K) right-hand sides.
+      impl: see module docstring.  "pallas"/"pallas-interpret" with
+        K > 16 falls back to the lax path (the unrolled recurrence is
+        static in K), which keeps the bitwise pin trivial there.
+
+    Returns: (Bn, K) solutions, same dtype as the inputs.
+    """
+    if impl not in _IMPLS:
+        raise ValueError(
+            f"unknown impl {impl!r} ({' | '.join(_IMPLS)}); a typo would "
+            "otherwise silently fall back to the slow lax path")
+    K = Q.shape[-1]
+    if impl in ("pallas", "pallas-interpret") and K <= _MAX_K:
+        interpret = (jax.default_backend() != "tpu"
+                     if impl == "pallas" else True)
+        return _cho_solve_pallas_jit(Q, B, bool(interpret))
+    if impl == "unrolled" or (impl == "auto" and K <= _MAX_K):
+        return _cho_solve_unrolled_jit(Q, B)
+    return _cho_solve_lax_jit(Q, B)
+
+
+def chol_solve_sample_batched(
+    Q: jax.Array,
+    B: jax.Array,
+    Zn: jax.Array,
+    *,
+    impl: str = "auto",
+) -> jax.Array:
+    """Posterior mean + noise in ONE factorization per system (Rue 2001):
+    x_j = Q_j^{-1} b_j + L_j^{-T} z_j for a flattened (Bn, K, K) batch.
+
+    This is the mixed-precision sweep's Lambda-update dispatch
+    (models/conditionals.py, compute_dtype="bf16"): the whole shard
+    group's per-feature systems run as one batch here - one kernel
+    launch on TPU ("auto" picks the Pallas path there), one fused
+    elementwise recurrence elsewhere - instead of a vmap-per-shard
+    sampler dispatch.  Zn is passed in so the RNG stays in the caller's
+    per-shard key discipline.  Factorization dtype = input dtype (f32).
+    """
+    if impl not in _IMPLS:
+        raise ValueError(
+            f"unknown impl {impl!r} ({' | '.join(_IMPLS)}); a typo would "
+            "otherwise silently fall back to the slow lax path")
+    K = Q.shape[-1]
+    if impl == "auto":
+        if K <= _MAX_K:
+            impl = ("pallas" if jax.default_backend() == "tpu"
+                    else "unrolled")
+        else:
+            impl = "lax"
+    if impl in ("pallas", "pallas-interpret") and K <= _MAX_K:
+        interpret = (jax.default_backend() != "tpu"
+                     if impl == "pallas" else True)
+        return _chol_solve_sample_pallas_jit(Q, B, Zn, bool(interpret))
+    if impl == "unrolled" and K <= _MAX_K:
+        return _chol_solve_sample_unrolled_jit(Q, B, Zn)
+    return _chol_solve_sample_lax_jit(Q, B, Zn)
+
+
+def cho_solve_shared(Q: jax.Array, B: jax.Array) -> jax.Array:
+    """Solve X = Q^{-1} B' for ONE shared SPD precision and a (n, K)
+    right-hand block - the Z/X-update mean shape (factor once, solve a
+    full (K, n) panel in one triangular-solve dispatch)."""
+    L = lax.linalg.cholesky(Q)
+    return _tri_solve(L, _tri_solve(L, B.T, trans=False), trans=True).T
+
+
+class _HostRef:
+    """Minimal pallas-Ref stand-in: index-only reads over a plain array,
+    so the ``_lane_*`` recurrences below run UNCHANGED outside
+    pallas_call as the "unrolled" fallback."""
+
+    __slots__ = ("a",)
+
+    def __init__(self, a):
+        self.a = a
+
+    def __getitem__(self, s):
+        return self.a[s]
+
+
+# Fallback impls.  "unrolled" executes the EXACT op graph of the pallas
+# kernels - same lane-major orientation, same _pad_batch padding, same
+# _lane_* recurrence helpers, only the pallas_call wrapper removed - and
+# is jitted even at top level.  Both choices are load-bearing for the
+# bitwise pin (tests/test_precision.py): two structurally DIFFERENT XLA
+# programs make different fused-multiply-add contraction choices for the
+# `acc - c * x` recurrence steps (observed: a batch-major unrolled
+# fallback matched the kernel bitwise at K=4 and drifted 1-2 ulp at
+# K=16), and eager per-op dispatch denies XLA the FMA altogether.
+# Identical graph -> identical contraction -> identical bits.
+@jax.jit
+def _cho_solve_unrolled_jit(Q, B):
+    P, K = B.shape
+    _, _, (Qp, Bp) = _pad_batch(K, B.dtype, [Q, B])
+    cols = _chol_lane_factor(_HostRef(jnp.transpose(Qp, (2, 1, 0))), K)
+    v = _lane_fwd_solve(cols, _HostRef(Bp.T), K)
+    x = _lane_bwd_solve(cols, v, K)
+    return jnp.concatenate(x, axis=0)[:, :P].T
+
+
+@jax.jit
+def _cho_solve_lax_jit(Q, B):
+    L = lax.linalg.cholesky(Q)                        # (Bn, K, K)
+    return _tri_solve(L, _tri_solve(L, B, trans=False), trans=True)
+
+
+@jax.jit
+def _chol_solve_sample_unrolled_jit(Q, B, Zn):
+    P, K = B.shape
+    _, _, (Qp, Bp, Zp) = _pad_batch(K, B.dtype, [Q, B, Zn])
+    cols = _chol_lane_factor(_HostRef(jnp.transpose(Qp, (2, 1, 0))), K)
+    v = _lane_fwd_solve(cols, _HostRef(Bp.T), K)
+    m = _lane_bwd_solve(cols, v, K)
+    Zt = Zp.T
+    y = _lane_bwd_solve(cols, [Zt[j:j + 1, :] for j in range(K)], K)
+    out = jnp.concatenate([m[j] + y[j] for j in range(K)], axis=0)
+    return out[:, :P].T
+
+
+@jax.jit
+def _chol_solve_sample_lax_jit(Q, B, Zn):
+    L = lax.linalg.cholesky(Q)
+    M = _tri_solve(L, _tri_solve(L, B, trans=False), trans=True)
+    return M + _tri_solve(L, Zn, trans=True)
+
+
+def _chol_lane_factor(q_ref, K: int) -> list:
+    """Lower-Cholesky of one lane tile: cols[j] = rows j..K-1 of column j
+    as a (K-j, TILE_B) slab - the pallas_gaussian.py recurrence, with the
+    SAME op order as gaussian._chol_unrolled."""
+    cols = []
+    for j in range(K):
+        s = q_ref[j, j:, :]                          # (K-j, TILE_B)
+        for t in range(j):
+            s = s - cols[t][j - t:, :] * cols[t][j - t:j - t + 1, :]
+        d = jnp.sqrt(s[:1, :])                       # (1, TILE_B) = L_jj
+        if K - j > 1:
+            cols.append(jnp.concatenate([d, s[1:, :] / d], axis=0))
+        else:
+            cols.append(d)
+    return cols
+
+
+def _lane_fwd_solve(cols: list, b_ref, K: int) -> list:
+    """L v = b over the lane tile; v[j] is (1, TILE_B)."""
+    v = []
+    for j in range(K):
+        acc = b_ref[j:j + 1, :]
+        for t in range(j):
+            acc = acc - cols[t][j - t:j - t + 1, :] * v[t]
+        v.append(acc / cols[j][:1, :])
+    return v
+
+
+def _lane_bwd_solve(cols: list, rows: list, K: int) -> list:
+    """L' x = b over the lane tile, b given as K (1, TILE_B) rows.
+    `acc / d`, never `acc * (1/d)` - the bitwise pin vs the unrolled
+    fallback depends on matching its division exactly."""
+    x = [None] * K
+    for j in reversed(range(K)):
+        acc = rows[j]
+        for i in range(j + 1, K):
+            acc = acc - cols[j][i - j:i - j + 1, :] * x[i]
+        x[j] = acc / cols[j][:1, :]
+    return x
+
+
+def _cho_solve_kernel(q_ref, b_ref, out_ref, *, K: int):
+    """One B-tile of the plain solve x = Q^{-1} b."""
+    cols = _chol_lane_factor(q_ref, K)
+    v = _lane_fwd_solve(cols, b_ref, K)
+    x = _lane_bwd_solve(cols, v, K)
+    for j in range(K):
+        out_ref[j:j + 1, :] = x[j]
+
+
+def _chol_solve_sample_kernel(q_ref, b_ref, z_ref, out_ref, *, K: int):
+    """One B-tile of the Rue (2001) mean + noise: m + y with L L' m = b
+    and L' y = z, one factorization."""
+    cols = _chol_lane_factor(q_ref, K)
+    v = _lane_fwd_solve(cols, b_ref, K)
+    m = _lane_bwd_solve(cols, v, K)
+    y = _lane_bwd_solve(cols, [z_ref[j:j + 1, :] for j in range(K)], K)
+    for j in range(K):
+        out_ref[j:j + 1, :] = m[j] + y[j]
+
+
+def _pad_batch(K, dtype, arrs):
+    """Pad the batch axis to a _TILE_B multiple: identity precisions /
+    zero rhs - sqrt(1) and solves over zeros, no NaN, sliced out after."""
+    P = arrs[1].shape[0]
+    n_tiles = max((P + _TILE_B - 1) // _TILE_B, 1)
+    Pp = n_tiles * _TILE_B
+    if Pp == P:
+        return n_tiles, Pp, arrs
+    pad = Pp - P
+    eyeK = jnp.broadcast_to(jnp.eye(K, dtype=dtype), (pad, K, K))
+    out = [jnp.concatenate([arrs[0], eyeK], axis=0)]
+    out += [jnp.concatenate([a, jnp.zeros((pad, K), dtype)], axis=0)
+            for a in arrs[1:]]
+    return n_tiles, Pp, out
+
+
+def _lane_pallas_call(kernel, K, dtype, n_tiles, Pp, operands, interpret):
+    """Shared pallas_call plumbing: Q batch-minor COLUMN-major
+    (Qt[j, i, b] = Q[b, i, j] - Mosaic wants leading-index slices), every
+    vector operand transposed to (K, Pp)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    Qt = jnp.transpose(operands[0], (2, 1, 0))       # (K, K, Pp)
+    vecs = [a.T for a in operands[1:]]
+    vec_spec = pl.BlockSpec((K, _TILE_B), lambda i: (0, i),
+                            memory_space=pltpu.VMEM)
+    out = pl.pallas_call(
+        functools.partial(kernel, K=K),
+        grid=(n_tiles,),
+        in_specs=[pl.BlockSpec((K, K, _TILE_B), lambda i: (0, 0, i),
+                               memory_space=pltpu.VMEM)]
+        + [vec_spec] * len(vecs),
+        out_specs=vec_spec,
+        out_shape=jax.ShapeDtypeStruct((K, Pp), dtype),
+        interpret=interpret,
+    )(Qt, *vecs)
+    return out
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _cho_solve_pallas_jit(Q, B, interpret):
+    P, K = B.shape
+    n_tiles, Pp, (Q, B) = _pad_batch(K, B.dtype, [Q, B])
+    out = _lane_pallas_call(_cho_solve_kernel, K, B.dtype, n_tiles, Pp,
+                            [Q, B], interpret)
+    return out[:, :P].T
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _chol_solve_sample_pallas_jit(Q, B, Zn, interpret):
+    P, K = B.shape
+    n_tiles, Pp, (Q, B, Zn) = _pad_batch(K, B.dtype, [Q, B, Zn])
+    out = _lane_pallas_call(_chol_solve_sample_kernel, K, B.dtype,
+                            n_tiles, Pp, [Q, B, Zn], interpret)
+    return out[:, :P].T

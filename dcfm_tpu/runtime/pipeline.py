@@ -101,6 +101,41 @@ _G_CK_GEN = _REG.gauge(
     "dcfm_fit_checkpoint_generation",
     "checkpoint saves completed by the current fit (the write-behind "
     "generation counter)")
+_G_RELAYOUTS = _REG.gauge(
+    "dcfm_fit_carry_relayouts",
+    "steady-state chunk boundaries where the carry came back with a "
+    "different placement (sharding/layout) than it went in - each one "
+    "is a per-chunk relayout copy of the biggest buffers on the device; "
+    "MUST read 0 once the chunk program is warm")
+
+
+def carry_placement_sig(carry) -> tuple:
+    """Per-leaf placement signature of a chunk carry: (dtype, shape,
+    sharding, layout) for every jax.Array leaf - metadata reads only,
+    never a device sync.
+
+    The chunk jit donates its carry (``donate_argnums``), so XLA can
+    alias the output buffers onto the input ones ONLY when the output
+    placement matches the input placement; a mismatch silently degrades
+    every boundary into a full copy of the accumulator panels.  The
+    chunk loop snapshots this signature before and after each chunk call
+    and counts steady-state mismatches into ``dcfm_fit_carry_relayouts``
+    (tests/test_precision.py pins the counter at 0 across chunks).
+    """
+    sig = []
+    for leaf in jax.tree.leaves(carry):
+        if not isinstance(leaf, jax.Array):
+            continue
+        try:
+            lay = repr(leaf.layout)        # jax >= 0.4.35
+        except Exception:  # dcfm: ignore[DCFM601] - optional metadata probe: older jax has no .layout; "?" compares equal to itself
+            lay = "?"
+        try:
+            shd = repr(leaf.sharding)
+        except Exception:  # dcfm: ignore[DCFM601] - optional metadata probe: deleted/donated leaves refuse introspection; "?" compares equal to itself
+            shd = "?"
+        sig.append((str(leaf.dtype), tuple(leaf.shape), shd, lay))
+    return tuple(sig)
 
 
 def chunk_schedule(num_iters: int, chunk: int) -> list:
@@ -334,6 +369,11 @@ class ChainRunResult:
     # decision was made from (None when early stop is off).
     stopped_at_iter: Optional[int] = None
     rhat_trajectory: Optional[list] = None
+    # Steady-state carry relayouts observed across the run's chunk
+    # boundaries (see carry_placement_sig): 0 on a healthy run - the
+    # donated carry round-trips the chunk jit with its placement
+    # pinned, so every boundary aliases instead of copying.
+    relayouts: int = 0
 
 
 def early_stop_metrics(traces: list, trace0: int, burnin: int):
@@ -525,15 +565,31 @@ def run_chain(*, cfg, model, run, sched, phase: dict, multiproc: bool,
     es_on = run.early_stop == "rhat"
     stopped_at = None
     rhat_traj = [] if es_on else None
+    # Relayout watchdog: compare the carry's placement signature across
+    # the donated chunk-jit boundary.  The FIRST boundary is warm-up
+    # (the init program's output layout may legitimately differ from
+    # the chunk program's steady-state layout, and the first call pays
+    # that relayout exactly once); after it, in-sig != out-sig means
+    # every subsequent boundary copies the carry instead of aliasing
+    # the donation - the per-chunk relayout tax this counter exists to
+    # keep at 0.
+    relayouts = 0
+    placement_warm = False
     try:
         while qi < len(queue_):
             ni = queue_[qi]
             qi += 1
             tc = time.perf_counter()
+            in_sig = carry_placement_sig(carry)
             carry, stats, trace = chunk_fns(ni, m_active)(
                 key_chain, Yd, carry, sched)
             trace_host = np.asarray(trace)  # dcfm: ignore[DCFM801] - per-chunk trace rows are KBs; an async drain would buy nothing
             chunk_secs.append(time.perf_counter() - tc)
+            out_sig = carry_placement_sig(carry)
+            if placement_warm and out_sig != in_sig:
+                relayouts += 1
+                record("carry_relayout", iteration=it_now + ni)
+            placement_warm = True
             it_now += ni
             traces.append((it_now - ni, trace_host))
             if es_on:
@@ -568,6 +624,7 @@ def run_chain(*, cfg, model, run, sched, phase: dict, multiproc: bool,
                    dur_s=chunk_secs[-1], final=last)
             _G_ITER.set(it_now)
             _G_CHUNK_S.set(chunk_secs[-1])
+            _G_RELAYOUTS.set(relayouts)
             if streamer is not None:
                 _G_STREAM_SKIPS.set(streamer.skipped)
             if sentinel is not None:
@@ -614,6 +671,9 @@ def run_chain(*, cfg, model, run, sched, phase: dict, multiproc: bool,
                     else None, bad)
                 if commit_fn is not None:
                     carry = commit_fn(carry)
+                # the reloaded carry legitimately pays one warm-up
+                # relayout, exactly like the initial resume commit
+                placement_warm = False
                 # drop the poisoned chunks' traces, re-lineage the chain
                 # key (the retry must not deterministically re-enter the
                 # same blow-up) and escalate the ridge jitter; the resumed
@@ -685,6 +745,7 @@ def run_chain(*, cfg, model, run, sched, phase: dict, multiproc: bool,
                     plan.maybe_kill(it_now, done, "post_save")
                     if plan.poison_due(it_now, done):
                         carry = _poison_carry(carry)
+                        placement_warm = False   # chaos-only rebuild
                 continue
             if writer.poll_error() is not None and not last:
                 # Durability broke mid-run (disk full, ...): fail at the
@@ -781,6 +842,7 @@ def run_chain(*, cfg, model, run, sched, phase: dict, multiproc: bool,
                     plan.maybe_kill(it_now, done, "post_save")
                 if plan.poison_due(it_now, done):
                     carry = _poison_carry(carry)
+                    placement_warm = False   # chaos-only rebuild
         if writer is not None:
             # the last save must be durable before fit() returns; a failure
             # here must not discard a finished chain's results.  The
@@ -810,4 +872,5 @@ def run_chain(*, cfg, model, run, sched, phase: dict, multiproc: bool,
         done=done, acc_start=acc_start, checkpoint_error=ck_error,
         rewinds=sentinel.rewinds if sentinel is not None else 0,
         trace0=trace0, streamer=streamer,
-        stopped_at_iter=stopped_at, rhat_trajectory=rhat_traj)
+        stopped_at_iter=stopped_at, rhat_trajectory=rhat_traj,
+        relayouts=relayouts)

@@ -1144,6 +1144,17 @@ def checkpoint_compatible(
         return (f"store_draws changed: {saved.run.store_draws} != "
                 f"{cfg.run.store_draws} (the carry gains/loses the "
                 "draw-buffer leaves)")
+    # Sweep precision is part of the chain's identity: the accumulators
+    # are raw sums over draws, so resuming an f32 donor under bf16 (or
+    # vice versa) would silently blend two numerically different chains
+    # into one posterior.  Old checkpoints carry no compute_dtype key
+    # and deserialize to the "f32" default above - exactly what they
+    # ran - so only a REAL mismatch refuses.
+    if saved.backend.compute_dtype != cfg.backend.compute_dtype:
+        return (f"compute_dtype changed: checkpoint ran "
+                f"{saved.backend.compute_dtype!r}, resume requests "
+                f"{cfg.backend.compute_dtype!r} (one accumulated "
+                "posterior must come from one sweep precision)")
     if meta["fingerprint"] != fingerprint:
         return "data fingerprint mismatch - resuming on different data"
     return None

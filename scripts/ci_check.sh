@@ -62,12 +62,17 @@ python -m dcfm_tpu.analysis --check-readme README.md || exit 1
 # two barrier-synchronized writer threads over one memmapped artifact
 # and the RSS-guard test forks a measurement subprocess - a deadlocked
 # barrier or runaway child must fail one file, not wedge the suite.
+# test_precision.py rides the lane: the mixed-precision/bf16 compute
+# path and the batched K x K pallas-interpret kernel compile programs
+# no other file traces - an XLA/pallas native-level abort there must
+# fail ONE file with its signal named, not take down the suite.
 echo "== serve + chaos tests incl. crash-fuzz smoke (crash-isolated lane) =="
 for f in tests/test_serve_artifact.py tests/test_serve_engine.py \
          tests/test_serve_server.py tests/test_serve_fleet.py \
          tests/test_resilience.py tests/test_online.py \
          tests/test_runtime_stream.py tests/test_obs.py \
-         tests/test_chains_mesh.py tests/test_sparse_ingest.py; do
+         tests/test_chains_mesh.py tests/test_sparse_ingest.py \
+         tests/test_precision.py; do
     JAX_PLATFORMS=cpu python -m dcfm_tpu.analysis.isolate "$f" \
         -- -q -m 'not slow' --continue-on-collection-errors \
         -p no:cacheprovider -p no:xdist -p no:randomly || exit 1

@@ -1,0 +1,28 @@
+"""Known-bad mixed-precision matmuls: DCFM1601 must fire (all spellings)."""
+import jax.numpy as jnp
+
+
+def dot_on_cast_name(a, b):
+    # DCFM1601: the name holds a bf16 cast; jnp.dot then both multiplies
+    # AND accumulates in bfloat16
+    al = a.astype(jnp.bfloat16)
+    return jnp.dot(al, b)
+
+
+def matmul_operator_on_cast(a, b):
+    # DCFM1601: the @ operator has no preferred_element_type spelling at
+    # all - a low-precision operand must go through jnp.matmul
+    bl = b.astype(jnp.bfloat16)
+    return a @ bl
+
+def einsum_inline_cast(x, w):
+    # DCFM1601: inline .astype directly as an einsum operand, no
+    # preferred_element_type keyword
+    return jnp.einsum("ij,jk->ik", x.astype(jnp.bfloat16), w)
+
+
+def matmul_string_dtype(a, b):
+    # DCFM1601: the string spelling of the cast taints exactly like the
+    # jnp.bfloat16 attribute
+    ah = jnp.asarray(a, dtype="float16")
+    return jnp.matmul(ah, b)

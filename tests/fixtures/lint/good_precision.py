@@ -1,0 +1,27 @@
+"""Known-good mixed-precision matmuls: low-precision inputs always pin
+the accumulator dtype with preferred_element_type."""
+import jax.numpy as jnp
+
+
+def mm(a, b):
+    # the sanctioned pattern (models/conditionals.py): bf16 INPUTS, f32
+    # ACCUMULATION, declared at the contraction itself
+    return jnp.matmul(a.astype(jnp.bfloat16), b.astype(jnp.bfloat16),
+                      preferred_element_type=jnp.float32)
+
+
+def einsum_pinned(x, w):
+    # einsum with the accumulator dtype pinned is exactly as safe
+    xl = x.astype(jnp.bfloat16)
+    return jnp.einsum("ij,jk->ik", xl, w,
+                      preferred_element_type=jnp.float32)
+
+
+def f32_matmul(a, b):
+    # no low-precision operand anywhere: plain f32 matmuls stay silent
+    return a @ b
+
+
+def f32_cast_dot(a, b):
+    # an UP-cast is not a low-precision taint
+    return jnp.dot(a.astype(jnp.float32), b)

@@ -313,6 +313,28 @@ def test_densequad_skips_scripts_and_tests():
                    for f in lint_source(src, "scripts/demo.py"))
 
 
+def test_bad_precision_fires_1601():
+    assert _rules_fired("bad_precision.py") == {"DCFM1601"}
+
+
+def test_bad_precision_flags_every_contraction_shape():
+    findings = lint_file(os.path.join(FIXTURES, "bad_precision.py"))
+    # jnp.dot on a cast name, @ on a cast name, einsum with an inline
+    # cast operand, jnp.matmul on a string-dtype cast
+    assert len([f for f in findings if f.rule == "DCFM1601"]) == 4
+
+
+def test_precision_skips_scripts_and_tests():
+    src = ("import jax.numpy as jnp\n"
+           "def f(a, b):\n"
+           "    return jnp.dot(a.astype(jnp.bfloat16), b)\n")
+    assert any(f.rule == "DCFM1601" for f in lint_source(src, "mod.py"))
+    assert not any(f.rule == "DCFM1601"
+                   for f in lint_source(src, "test_mod.py"))
+    assert not any(f.rule == "DCFM1601"
+                   for f in lint_source(src, "scripts/demo.py"))
+
+
 def test_bad_pragma_fires_002_for_dead_and_unknown():
     findings = lint_file(os.path.join(FIXTURES, "bad_pragma.py"))
     assert {f.rule for f in findings} == {"DCFM002"}
@@ -343,7 +365,7 @@ def test_every_rule_family_has_a_firing_fixture():
     "good_multihost.py", "good_runtime.py", "good_obs.py",
     "good_handler.py", "good_locks.py", "good_lifetime.py",
     "good_pragma.py", "good_poll.py", "good_chainaxis.py",
-    "good_densequad.py"])
+    "good_densequad.py", "good_precision.py"])
 def test_good_fixture_is_clean(name):
     findings = lint_file(os.path.join(FIXTURES, name))
     assert findings == [], [str(f) for f in findings]

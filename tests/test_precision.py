@@ -1,0 +1,232 @@
+"""Mixed-precision compute path (BackendConfig.compute_dtype).
+
+Pins the whole precision contract of the fused sweep:
+
+* the "f32" default is INERT - same results as an explicit "f32" request,
+  and the traced sweep graph contains no bfloat16 anywhere (the knob is
+  guarded at trace time, so the default compiles the pre-knob program);
+* "bf16" changes only the large matmuls' input dtype - the traced graph
+  carries bfloat16 casts, every K x K precision/Cholesky stays f32, and
+  the fit's accuracy lands inside the measured cross-chain MC spread of
+  f32 fits (the accuracy contract: reduced precision may move a fit
+  within chain-to-chain noise, never outside it);
+* the batched K x K factor-solve(-sample) kernel (ops/batched_solve) is
+  BITWISE-identical to its fallback where the kernel exists (K <= 16)
+  and numerically correct at every K;
+* the donated chunk carry round-trips the chunk jit with its placement
+  pinned: the relayout counter reads 0 across >= 3 chunk boundaries;
+* compute_dtype is part of a checkpoint's identity: bf16 checkpoints
+  round-trip and resume, a mismatched donor refuses with a typed error.
+"""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from tests.conftest import make_synthetic
+
+from dcfm_tpu import BackendConfig, FitConfig, ModelConfig, RunConfig, fit
+from dcfm_tpu.ops.batched_solve import (
+    cho_solve_batched,
+    chol_solve_sample_batched,
+)
+
+
+def _cfg(dtype=None, *, seed=0, chunk=0, chains=1, **kw):
+    backend = BackendConfig() if dtype is None else BackendConfig(
+        compute_dtype=dtype)
+    return FitConfig(
+        model=ModelConfig(num_shards=2, factors_per_shard=3, rho=0.8),
+        run=RunConfig(burnin=16, mcmc=16, thin=2, seed=seed,
+                      chunk_size=chunk, num_chains=chains),
+        backend=backend, **kw)
+
+
+@pytest.fixture(scope="module")
+def data():
+    Y, St = make_synthetic(n=40, p=24, k_true=3, seed=7)
+    return Y, St
+
+
+# ---------------------------------------------------------------------------
+# f32 default is inert
+# ---------------------------------------------------------------------------
+
+def test_f32_default_bitwise_identical(data):
+    """The knob's default must change NOTHING: a config that never
+    mentions compute_dtype and one that asks for "f32" explicitly are the
+    same program - Sigma, traces, and final state bitwise equal."""
+    Y, _ = data
+    res_default = fit(Y, _cfg(None))
+    res_f32 = fit(Y, _cfg("f32"))
+    np.testing.assert_array_equal(res_default.Sigma, res_f32.Sigma)
+    np.testing.assert_array_equal(res_default.traces, res_f32.traces)
+    np.testing.assert_array_equal(np.asarray(res_default.state.Lambda),
+                                  np.asarray(res_f32.state.Lambda))
+
+
+def _sweep_jaxpr(dtype):
+    import jax.numpy as jnp
+
+    from dcfm_tpu.models.conditionals import gibbs_sweep
+    from dcfm_tpu.models.priors import make_prior
+    from dcfm_tpu.models.state import init_state
+
+    cfg = ModelConfig(num_shards=2, factors_per_shard=3, rho=0.8,
+                      compute_dtype=dtype)
+    prior = make_prior(cfg)
+    key = jax.random.key(0)
+    state = init_state(key, prior, num_local_shards=2, n=8, P=6, K=3,
+                       as_=cfg.as_, bs=cfg.bs)
+    Y = jnp.zeros((2, 8, 6), jnp.float32)
+    return str(jax.make_jaxpr(
+        lambda k, y, s: gibbs_sweep(k, y, s, cfg, prior))(key, Y, state))
+
+
+def test_f32_graph_has_no_bf16_and_bf16_graph_does():
+    """Graph-level pin of "bitwise-identical to the pre-knob head": the
+    f32 sweep jaxpr contains no bfloat16 type anywhere (the trace-time
+    guard compiled the plain `a @ b` program), while the bf16 jaxpr casts
+    into bf16 AND still accumulates/factorizes in f32 (the K x K solve
+    operands stay f32 - bf16 appears only as matmul input casts)."""
+    jp_f32 = _sweep_jaxpr("f32")
+    jp_bf16 = _sweep_jaxpr("bf16")
+    assert "bf16" not in jp_f32
+    assert "bf16" in jp_bf16
+    # f32 accumulation is declared at the contractions themselves
+    assert "preferred_element_type=float32" in jp_bf16
+    # every K x K factorization stays f32 even in bf16 mode
+    chol_lines = [ln for ln in jp_bf16.splitlines() if "cholesky" in ln]
+    assert chol_lines and all("bf16" not in ln for ln in chol_lines)
+
+
+# ---------------------------------------------------------------------------
+# bf16 accuracy contract: inside the f32 cross-chain MC spread
+# ---------------------------------------------------------------------------
+
+def test_bf16_error_inside_f32_mc_band():
+    """Run the SAME fit under several f32 seeds to measure the chain-to-
+    chain MC spread of rel-Frobenius error, then demand the bf16 fit land
+    inside that band (widened by half its width for finite-sample slack).
+    This is the supported accuracy claim: reduced precision moves a fit
+    within MC noise, never outside it."""
+    Y, St = make_synthetic(n=120, p=48, k_true=3, seed=11)
+    norm = np.linalg.norm(St)
+
+    def run(dtype, seed):
+        cfg = FitConfig(
+            model=ModelConfig(num_shards=2, factors_per_shard=3, rho=0.8),
+            run=RunConfig(burnin=150, mcmc=150, thin=1, seed=seed),
+            backend=BackendConfig(compute_dtype=dtype))
+        return float(np.linalg.norm(fit(Y, cfg).Sigma - St) / norm)
+
+    f32_errs = np.array([run("f32", s) for s in range(4)])
+    bf16_err = run("bf16", 0)
+    width = max(f32_errs.max() - f32_errs.min(), 1e-3)
+    lo, hi = f32_errs.min() - 0.5 * width, f32_errs.max() + 0.5 * width
+    assert lo <= bf16_err <= hi, (
+        f"bf16 err {bf16_err:.4f} outside f32 MC band "
+        f"[{lo:.4f}, {hi:.4f}] (f32 samples {np.round(f32_errs, 4)})")
+
+
+# ---------------------------------------------------------------------------
+# batched K x K solve kernel: bitwise vs fallback, correct at every K
+# ---------------------------------------------------------------------------
+
+def _spd_problem(K, B, seed):
+    r = np.random.default_rng(seed)
+    A = r.standard_normal((B, K, K)).astype(np.float32)
+    Q = (A @ np.transpose(A, (0, 2, 1))
+         + K * np.eye(K, dtype=np.float32)[None])
+    rhs = r.standard_normal((B, K)).astype(np.float32)
+    Zn = r.standard_normal((B, K)).astype(np.float32)
+    return Q, rhs, Zn
+
+
+@pytest.mark.parametrize("K", [4, 16])
+def test_kernel_bitwise_vs_fallback(K):
+    """Where the pallas kernel exists (K <= 16) it must be BITWISE equal
+    to the fallback - the fallback executes the kernel's own lane-major
+    op graph, so they share every FMA-contraction decision."""
+    Q, rhs, Zn = _spd_problem(K, 37, seed=K)
+    np.testing.assert_array_equal(
+        np.asarray(cho_solve_batched(Q, rhs, impl="pallas-interpret")),
+        np.asarray(cho_solve_batched(Q, rhs, impl="unrolled")))
+    np.testing.assert_array_equal(
+        np.asarray(chol_solve_sample_batched(Q, rhs, Zn,
+                                             impl="pallas-interpret")),
+        np.asarray(chol_solve_sample_batched(Q, rhs, Zn, impl="unrolled")))
+
+
+@pytest.mark.parametrize("K", [4, 16, 64])
+def test_kernel_solves_correctly(K):
+    """Every dispatch (auto covers all K) solves Q x = b to f32 accuracy,
+    and the sample entry returns mean + L^-T z for the SAME Cholesky."""
+    Q, rhs, Zn = _spd_problem(K, 13, seed=100 + K)
+    x = np.asarray(cho_solve_batched(Q, rhs))
+    ref = np.stack([np.linalg.solve(Q[i], rhs[i]) for i in range(len(Q))])
+    np.testing.assert_allclose(x, ref, rtol=2e-4, atol=2e-5)
+    # sample entry: subtracting the mean leaves y with Cov[y] = Q^{-1};
+    # verify deterministically via y = L^{-T} z  =>  L^T y = z
+    y = np.asarray(chol_solve_sample_batched(Q, rhs, Zn)) - x
+    L = np.linalg.cholesky(Q)
+    np.testing.assert_allclose(
+        np.einsum("bkj,bk->bj", L, y), Zn, rtol=2e-3, atol=2e-4)
+
+
+def test_kernel_unknown_impl_raises():
+    Q, rhs, _ = _spd_problem(4, 3, seed=0)
+    with pytest.raises(ValueError, match="impl"):
+        cho_solve_batched(Q, rhs, impl="cuda")
+
+
+# ---------------------------------------------------------------------------
+# donated-carry placement stays pinned across chunk boundaries
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("dtype", ["f32", "bf16"])
+def test_relayout_counter_zero_across_chunks(data, dtype):
+    """4 chunks of 8 iterations: after warm-up, every chunk boundary must
+    hand the carry back with the placement it went in with (donation
+    aliases; no per-chunk relayout copy).  The obs gauge is the record
+    the bench and the fleet watch - it must read 0 here."""
+    from dcfm_tpu.obs import metrics as obs_metrics
+
+    Y, _ = data
+    fit(Y, _cfg(dtype, chunk=8))
+    g = obs_metrics.default_registry().gauge("dcfm_fit_carry_relayouts")
+    assert g.value() == 0.0
+
+
+# ---------------------------------------------------------------------------
+# checkpoints: bf16 round-trips; a mismatched donor refuses
+# ---------------------------------------------------------------------------
+
+def test_bf16_checkpoint_roundtrip(tmp_path, data):
+    """A bf16 fit checkpoints with compute_dtype in the meta, and a
+    bf16 resume of the finished run is a no-op returning the identical
+    posterior (the raw-sum accumulators restore exactly)."""
+    import json
+
+    Y, _ = data
+    ck = str(tmp_path / "ck.npz")
+    cfg = _cfg("bf16", chunk=8, checkpoint_path=ck)
+    res = fit(Y, cfg)
+    with np.load(ck) as z:
+        meta = json.loads(bytes(z["__meta__"]).decode())
+    assert meta["config"]["backend"]["compute_dtype"] == "bf16"
+    res2 = fit(Y, dataclasses.replace(cfg, resume=True))
+    np.testing.assert_array_equal(res.Sigma, res2.Sigma)
+
+
+def test_resume_refuses_mismatched_compute_dtype(tmp_path, data):
+    """One accumulated posterior must come from one sweep precision:
+    resuming an f32 donor under bf16 is a typed refusal, not a silent
+    blend of two numerically different chains."""
+    Y, _ = data
+    ck = str(tmp_path / "ck.npz")
+    fit(Y, _cfg("f32", chunk=8, checkpoint_path=ck))
+    with pytest.raises(ValueError, match="compute_dtype changed"):
+        fit(Y, _cfg("bf16", chunk=8, checkpoint_path=ck, resume=True))

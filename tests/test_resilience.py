@@ -527,6 +527,34 @@ def _save_iter(slot, iteration, keep_last=2):
     save_checkpoint(slot, c, _cfg(), fingerprint="f", keep_last=keep_last)
 
 
+def _rot_payload(path):
+    """Rot real PAYLOAD bytes of a checkpoint npz, in place.
+
+    A fixed file offset (the old ``size // 2``) is layout-sensitive:
+    np.savez 64-aligns members with local-header extra padding, so a
+    config-growth that resizes ``__meta__`` can silently move the
+    midpoint into structural bytes the zip reader never looks at - and
+    then nothing actually rotted (the arrays restore bit-identical).
+    Parse the archive and hit the middle of the largest leaf's DATA
+    instead: bytes that are CRC-recorded at save and restored at load.
+    Opens ``r+b`` so hardlinked retention copies share the damage, like
+    real in-place media rot.
+    """
+    import struct
+    import zipfile
+
+    with zipfile.ZipFile(path) as z:
+        zi = max((i for i in z.infolist()
+                  if i.filename.startswith("leaf_")),
+                 key=lambda i: i.compress_size)
+        off, csize = zi.header_offset, zi.compress_size
+    with open(path, "r+b") as f:
+        f.seek(off + 26)                  # local header: fnlen, extralen
+        fnlen, extralen = struct.unpack("<HH", f.read(4))
+        f.seek(off + 30 + fnlen + extralen + csize // 2)
+        f.write(b"\xff" * 8)
+
+
 def test_unanimous_pre_pass_promotes_common_generation(tmp_path):
     """A kill between two processes' saves leaves the newest generation
     on only one host.  The pod pre-pass must promote the newest
@@ -562,9 +590,7 @@ def test_unanimous_pre_pass_demotes_corrupt_then_promotes(tmp_path):
     for s in (s0, s1):
         _save_iter(s, 16)
         _save_iter(s, 24)
-    with open(s1, "r+b") as f:       # silent media corruption on host 1
-        f.seek(os.path.getsize(s1) // 2)
-        f.write(b"\xff" * 8)
+    _rot_payload(s1)                 # silent media corruption on host 1
     rep = SuperviseReport()
     it = _ensure_unanimous_checkpoint(base, 2, rep, lambda m: None)
     assert it == 16
@@ -673,9 +699,7 @@ def test_unanimity_pre_pass_demotes_stale_other_count_sets(tmp_path):
     for i in range(3):
         _save_iter(proc_path(base, i, 3), 24)
     stale = proc_path(base, 1, 3)
-    with open(stale, "r+b") as f:
-        f.seek(os.path.getsize(stale) // 2)
-        f.write(b"\xff" * 8)
+    _rot_payload(stale)
     rep = SuperviseReport()
     _ensure_unanimous_checkpoint(base, 2, rep, lambda m: None)
     assert rep.corrupt_fallbacks == 1
@@ -710,9 +734,7 @@ def test_promotion_keeps_retention_chain_gapless(tmp_path):
     # rotation's hardlinks, so BOTH copies of 16 die).  Pre-fix the
     # bak1 HOLE hid gen 8 behind it and the pod was orphaned to a
     # fresh start; with the gapless chain it falls back to 8.
-    with open(s0, "r+b") as f:
-        f.seek(os.path.getsize(s0) // 2)
-        f.write(b"\xff" * 8)
+    _rot_payload(s0)
     rep2 = SuperviseReport()
     it = _ensure_unanimous_checkpoint(base, 2, rep2, lambda m: None)
     assert it == 8                        # recovered, not orphaned
@@ -839,19 +861,14 @@ def test_demotion_hole_does_not_hide_older_generations(tmp_path):
         for it in (8, 16, 24):
             _save_iter(s, it, keep_last=3)   # live 24, bak1 16, bak2 8
 
-    def _rot(p):
-        with open(p, "r+b") as f:
-            f.seek(os.path.getsize(p) // 2)
-            f.write(b"\xff" * 8)
-
-    _rot(s0 + ".bak1")                       # middle generation rots
+    _rot_payload(s0 + ".bak1")               # middle generation rots
     rep = SuperviseReport()
     assert _ensure_unanimous_checkpoint(base, 2, rep, lambda m: None) == 24
     assert os.path.exists(s0 + ".bak1.corrupt")   # demoted: chain has a hole
     # second failure: host 0's live file rots as well (bak1@16 on host 0
     # is gone, so 16 is not unanimous; 8 must still be reachable PAST
     # the .bak1 hole)
-    _rot(s0)
+    _rot_payload(s0)
     rep2 = SuperviseReport()
     it = _ensure_unanimous_checkpoint(base, 2, rep2, lambda m: None)
     assert it == 8
