@@ -62,10 +62,20 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--write-baseline", action="store_true",
                    help="with --baseline: (re)write the file from the "
                         "current findings and exit 0")
+    p.add_argument("--trace", action="store_true",
+                   help="run the TRACE-level gate instead of the AST "
+                        "lint: abstractly trace every registered jit "
+                        "entry (analysis/tracecheck.py) and verify the "
+                        "DCFM18xx jaxpr invariants (collective-axis "
+                        "safety, dtype leaks, donation, retrace "
+                        "sentinel); same baseline/format/exit "
+                        "contract")
     p.add_argument("--changed", action="store_true",
                    help="lint only files that differ from git HEAD "
                         "(plus untracked files); the symbol table "
-                        "still covers the whole tree")
+                        "still covers the whole tree.  With --trace: "
+                        "skip entries whose defining module matches "
+                        "HEAD")
     p.add_argument("--cache-file", metavar="FILE",
                    help="per-file analysis cache keyed on content "
                         "hash (cold run populates it; warm runs skip "
@@ -129,20 +139,34 @@ def _check_readme(readme_path: str, rules) -> int:
 def _run(args) -> int:
     from dcfm_tpu.analysis import baseline as baseline_mod
     from dcfm_tpu.analysis import engine
-    from dcfm_tpu.analysis.rules import RULES
+    from dcfm_tpu.analysis.rules import ALL_RULES
 
     if args.list_rules:
-        _print_rules(RULES)
+        _print_rules(ALL_RULES)
         return 0
     if args.rules_md:
-        print(rules_markdown(RULES))
+        print(rules_markdown(ALL_RULES))
         return 0
     if args.check_readme:
-        return _check_readme(args.check_readme, RULES)
+        return _check_readme(args.check_readme, ALL_RULES)
     if args.write_baseline and not args.baseline:
         print("dcfm-lint: --write-baseline requires --baseline FILE",
               file=sys.stderr)
         return 2
+
+    root = os.getcwd()
+    if args.trace:
+        # Trace-level gate: the registered jit entries, not file paths.
+        from dcfm_tpu.analysis import tracecheck
+        try:
+            findings = tracecheck.check_project(
+                cache_path=args.cache_file, changed_only=args.changed,
+                root=root)
+        except RuntimeError as e:
+            print(f"dcfm-lint: {e}", file=sys.stderr)
+            return 2
+        return _report(args, findings, baseline_mod, engine, ALL_RULES,
+                       root, trace_mode=True)
 
     paths = args.paths or [os.path.dirname(os.path.dirname(
         os.path.abspath(__file__)))]
@@ -151,7 +175,6 @@ def _run(args) -> int:
             print(f"dcfm-lint: no such path: {p}", file=sys.stderr)
             return 2
 
-    root = os.getcwd()
     try:
         findings = engine.lint_project(
             paths, exclude=args.exclude, cache_path=args.cache_file,
@@ -159,9 +182,33 @@ def _run(args) -> int:
     except RuntimeError as e:
         print(f"dcfm-lint: {e}", file=sys.stderr)
         return 2
+    return _report(args, findings, baseline_mod, engine, ALL_RULES, root)
+
+
+def _report(args, findings, baseline_mod, engine, rules, root,
+            trace_mode=False) -> int:
+    """Shared tail of the AST and trace gates: baseline application,
+    severity threshold, and the text/json/sarif reporters - one exit
+    contract for both modes.
+
+    The two gates share ONE baseline file, partitioned by rule family:
+    each mode applies (and, under --write-baseline, rewrites) only its
+    own family's entries, so a trace run never reports the AST debt as
+    stale - or wipes it on refresh - and vice versa."""
+    from dcfm_tpu.analysis.rules import TRACE_RULES
+
+    def ours(entry) -> bool:
+        return (entry.get("rule") in TRACE_RULES) == trace_mode
 
     if args.baseline and args.write_baseline:
         data = baseline_mod.build_baseline(findings, root)
+        prior = baseline_mod.load_baseline(args.baseline)
+        if prior is not None:
+            foreign = [e for e in prior.get("entries", ())
+                       if not ours(e)]
+            data["entries"] = sorted(
+                foreign + data["entries"],
+                key=lambda e: (e["path"], e["rule"], e["fingerprint"]))
         baseline_mod.save_baseline(args.baseline, data)
         print(f"dcfm-lint: wrote {len(data['entries'])} baseline "
               f"entr{'y' if len(data['entries']) == 1 else 'ies'} to "
@@ -175,11 +222,13 @@ def _run(args) -> int:
             print(f"dcfm-lint: unreadable baseline {args.baseline} "
                   "(create it with --write-baseline)", file=sys.stderr)
             return 2
+        scoped = dict(data, entries=[
+            e for e in data.get("entries", ()) if ours(e)])
         findings, suppressed, stale = baseline_mod.apply_baseline(
-            findings, data, root)
+            findings, scoped, root)
 
     def severity(f):
-        return RULES[f.rule].severity if f.rule in RULES else "error"
+        return rules[f.rule].severity if f.rule in rules else "error"
 
     failing = [f for f in findings
                if args.fail_on == "warning" or severity(f) == "error"]

@@ -34,7 +34,7 @@ from typing import Iterable, Optional
 
 from dcfm_tpu.analysis import lifetime, locks
 from dcfm_tpu.analysis.linter import Finding, _Module, lint_source
-from dcfm_tpu.analysis.rules import RULES
+from dcfm_tpu.analysis.rules import ALL_RULES, RULES
 
 # bumped whenever analysis semantics change so stale caches self-expire;
 # the rules-registry digest is folded in as well
@@ -263,8 +263,8 @@ _SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
 
 def to_sarif(findings: Iterable, root: Optional[str] = None) -> dict:
     """SARIF 2.1.0 log for code-scanning uploads: one run, the full
-    rule registry as the driver's rule metadata, severity mapped to
-    SARIF level (error/warning)."""
+    rule registry (AST + trace rules) as the driver's rule metadata,
+    severity mapped to SARIF level (error/warning)."""
     root = os.path.abspath(root or os.getcwd())
     rules = [{
         "id": r.id,
@@ -272,7 +272,7 @@ def to_sarif(findings: Iterable, root: Optional[str] = None) -> dict:
         "shortDescription": {"text": f"{r.family}: {r.name}"},
         "fullDescription": {"text": r.summary},
         "defaultConfiguration": {"level": r.severity},
-    } for r in RULES.values()]
+    } for r in ALL_RULES.values()]
     results = []
     for f in findings:
         try:
@@ -280,7 +280,8 @@ def to_sarif(findings: Iterable, root: Optional[str] = None) -> dict:
                                   root).replace("\\", "/")
         except ValueError:
             uri = f.path.replace("\\", "/")
-        level = (RULES[f.rule].severity if f.rule in RULES else "error")
+        level = (ALL_RULES[f.rule].severity
+                 if f.rule in ALL_RULES else "error")
         results.append({
             "ruleId": f.rule,
             "level": level,

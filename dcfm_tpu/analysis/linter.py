@@ -32,7 +32,7 @@ import re
 import tokenize
 from typing import Iterable, Optional
 
-from dcfm_tpu.analysis.rules import RULES
+from dcfm_tpu.analysis.rules import ALL_RULES, RULES
 
 _IGNORE_RE = re.compile(r"#\s*dcfm:\s*ignore\[([A-Z0-9, ]+)\]")
 
@@ -76,7 +76,8 @@ class Finding:
     message: str
 
     def __str__(self) -> str:
-        name = RULES[self.rule].name if self.rule in RULES else "error"
+        name = (ALL_RULES[self.rule].name
+                if self.rule in ALL_RULES else "error")
         return (f"{self.path}:{self.line}:{self.col}: {self.rule} "
                 f"[{name}] {self.message}")
 
@@ -1515,6 +1516,45 @@ def _check_precision_matmul(mod: _Module, rep: _Reporter) -> None:
 
 
 # =====================================================================
+# DCFM17xx - partition-rule conformance
+# =====================================================================
+
+_SPEC_CTORS = {"jax.sharding.PartitionSpec", "jax.sharding.NamedSharding",
+               "jax.P", "jax.NamedSharding"}
+
+
+def _check_partition_specs(mod: _Module, rep: _Reporter) -> None:
+    """DCFM1701: PartitionSpec/NamedSharding constructed outside
+    parallel/mesh.py's rule table.  ROADMAP item 5: partitioning
+    decisions collapse onto the ONE name-keyed table
+    (match_partition_rules plus the shard_sharding /
+    replicated_sharding / named_shardings helpers), so a placement
+    change edits one file and the trace gate can audit every spec.
+    parallel/mesh.py itself - the table's home - is exempt."""
+    parts = str(mod.path).replace("\\", "/").split("/")
+    if parts[-1] == "mesh.py" and len(parts) >= 2 \
+            and parts[-2] == "parallel":
+        return
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        full = mod.resolve(node.func)
+        if full not in _SPEC_CTORS:
+            continue
+        ctor = full.rsplit(".", 1)[-1]
+        rep.emit(
+            "DCFM1701", node,
+            f"{ctor}(...) constructed outside parallel/mesh.py's rule "
+            "table - partitioning decisions live in ONE place "
+            "(match_partition_rules / carry_partition_rules and the "
+            "shard_sharding / replicated_sharding / named_shardings "
+            "helpers) so a placement change edits one file and the "
+            "trace gate audits every spec.  Route through a mesh.py "
+            "helper, or annotate a sanctioned one-off with "
+            "`# dcfm: ignore[DCFM1701] - <why>`")
+
+
+# =====================================================================
 # DCFM002 - stale suppressions
 # =====================================================================
 
@@ -1580,6 +1620,7 @@ def lint_source(source: str, path: str = "<string>",
     _check_chain_reductions(mod, rep)
     _check_dense_quadratic(mod, rep)
     _check_precision_matmul(mod, rep)
+    _check_partition_specs(mod, rep)
     _check_stale_pragmas(mod, rep)      # must stay last: reads the ledger
     rep.findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return rep.findings

@@ -257,4 +257,80 @@ RULES = {r.id: r for r in [
          "low-precision matmul, as models/conditionals.py's `mm` helper "
          "and the combine-step einsum do",
          library_only=True),
+    # ---- DCFM17xx: partition-rule conformance ------------------------
+    Rule("DCFM1701", "inline-partition-spec", "partition",
+         "PartitionSpec(...) or NamedSharding(...) constructed outside "
+         "parallel/mesh.py - partitioning decisions must collapse onto "
+         "the one rule table (match_partition_rules and the "
+         "shard_sharding/replicated_sharding/named_shardings helpers, "
+         "ROADMAP item 5) so a placement change edits ONE file and the "
+         "trace gate can audit every spec.  Sanctioned one-off "
+         "constructions carry an inline "
+         "`# dcfm: ignore[DCFM1701] - <why>`",
+         library_only=True),
 ]}
+
+
+# Trace-level rules (analysis/tracecheck.py): verified on the JAXPRS of
+# registered jit entry points, not on source text, so they live in
+# their own registry - the AST fixture tests assert that every RULES
+# entry has a source-level firing fixture, which trace rules cannot
+# have.  The CLI merges both registries for --list-rules/--rules-md/
+# SARIF metadata, and baseline fingerprinting treats the two identically
+# (trace findings anchor at the entry's registration line).
+TRACE_RULES = {r.id: r for r in [
+    Rule("DCFM1800", "trace-entry-error", "trace",
+         "a registered trace entry failed to build or trace - the "
+         "analyzer cannot verify its invariants at all, which is itself "
+         "a gate failure (an entry that stops tracing abstractly has "
+         "usually grown a concrete-value dependence, the retrace "
+         "hazard's precursor)"),
+    Rule("DCFM1801", "collective-unknown-axis", "trace",
+         "a collective (psum/all_gather/ppermute/axis_index/...) in the "
+         "traced graph names a mesh axis that does not exist in the "
+         "entry's declared mesh or any enclosing shard_map - the "
+         "program cannot run on the mesh it is registered for"),
+    Rule("DCFM1802", "collective-spans-chains", "trace",
+         "a data-moving collective (psum/all_gather/pmax/...) inside a "
+         "sweep-body entry reduces over the 'chains' mesh axis - the "
+         "PR-12 bitwise chain-independence contract: chains never "
+         "communicate during the sweep, so packed-mesh results stay "
+         "chain-for-chain identical to vmap runs.  axis_index over "
+         "chains (key derivation) is exempt: it reads coordinates, "
+         "it moves no data"),
+    Rule("DCFM1803", "dtype-leak", "trace",
+         "a bfloat16 or float64 value appears in the traced graph of an "
+         "entry registered under the f32-default configuration - the "
+         "compute_dtype knob's default must compile the pre-knob "
+         "program exactly (tests/test_precision.py pins one entry; the "
+         "trace gate pins them all)"),
+    Rule("DCFM1804", "lowprec-accum-unpinned", "trace",
+         "a dot_general over bfloat16/float16 operands in a bf16-mode "
+         "entry does not carry preferred_element_type=float32 - the "
+         "contraction accumulates in the low input precision, silently "
+         "voiding the mixed-precision accuracy contract (the trace-"
+         "level twin of DCFM1601, which only sees source text)"),
+    Rule("DCFM1805", "host-callback-in-jit", "trace",
+         "a host callback primitive (pure_callback/io_callback/"
+         "debug_callback) appears inside a registered jit entry - each "
+         "call synchronizes device->host inside the hot loop, "
+         "serializing the chain behind the link exactly like the "
+         "DCFM801 source-level class"),
+    Rule("DCFM1806", "undonated-carry", "trace",
+         "a carry buffer of a chunk-style entry is not donated into its "
+         "jit - XLA then holds old + new carry across every chunk call "
+         "and cannot alias the update in place, the relayout/double-"
+         "buffer class PR 15 instrumented at runtime "
+         "(dcfm_fit_carry_relayouts); caught here before anything runs"),
+    Rule("DCFM1807", "unstable-trace-key", "trace",
+         "an entry's static cache key embeds unhashable or identity-"
+         "hashed mutable Python state (a list/dict/set/ndarray, or an "
+         "object hashing by id) - every call then misses or falsely "
+         "hits jit's trace cache, the silent-retrace hazard ROADMAP "
+         "item 4's adaptive-K bucketing must avoid; key on frozen "
+         "config dataclasses, shapes, and mesh signatures only"),
+]}
+
+
+# Merged view for CLI listing, README generation and SARIF metadata.
+ALL_RULES = {**RULES, **TRACE_RULES}

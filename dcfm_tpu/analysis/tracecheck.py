@@ -1,0 +1,380 @@
+"""Trace-level analyzer: jaxpr invariants over registered jit entries.
+
+The AST linter (analysis/linter.py) sees source text; every invariant
+the mesh/precision work depends on lives BELOW it, in the traced
+program.  This module abstractly traces each registered entry
+(analysis/registry.py) with ShapeDtypeStruct inputs at a representative
+mesh - trace only, never compile, never execute - and walks the
+resulting jaxpr for the DCFM18xx rule family:
+
+* **collective-axis safety** (DCFM1801/1802): every collective names an
+  axis of the declared mesh, and no data-moving collective in a sweep
+  body spans ``chains`` - the PR-12 bitwise chain-independence
+  contract, previously enforced only by parity tests.
+* **dtype leaks** (DCFM1803/1804): the f32-default graph contains no
+  bfloat16/float64 anywhere, and every low-precision dot_general in
+  bf16 mode pins ``preferred_element_type=float32`` - generalizing the
+  one-off jaxpr assertion in tests/test_precision.py to every entry.
+* **transfer/donation audit** (DCFM1805/1806): no host callbacks inside
+  jit entries; chunk-style entries donate their carry (the relayout /
+  double-buffer class PR 15 instrumented at runtime, caught before
+  anything runs).
+* **retrace sentinel** (DCFM1807): each entry's static cache key is
+  recorded in a :class:`~dcfm_tpu.analysis.registry.TraceKeyRegistry`
+  and flagged if it embeds unhashed mutable Python state - the silent
+  per-call-retrace hazard ROADMAP item 4 must avoid.
+
+Findings are ordinary :class:`~dcfm_tpu.analysis.linter.Finding` rows
+anchored at each entry's *registration line*, so the severity tiers,
+SARIF serialization and LINT_BASELINE.json fingerprinting all apply
+unchanged.  ``python -m dcfm_tpu.analysis --trace`` is the CLI; the
+per-entry results are cached on the defining module's content hash, and
+``--changed`` skips entries whose defining module matches git HEAD.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Iterable, Optional
+
+from dcfm_tpu.analysis.linter import Finding
+from dcfm_tpu.analysis.registry import (
+    SkipEntry, TraceEntry, TraceKeyRegistry, discover)
+from dcfm_tpu.analysis.rules import TRACE_RULES
+
+# Enough virtual devices for the representative meshes (2-D chains x
+# shards needs 4+); must be decided before the first jax backend use.
+_MIN_DEVICES = 8
+
+# Data-moving collectives: the chains-independence contract (DCFM1802)
+# applies to these.  psum2/pbroadcast are shard_map-internal spellings.
+_COMM_PRIMS = {
+    "psum", "psum2", "pmax", "pmin", "pmean", "all_gather",
+    "all_to_all", "ppermute", "pgather", "reduce_scatter",
+    "psum_scatter",
+}
+# pbroadcast moves no data (replication bookkeeping) but still names an
+# axis; axis_index reads coordinates.  Both join the axis-exists check.
+_AXIS_PRIMS = _COMM_PRIMS | {"axis_index", "pbroadcast"}
+
+_CALLBACK_PRIMS = {"pure_callback", "io_callback", "debug_callback",
+                   "outside_call", "host_callback_call"}
+
+_LEAK_DTYPES = ("bfloat16", "float64")
+_LOWP_DTYPES = ("bfloat16", "float16")
+
+
+def _ensure_virtual_devices() -> None:
+    """Give the process enough virtual CPU devices for the
+    representative meshes.  Only effective before jax initializes its
+    backend (the CLI path); an already-initialized process (tests under
+    conftest's 8-device setup) is left alone."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count="
+            f"{_MIN_DEVICES}").strip()
+
+
+# -- jaxpr walking ----------------------------------------------------
+
+def _sub_jaxprs(params: dict):
+    """Every ClosedJaxpr/Jaxpr reachable from an eqn's params (scan's
+    ``jaxpr``, cond's ``branches`` tuple, pjit/shard_map bodies, ...)."""
+    from jax.core import ClosedJaxpr, Jaxpr
+
+    for v in params.values():
+        vs = v if isinstance(v, (list, tuple)) else (v,)
+        for x in vs:
+            if isinstance(x, ClosedJaxpr):
+                yield x.jaxpr
+            elif isinstance(x, Jaxpr):
+                yield x
+
+
+def iter_eqns(jaxpr, axis_env: frozenset):
+    """Yield ``(eqn, axis_env)`` over the whole nested jaxpr; the axis
+    environment grows by a shard_map eqn's mesh axes inside its body."""
+    for eqn in jaxpr.eqns:
+        yield eqn, axis_env
+        env = axis_env
+        if eqn.primitive.name == "shard_map":
+            mesh = eqn.params.get("mesh")
+            names = getattr(mesh, "axis_names", ()) or ()
+            env = axis_env | frozenset(names)
+        for sub in _sub_jaxprs(eqn.params):
+            yield from iter_eqns(sub, env)
+
+
+def _eqn_axes(eqn) -> tuple:
+    """The mesh axis names a collective eqn references, as a tuple."""
+    axes = eqn.params.get("axes")
+    if axes is None:
+        axes = eqn.params.get("axis_name")
+    if axes is None:
+        return ()
+    if isinstance(axes, (tuple, list, frozenset, set)):
+        return tuple(a for a in axes if isinstance(a, str))
+    return (axes,) if isinstance(axes, str) else ()
+
+
+def _eqn_dtypes(eqn):
+    """Dtype names of every in/out aval of an eqn (Literals included)."""
+    for v in list(eqn.invars) + list(eqn.outvars):
+        aval = getattr(v, "aval", None)
+        dt = getattr(aval, "dtype", None)
+        if dt is not None:
+            yield str(dt)
+
+
+# -- per-entry verification -------------------------------------------
+
+def _trace_entry(spec):
+    """Abstractly trace a TraceSpec; returns (closed_jaxpr, args_info).
+    ``args_info`` is the positional-args pytree of ArgInfo(aval,
+    donated) leaves, or None when the jax version doesn't expose it."""
+    import jax
+
+    fn = spec.fn
+    if not hasattr(fn, "trace"):
+        fn = jax.jit(fn, donate_argnums=spec.donate_argnums)
+    traced = fn.trace(*spec.args)
+    info = getattr(traced, "args_info", None)
+    # args_info is ((arg0, arg1, ...), kwargs_dict) on this jax
+    if (isinstance(info, tuple) and len(info) == 2
+            and isinstance(info[1], dict)):
+        info = info[0]
+    return traced.jaxpr, info
+
+
+def check_entry(entry: TraceEntry,
+                key_registry: Optional[TraceKeyRegistry] = None) -> list:
+    """All findings for one registered entry (empty when it verifies);
+    a builder raising SkipEntry yields no findings."""
+    import jax
+
+    def finding(rule: str, message: str) -> Finding:
+        return Finding(entry.path, entry.line, 0, rule,
+                       f"[{entry.name}] {message}")
+
+    try:
+        spec = entry.build()
+    except SkipEntry:
+        return []
+    except Exception as e:
+        return [finding(
+            "DCFM1800",
+            f"entry builder failed: {type(e).__name__}: {e}")]
+    try:
+        closed, args_info = _trace_entry(spec)
+    except Exception as e:
+        return [finding(
+            "DCFM1800",
+            f"abstract trace failed: {type(e).__name__}: {e} - the "
+            "entry has likely grown a concrete-value dependence")]
+
+    findings = []
+    from dcfm_tpu.parallel.mesh import CHAIN_AXIS
+
+    declared = frozenset(getattr(spec.mesh, "axis_names", ()) or ())
+
+    bf16_mode = spec.compute_dtype == "bf16"
+    leaked: dict = {}                       # dtype -> (count, first prim)
+    for eqn, env in iter_eqns(closed.jaxpr, declared):
+        prim = eqn.primitive.name
+        # (a) collective-axis safety
+        if prim in _AXIS_PRIMS:
+            for ax in _eqn_axes(eqn):
+                if ax not in env:
+                    findings.append(finding(
+                        "DCFM1801",
+                        f"{prim} names mesh axis {ax!r}, which does not "
+                        f"exist in the entry's declared mesh axes "
+                        f"{sorted(env) or '(none)'}"))
+                elif (entry.sweep_body and ax == CHAIN_AXIS
+                        and prim in _COMM_PRIMS):
+                    findings.append(finding(
+                        "DCFM1802",
+                        f"{prim} reduces over the {CHAIN_AXIS!r} mesh "
+                        "axis inside a sweep body - chains must stay "
+                        "bitwise independent during the sweep (PR-12 "
+                        "contract); reduce over the shard axis only, "
+                        "or move the cross-chain reduction to the "
+                        "chunk-boundary host side"))
+        # (b) dtype leaks
+        if not bf16_mode:
+            for dt in _eqn_dtypes(eqn):
+                if dt in _LEAK_DTYPES:
+                    n, p0 = leaked.get(dt, (0, prim))
+                    leaked[dt] = (n + 1, p0)
+        elif prim == "dot_general":
+            in_dts = [str(getattr(v.aval, "dtype", ""))
+                      for v in eqn.invars]
+            if any(dt in _LOWP_DTYPES for dt in in_dts):
+                import numpy as np
+                pet = eqn.params.get("preferred_element_type")
+                if pet is None or str(np.dtype(pet)) != "float32":
+                    findings.append(finding(
+                        "DCFM1804",
+                        f"dot_general over {'/'.join(sorted(set(in_dts)))}"
+                        f" operands accumulates in "
+                        f"{pet or 'the input precision'} - pin "
+                        "preferred_element_type=jnp.float32 (the "
+                        "models/conditionals.py `mm` pattern)"))
+        # (c) host callbacks
+        if prim in _CALLBACK_PRIMS:
+            findings.append(finding(
+                "DCFM1805",
+                f"host callback primitive {prim} inside the jit entry - "
+                "each call synchronizes device->host in the hot loop"))
+    for dt, (n, p0) in sorted(leaked.items()):
+        findings.append(finding(
+            "DCFM1803",
+            f"{n} {dt} value(s) in the f32-default graph (first at "
+            f"primitive {p0}) - the compute_dtype default must compile "
+            "the pre-knob f32 program exactly"))
+
+    # (c') donation audit
+    if entry.donate_argnum is not None and args_info is not None:
+        try:
+            leaves = jax.tree_util.tree_leaves(
+                args_info[entry.donate_argnum])
+        except (IndexError, TypeError):
+            leaves = []
+        undonated = sum(1 for l in leaves
+                        if not getattr(l, "donated", False))
+        if undonated:
+            findings.append(finding(
+                "DCFM1806",
+                f"{undonated} of {len(leaves)} carry buffer(s) "
+                f"(argument {entry.donate_argnum}) are NOT donated "
+                "into the chunk jit - XLA holds old + new carry "
+                "across every chunk call; add donate_argnums="
+                f"({entry.donate_argnum},)"))
+
+    # (d) retrace sentinel
+    if key_registry is None:
+        key_registry = TraceKeyRegistry()
+    shapes_sig = tuple(
+        (tuple(getattr(l, "shape", ())), str(getattr(l, "dtype", "")))
+        for l in jax.tree_util.tree_leaves(spec.args))
+    mesh_sig = tuple(sorted(spec.mesh.shape.items())) if spec.mesh else ()
+    full_key = tuple(spec.static_key) + (shapes_sig, mesh_sig)
+    for idx, reason in key_registry.record(entry.name, full_key):
+        findings.append(finding(
+            "DCFM1807",
+            f"static cache key component #{idx} "
+            f"({type(full_key[idx]).__name__}) is "
+            f"retrace-unstable: {reason}"))
+
+    return findings
+
+
+def check_entries(entry_list: Iterable[TraceEntry]) -> list:
+    """Findings over a list of entries, sorted like the AST engine's."""
+    key_registry = TraceKeyRegistry()
+    findings = []
+    for entry in entry_list:
+        findings.extend(check_entry(entry, key_registry))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+# -- project gate: discovery + content-hash cache + --changed ---------
+
+def _trace_rules_digest() -> str:
+    blob = json.dumps(sorted(
+        (r.id, r.name, r.family, r.summary, r.severity)
+        for r in TRACE_RULES.values()))
+    return hashlib.sha256(blob.encode("utf-8")).hexdigest()[:16]
+
+
+def _version_stamp() -> str:
+    import jax
+
+    from dcfm_tpu.analysis.engine import ENGINE_VERSION
+    return f"trace:{ENGINE_VERSION}:{_trace_rules_digest()}:{jax.__version__}"
+
+
+def _load_cache(cache_path: Optional[str]) -> dict:
+    if not cache_path:
+        return {}
+    try:
+        with open(cache_path, "r", encoding="utf-8") as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return {}
+    if not isinstance(data, dict) \
+            or data.get("version") != _version_stamp():
+        return {}
+    ent = data.get("entries")
+    return ent if isinstance(ent, dict) else {}
+
+
+def _save_cache(cache_path: Optional[str], entries: dict) -> None:
+    if not cache_path:
+        return
+    import tempfile
+    d = os.path.dirname(os.path.abspath(cache_path)) or "."
+    try:
+        fd, tmp = tempfile.mkstemp(dir=d, prefix=".tracecache-",
+                                   suffix=".tmp")
+        with os.fdopen(fd, "w", encoding="utf-8") as f:
+            json.dump({"version": _version_stamp(), "entries": entries}, f)
+        os.replace(tmp, cache_path)
+    except OSError:
+        pass                          # cache is an optimization, never fatal
+
+
+def _module_sha(path: str) -> Optional[str]:
+    try:
+        with open(path, "rb") as f:
+            return hashlib.sha256(f.read()).hexdigest()
+    except OSError:
+        return None
+
+
+def check_project(*, cache_path: Optional[str] = None,
+                  changed_only: bool = False,
+                  root: Optional[str] = None) -> list:
+    """The whole-registry trace gate: discover the library's entries,
+    verify each (content-hash cached per defining module), and return
+    Finding rows.  With ``changed_only``, entries whose defining module
+    matches git HEAD are skipped entirely - the AST engine's --changed
+    contract applied per entry."""
+    _ensure_virtual_devices()
+    root = os.path.abspath(root or os.getcwd())
+
+    entry_list = discover()
+
+    if changed_only:
+        from dcfm_tpu.analysis.engine import _changed_files
+        changed = _changed_files(root)
+        if changed is None:
+            raise RuntimeError(
+                "--changed needs a usable git checkout at "
+                f"{root} (git diff/ls-files failed)")
+        entry_list = [e for e in entry_list if e.path in changed]
+
+    cache = _load_cache(cache_path)
+    new_cache: dict = {}
+    key_registry = TraceKeyRegistry()
+    findings = []
+    for entry in entry_list:
+        sha = _module_sha(entry.path)
+        hit = cache.get(entry.name)
+        if sha is not None and hit and hit.get("sha") == sha \
+                and "findings" in hit:
+            rows = [Finding(*row) for row in hit["findings"]]
+        else:
+            rows = check_entry(entry, key_registry)
+        new_cache[entry.name] = {
+            "sha": sha,
+            "findings": [[f.path, f.line, f.col, f.rule, f.message]
+                         for f in rows]}
+        findings.extend(rows)
+    _save_cache(cache_path, new_cache)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings

@@ -389,12 +389,24 @@ def _pin_carry_layouts(chunk_callable):
     (resume paths can present a different committed placement than a
     fresh init); anything that defeats the metadata read falls back to
     the plain donating jit unchanged.
+
+    The per-leaf layout pin is DERIVED through the same name-keyed rule
+    table seam as every partition spec
+    (parallel.mesh.match_partition_rules over
+    parallel.mesh.committed_layout_rules - ROADMAP item 5: no
+    hand-assembled per-leaf placement outside the rule tables); scalars
+    go through the rules too, since every leaf needs its layout answer.
     """
+    from dcfm_tpu.parallel.mesh import (
+        committed_layout_rules, match_partition_rules)
+
     cache = {}
+    layout_rules = committed_layout_rules()
 
     def call(key, Y, carry, sched):
         try:
-            lcar = jax.tree.map(lambda a: a.layout, carry)
+            lcar = match_partition_rules(layout_rules, carry,
+                                         scalar_spec=None)
             sig = tuple(repr(l) for l in jax.tree.leaves(lcar))
         except Exception:  # dcfm: ignore[DCFM601] - optional layout probe: non-array leaves / older jax fall back to the unpinned donating jit
             lcar, sig = None, None
@@ -811,13 +823,9 @@ def _fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
                 # loader's buffers and compute on freed heap once they
                 # are dropped; the jitted jnp.copy allocates fresh
                 # device-owned buffers).
-                from jax.sharding import NamedSharding, PartitionSpec
+                from dcfm_tpu.parallel.mesh import named_shardings
                 specs = _mesh_fns(mesh, m, chunk, C, S_draws, unroll)[2]
-                spec_leaves = jax.tree.leaves(
-                    specs, is_leaf=lambda x: isinstance(x, PartitionSpec))
-                _, treedef = jax.tree.flatten(c)
-                shardings = jax.tree.unflatten(
-                    treedef, [NamedSharding(mesh, s) for s in spec_leaves])
+                shardings = named_shardings(mesh, specs, c)
                 return jax.jit(lambda t: jax.tree.map(jnp.copy, t),
                                out_shardings=shardings)(c)
 

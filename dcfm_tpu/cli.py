@@ -55,8 +55,9 @@ def build_parser() -> argparse.ArgumentParser:
     # exist so `dcfm-tpu --help` lists the subcommands.
     sub.add_parser(
         "lint", add_help=False,
-        help="JAX/FFI-aware static analysis (dcfm-lint); see "
-             "`dcfm-tpu lint --list-rules`")
+        help="JAX/FFI-aware static analysis (dcfm-lint): AST rules, "
+             "plus `--trace` for jaxpr-level invariants over the "
+             "registered jit entries; see `dcfm-tpu lint --list-rules`")
     sub.add_parser(
         "test-isolated", add_help=False,
         help="run pytest one subprocess per test file, so a native "

@@ -527,3 +527,42 @@ def covariance_blocks(
     blocks = blocks + (onehot[:, :, None, None]
                        * (1.0 / ps_local)[:, None, :, None] * eye_P)
     return blocks
+
+
+# =====================================================================
+# Trace-gate registration (analysis/tracecheck.py): the fused sweep is
+# abstractly traced in BOTH precision modes on every CI run, so the
+# collective-axis / dtype-leak / callback invariants hold for the whole
+# graph, not just the one jaxpr tests/test_precision.py pins.
+# =====================================================================
+
+from dcfm_tpu.analysis.registry import TraceSpec, register_trace_entry
+
+
+def _sweep_trace_spec(compute_dtype: str) -> TraceSpec:
+    from dcfm_tpu.models.priors import make_prior
+    from dcfm_tpu.models.state import init_state
+
+    cfg = ModelConfig(num_shards=2, factors_per_shard=3, rho=0.8,
+                      compute_dtype=compute_dtype)
+    prior = make_prior(cfg)
+    key = jax.eval_shape(jax.random.key, 0)
+    Y = jax.ShapeDtypeStruct((2, 8, 6), jnp.float32)
+    state = jax.eval_shape(
+        functools.partial(init_state, prior=prior, num_local_shards=2,
+                          n=8, P=6, K=3, as_=cfg.as_, bs=cfg.bs), key)
+
+    def sweep(k, y, s):
+        return gibbs_sweep(k, y, s, cfg, prior)
+    return TraceSpec(fn=sweep, args=(key, Y, state),
+                     static_key=(cfg,), compute_dtype=compute_dtype)
+
+
+@register_trace_entry("models.gibbs_sweep[f32]", sweep_body=True)
+def _trace_gibbs_sweep_f32() -> TraceSpec:
+    return _sweep_trace_spec("f32")
+
+
+@register_trace_entry("models.gibbs_sweep[bf16]", sweep_body=True)
+def _trace_gibbs_sweep_bf16() -> TraceSpec:
+    return _sweep_trace_spec("bf16")

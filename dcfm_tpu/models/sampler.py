@@ -532,3 +532,37 @@ def run_chunk(
             .astype(jnp.float32)),
     )
     return carry, stats, trace
+
+
+# =====================================================================
+# Trace-gate registration (analysis/tracecheck.py): the single-device
+# chunk body, with its carry donation audited abstractly.
+# =====================================================================
+
+from dcfm_tpu.analysis.registry import TraceSpec, register_trace_entry
+
+
+@register_trace_entry("models.run_chunk", sweep_body=True,
+                      donate_argnum=2)
+def _trace_run_chunk() -> TraceSpec:
+    import functools
+
+    from dcfm_tpu.models.priors import make_prior
+    from dcfm_tpu.models.state import packed_pair_indices
+
+    cfg = ModelConfig(num_shards=2, factors_per_shard=3, rho=0.8)
+    prior = make_prior(cfg)
+    rows, cols = packed_pair_indices(cfg.num_shards)
+    key = jax.eval_shape(jax.random.key, 0)
+    Y = jax.ShapeDtypeStruct((2, 8, 6), jnp.float32)
+    carry = jax.eval_shape(
+        functools.partial(init_chain, cfg=cfg, prior=prior,
+                          num_global_shards=cfg.num_shards,
+                          num_stored_draws=0, num_local_pairs=rows.size),
+        key, Y)
+    chunk = functools.partial(
+        run_chunk, cfg=cfg, prior=prior, num_iters=2,
+        num_global_shards=cfg.num_shards, pair_rows=rows, pair_cols=cols)
+    sched = jax.ShapeDtypeStruct((2,), jnp.float32)
+    return TraceSpec(fn=chunk, args=(key, Y, carry, sched),
+                     donate_argnums=(2,), static_key=(cfg, 2))

@@ -148,29 +148,129 @@ def chain_rows(mesh: Mesh) -> int:
         else 1
 
 
-def match_partition_rules(rules, tree):
+def _nearest_miss(name: str, rules) -> str:
+    """The rule pattern most similar to ``name`` (difflib ratio) - the
+    diagnostic for the overwhelmingly common failure, a rule-table typo
+    one edit away from the leaf it meant to match."""
+    import difflib
+
+    best, best_score = None, -1.0
+    for i, (pattern, _) in enumerate(rules):
+        score = difflib.SequenceMatcher(None, pattern, name).ratio()
+        if score > best_score:
+            best, best_score = (i, pattern), score
+    if best is None:
+        return "  (rule table is empty)"
+    return (f"  nearest miss: rule #{best[0]} pattern {best[1]!r} "
+            f"(similarity {best_score:.2f})")
+
+
+def _rule_table_str(rules) -> str:
+    return "\n".join(
+        f"  #{i}: {pattern!r} -> {value}"
+        for i, (pattern, value) in enumerate(rules))
+
+
+def match_partition_rules(rules, tree, *, scalar_spec=P()):
     """PartitionSpec pytree for ``tree``, chosen by NAME: each leaf's key
     path (jax.tree_util.keystr, e.g. ``.state.Lambda`` or
     ``.state.prior['tau']``) is matched against ``rules`` - an ordered
-    list of ``(regex, PartitionSpec)`` pairs - and the FIRST match wins.
-    Scalar and one-element leaves replicate (collectives over a scalar
-    cost more than they shard).  A leaf no rule matches raises: silence
-    here would mean a new carry field silently replicating p^2-sized
-    state onto every chip.
+    list of ``(regex, spec)`` pairs - and the FIRST match wins.  A rule
+    value may also be a callable ``leaf -> spec`` (the committed-layout
+    derivation in api._pin_carry_layouts uses this to read layouts off
+    concrete arrays through the same name-keyed table).
+
+    Scalar and one-element leaves take ``scalar_spec`` without
+    consulting the table (collectives over a scalar cost more than they
+    shard); pass ``scalar_spec=None`` to send scalars through the rules
+    like any other leaf (layout derivation needs every leaf's answer).
+
+    A leaf no rule matches raises with the nearest-miss pattern and the
+    full indexed rule table: silence here would mean a new carry field
+    silently replicating p^2-sized state onto every chip, and the
+    exception alone must be enough to diagnose a rule-table typo.
     """
     def spec_for(path, leaf):
         shape = getattr(leaf, "shape", ())
-        if len(shape) == 0 or int(np.prod(shape)) == 1:
-            return P()
+        if scalar_spec is not None and (
+                len(shape) == 0 or int(np.prod(shape)) == 1):
+            return scalar_spec
         name = jax.tree_util.keystr(path)
         for pattern, spec in rules:
             if re.search(pattern, name):
-                return spec
+                return spec(leaf) if callable(spec) else spec
         raise ValueError(
             f"no partition rule matches carry leaf {name!r} "
             f"(shape {tuple(shape)}); add a rule - an unmatched leaf "
-            "must never silently replicate")
+            "must never silently replicate.\n"
+            + _nearest_miss(name, rules)
+            + "\n  rule table (first match wins):\n"
+            + _rule_table_str(rules))
     return jax.tree_util.tree_map_with_path(spec_for, tree)
+
+
+def carry_partition_rules(*, packed: bool, num_chains: int):
+    """THE chain-carry partition rule table (ROADMAP item 5: all
+    partitioning logic collapses onto one name-keyed table).  The carry
+    is shard-major by default; the named exceptions are the shared
+    factor draws X (replicated across shards), the draw rings (draw
+    axis between chain and shard), and the per-chain iteration counter.
+    A new carry field either matches the shard-major default or fails
+    loudly in match_partition_rules - it cannot silently replicate.
+
+    ``packed`` places the leading chain axis over the chain mesh rows
+    (2-D chains x shards mesh); otherwise a multi-chain carry keeps an
+    unsharded (vmap) leading axis, and a single-chain carry has none.
+    """
+    lead = ((CHAIN_AXIS,) if packed else (None,)) if num_chains > 1 else ()
+    return [
+        (r"\.state\.X$", P(*lead)),
+        (r"\.draws\.X$", P(*lead)),
+        (r"\.draws\.", P(*lead, None, SHARD_AXIS)),
+        (r"\.iteration$", P(*lead)),
+        (r".", P(*lead, SHARD_AXIS)),
+    ]
+
+
+def committed_layout_rules():
+    """Layout-derivation rule table: every leaf answers with its own
+    committed ``.layout`` (sharding + device-local layout read off the
+    concrete array, metadata only).  api._pin_carry_layouts derives the
+    chunk jit's carry in/out placement pin through this table, so the
+    derivation rides the same match_partition_rules seam as the
+    PartitionSpec tables instead of a hand-rolled tree_map."""
+    return [(r".", lambda leaf: leaf.layout)]
+
+
+def chain_diag_spec(packed: bool) -> P:
+    """Per-chunk health/trace outputs: chain-major on a packed mesh
+    (each chain row contributes its chains' rows), replicated
+    otherwise."""
+    return P(CHAIN_AXIS) if packed else P()
+
+
+def shard_sharding(mesh: Mesh) -> NamedSharding:
+    """NamedSharding splitting a leading global-shard axis over the
+    mesh - the one construction site for the data-placement sharding
+    (place_sharded / place_sharded_global / streaming upload)."""
+    return NamedSharding(mesh, P(SHARD_AXIS))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    """Fully-replicated NamedSharding - the fetch/replicate jits'
+    out_shardings (every process can materialize the output on host)."""
+    return NamedSharding(mesh, P())
+
+
+def named_shardings(mesh: Mesh, specs, tree):
+    """Carry PartitionSpec pytree -> NamedSharding pytree shaped like
+    ``tree`` (the resume-commit path: a host-numpy carry is device_put
+    with exactly the shardings the shard_map chunk expects)."""
+    spec_leaves = jax.tree_util.tree_leaves(
+        specs, is_leaf=lambda x: isinstance(x, P))
+    treedef = jax.tree_util.tree_structure(tree)
+    return jax.tree_util.tree_unflatten(
+        treedef, [NamedSharding(mesh, s) for s in spec_leaves])
 
 
 def shards_per_device(num_shards: int, mesh: Mesh) -> int:

@@ -37,9 +37,10 @@ from typing import Optional
 import numpy as np
 
 import jax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
 
-from dcfm_tpu.parallel.mesh import SHARD_AXIS, initialize_multihost, make_mesh
+from dcfm_tpu.parallel.mesh import (
+    initialize_multihost, make_mesh, shard_sharding)
 
 
 def initialize(coordinator_address: str, num_processes: int,
@@ -98,7 +99,7 @@ def place_sharded_global(Y_shard_major: np.ndarray, mesh: Mesh) -> jax.Array:
     behaves exactly like parallel.shard.place_sharded's output, so
     build_mesh_chain runs unmodified on top.
     """
-    sharding = NamedSharding(mesh, P(SHARD_AXIS))
+    sharding = shard_sharding(mesh)
     if jax.process_count() == 1:
         return jax.device_put(Y_shard_major, sharding)
     # every process holds the full host copy; the callback hands each

@@ -33,7 +33,7 @@ except ImportError:  # pragma: no cover - older JAX
     def shard_map(f, *, mesh, in_specs, out_specs):
         return _shard_map_legacy(f, mesh=mesh, in_specs=in_specs,
                                  out_specs=out_specs, check_rep=False)
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
 
 from dcfm_tpu.config import ModelConfig, RunConfig
 from dcfm_tpu.models.priors import Prior
@@ -41,8 +41,9 @@ from dcfm_tpu.models.sampler import (
     ChainCarry, ChainStats, DrawBuffers, chain_keys, init_chain, run_chunk)
 from dcfm_tpu.models.state import num_padded_pairs, packed_pair_indices
 from dcfm_tpu.parallel.mesh import (
-    CHAIN_AXIS, SHARD_AXIS, match_partition_rules, replicated_spec,
-    shard_spec, shards_per_device)
+    CHAIN_AXIS, SHARD_AXIS, carry_partition_rules, chain_diag_spec,
+    match_partition_rules, replicated_spec, shard_sharding, shard_spec,
+    shards_per_device)
 
 
 def _mesh_reduce(x: jax.Array) -> jax.Array:
@@ -126,29 +127,17 @@ def build_mesh_chain(
 
     sh = shard_spec()       # leading global-shard axis -> split over mesh
     rep = replicated_spec()
-    # Leading chain-axis placement: split over the chain mesh rows when
-    # packed, an unsharded (vmap) leading axis otherwise.
-    lead = ((CHAIN_AXIS,) if packed else (None,)) if C > 1 else ()
 
     import jax.numpy as jnp  # noqa: F811
 
     def carry_specs() -> ChainCarry:
         # Rule-based partition specs, matched by LEAF NAME against the
-        # carry template (parallel.mesh.match_partition_rules): the carry
-        # is shard-major by default; the named exceptions are the shared
-        # factor draws X (replicated across shards), the draw rings
-        # (draw axis between chain and shard), and the per-chain
-        # iteration counter.  A new carry field either matches the
-        # shard-major default or fails loudly here - it cannot silently
-        # replicate.
+        # carry template through THE carry rule table
+        # (parallel.mesh.carry_partition_rules - see its docstring for
+        # the placement policy; an unmatched new carry field fails
+        # loudly there, it cannot silently replicate).
         template = jax.eval_shape(_global_carry, jax.random.key(0))
-        rules = [
-            (r"\.state\.X$", P(*lead)),
-            (r"\.draws\.X$", P(*lead)),
-            (r"\.draws\.", P(*lead, None, SHARD_AXIS)),
-            (r"\.iteration$", P(*lead)),
-            (r".", P(*lead, SHARD_AXIS)),
-        ]
+        rules = carry_partition_rules(packed=packed, num_chains=C)
         return match_partition_rules(rules, template)
 
     def _global_carry(key):
@@ -230,9 +219,7 @@ def build_mesh_chain(
         return carry, stats, trace
 
     specs = carry_specs()
-    # Per-chunk health/trace outputs: chain-major on a packed mesh (each
-    # row contributes its chains' rows), replicated otherwise.
-    diag = P(CHAIN_AXIS) if packed else rep
+    diag = chain_diag_spec(packed)
     init_fn = jax.jit(shard_map(
         _init, mesh=mesh,
         in_specs=(rep, sh),
@@ -259,8 +246,7 @@ def build_mesh_chain(
 
 def place_sharded(Y_shard_major, mesh: Mesh):
     """Host (g, n, P) array -> device array split over the mesh shard axis."""
-    return jax.device_put(
-        Y_shard_major, NamedSharding(mesh, P(SHARD_AXIS)))
+    return jax.device_put(Y_shard_major, shard_sharding(mesh))
 
 
 def place_sharded_streaming(source, mesh: Mesh, *,
@@ -279,7 +265,7 @@ def place_sharded_streaming(source, mesh: Mesh, *,
     """
     from dcfm_tpu.runtime.fetch import upload_host_array
 
-    sharding = NamedSharding(mesh, P(SHARD_AXIS))
+    sharding = shard_sharding(mesh)
     shape = tuple(source.shape)
     singles = []
     out_dtype = None
@@ -292,3 +278,50 @@ def place_sharded_streaming(source, mesh: Mesh, *,
         del block
     return jax.make_array_from_single_device_arrays(
         shape, sharding, singles)
+
+
+# =====================================================================
+# Trace-gate registration (analysis/tracecheck.py): the mesh chunk
+# bodies at representative meshes - the plain 1-D shard mesh and the
+# packed 2-D (chains x shards) mesh whose chain rows must never
+# communicate during the sweep (the DCFM1802 contract).
+# =====================================================================
+
+from dcfm_tpu.analysis.registry import (
+    SkipEntry, TraceSpec, register_trace_entry)
+
+
+def _mesh_chunk_spec(mesh: Mesh, num_chains: int) -> TraceSpec:
+    from dcfm_tpu.models.priors import make_prior
+
+    cfg = ModelConfig(num_shards=4, factors_per_shard=3, rho=0.8)
+    prior = make_prior(cfg)
+    init_fn, chunk_fn, _specs = build_mesh_chain(
+        mesh, cfg, prior, num_iters=2, num_chains=num_chains)
+    key = jax.eval_shape(jax.random.key, 0)
+    Y = jax.ShapeDtypeStruct((cfg.num_shards, 8, 6), jnp.float32)
+    carry = jax.eval_shape(init_fn, key, Y)
+    sched = jax.ShapeDtypeStruct((2,), jnp.float32)
+    return TraceSpec(fn=chunk_fn, args=(key, Y, carry, sched), mesh=mesh,
+                     static_key=(cfg, num_chains,
+                                 tuple(sorted(mesh.shape.items()))))
+
+
+@register_trace_entry("parallel.mesh_chunk", sweep_body=True,
+                      donate_argnum=2)
+def _trace_mesh_chunk() -> TraceSpec:
+    from dcfm_tpu.parallel.mesh import make_mesh
+
+    if jax.device_count() < 2:
+        raise SkipEntry("needs >= 2 devices for the shard mesh")
+    return _mesh_chunk_spec(make_mesh(2), 1)
+
+
+@register_trace_entry("parallel.packed_chunk", sweep_body=True,
+                      donate_argnum=2)
+def _trace_packed_chunk() -> TraceSpec:
+    from dcfm_tpu.parallel.mesh import make_chain_mesh
+
+    if jax.device_count() < 4:
+        raise SkipEntry("needs >= 4 devices for the chains x shards mesh")
+    return _mesh_chunk_spec(make_chain_mesh(2, 4), 2)

@@ -116,8 +116,8 @@ def fetch_jit(g: int, num_chains: int, mode: str, mesh=None):
         return cast_for_link(u, mode)
     if mesh is None:
         return jax.jit(prep)
-    from jax.sharding import NamedSharding, PartitionSpec
-    return jax.jit(prep, out_shardings=NamedSharding(mesh, PartitionSpec()))
+    from dcfm_tpu.parallel.mesh import replicated_sharding
+    return jax.jit(prep, out_shardings=replicated_sharding(mesh))
 
 
 @functools.lru_cache(maxsize=64)
@@ -145,17 +145,16 @@ def fetch_sd_jit(g: int, num_chains: int, mode: str, mesh=None):
         return cast_for_link(sd, mode)
     if mesh is None:
         return jax.jit(prep)
-    from jax.sharding import NamedSharding, PartitionSpec
-    return jax.jit(prep, out_shardings=NamedSharding(mesh, PartitionSpec()))
+    from dcfm_tpu.parallel.mesh import replicated_sharding
+    return jax.jit(prep, out_shardings=replicated_sharding(mesh))
 
 
 @functools.lru_cache(maxsize=8)
 def replicate_jit(mesh):
     """Identity jit that replicates a (sharded) pytree over the mesh -
     the multi-process path uses it to make small outputs host-fetchable."""
-    from jax.sharding import NamedSharding, PartitionSpec
-    return jax.jit(lambda x: x,
-                   out_shardings=NamedSharding(mesh, PartitionSpec()))
+    from dcfm_tpu.parallel.mesh import replicated_sharding
+    return jax.jit(lambda x: x, out_shardings=replicated_sharding(mesh))
 
 
 @functools.lru_cache(maxsize=4)
@@ -279,3 +278,24 @@ def assemble_q8_sigma(q8: np.ndarray, scales: np.ndarray,
     return assemble_from_q8(q8, scales, pre,
                             destandardize=True, reinsert_zero_cols=True,
                             force=True)
+
+
+# =====================================================================
+# Trace-gate registration (analysis/tracecheck.py): the quant8 fetch
+# prep - the one fetch mode with its own cast/scale graph.
+# =====================================================================
+
+from dcfm_tpu.analysis.registry import TraceSpec, register_trace_entry
+
+
+@register_trace_entry("runtime.fetch_quant8")
+def _trace_fetch_quant8() -> TraceSpec:
+    from dcfm_tpu.models.state import num_padded_pairs
+
+    g, num_chains = 4, 2
+    acc = jax.ShapeDtypeStruct(
+        (num_chains, num_padded_pairs(g), 8, 8), jnp.float32)
+    inv_count = jax.ShapeDtypeStruct((), jnp.float32)
+    return TraceSpec(fn=fetch_jit(g, num_chains, "quant8"),
+                     args=(acc, inv_count),
+                     static_key=(g, num_chains, "quant8"))

@@ -30,6 +30,17 @@ python -m dcfm_tpu.analysis . \
 echo "== dcfm-lint: README rule table matches --list-rules =="
 python -m dcfm_tpu.analysis --check-readme README.md || exit 1
 
+# Trace-level gate: abstractly trace every registered jit entry at its
+# representative mesh and verify the DCFM18xx jaxpr invariants
+# (collective-axis safety, dtype leaks, carry donation, retrace
+# sentinel).  Trace only - nothing compiles - so this stays seconds.
+# Shares the AST gate's baseline and exit contract; the per-entry
+# results are content-hash cached on each defining module.
+echo "== dcfm-lint: trace-level jaxpr invariants (baseline-gated) =="
+JAX_PLATFORMS=cpu python -m dcfm_tpu.analysis --trace \
+    --baseline LINT_BASELINE.json \
+    --fail-on warning || exit 1
+
 # Serve tests always run through the crash-isolated lane IN ADDITION to
 # their in-process tier-1 run below: they exercise native assembly +
 # sockets + thread storms, so a native-level abort here must fail ONE

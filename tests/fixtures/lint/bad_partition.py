@@ -1,0 +1,24 @@
+"""known-bad fixture: PartitionSpec/NamedSharding constructed outside
+parallel/mesh.py's rule table (DCFM1701) - every ctor spelling the
+alias table resolves fires once."""
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec
+from jax.sharding import PartitionSpec as P
+
+
+def inline_spec(mesh, x):
+    # the classic drift shape: a row-sharded spec decided at the call
+    # site instead of the name-keyed rule table
+    spec = PartitionSpec("shards", None)
+    return jax.device_put(x, NamedSharding(mesh, spec))
+
+
+def aliased_spec(mesh, x):
+    # `from jax.sharding import PartitionSpec as P` resolves too
+    return jax.device_put(x, NamedSharding(mesh, P("shards")))
+
+
+def api_level_ctors(mesh, x):
+    # the jax-namespace re-exports are the same ctor
+    return jax.device_put(x, jax.NamedSharding(mesh, jax.P("shards")))

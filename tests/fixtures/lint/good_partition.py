@@ -1,0 +1,31 @@
+"""known-good twin of bad_partition.py: placement routed through
+parallel/mesh.py's rule table and helpers - no inline specs left for
+DCFM1701 to audit, and the one sanctioned one-off carries a pragma."""
+
+import jax
+from jax.sharding import PartitionSpec
+
+from dcfm_tpu.parallel.mesh import (carry_partition_rules,
+                                    match_partition_rules,
+                                    named_shardings, replicated_sharding,
+                                    shard_sharding)
+
+
+def place_rows(mesh, x):
+    return jax.device_put(x, shard_sharding(mesh))
+
+
+def place_replicated(mesh, x):
+    return jax.device_put(x, replicated_sharding(mesh))
+
+
+def place_carry(mesh, carry):
+    rules = carry_partition_rules(packed=False, num_chains=1)
+    specs = match_partition_rules(rules, carry)
+    return jax.device_put(carry, named_shardings(mesh, specs, carry))
+
+
+def sanctioned_oneoff(mesh, x):
+    # a reviewed exception stays visible (and audited) via the pragma
+    spec = PartitionSpec("shards")  # dcfm: ignore[DCFM1701] - doc example of the sanctioned escape hatch
+    return jax.device_put(x, shard_sharding(mesh)), spec

@@ -335,6 +335,38 @@ def test_precision_skips_scripts_and_tests():
                    for f in lint_source(src, "scripts/demo.py"))
 
 
+def test_bad_partition_fires_1701():
+    assert _rules_fired("bad_partition.py") == {"DCFM1701"}
+
+
+def test_bad_partition_flags_every_ctor_spelling():
+    findings = lint_file(os.path.join(FIXTURES, "bad_partition.py"))
+    msgs = [f.message for f in findings if f.rule == "DCFM1701"]
+    # direct PartitionSpec, two NamedShardings, the `as P` alias, and
+    # both jax-namespace re-exports
+    assert len(msgs) == 6
+    assert any(m.startswith("PartitionSpec(...)") for m in msgs)
+    assert any(m.startswith("NamedSharding(...)") for m in msgs)
+
+
+def test_partition_rule_exempts_the_rule_table_home():
+    """parallel/mesh.py IS the table: the same ctor is quiet there and
+    flagged everywhere else in the library."""
+    src = ("from jax.sharding import PartitionSpec\n"
+           "def spec():\n"
+           "    return PartitionSpec('shards')\n")
+    assert not any(f.rule == "DCFM1701"
+                   for f in lint_source(src,
+                                        "dcfm_tpu/parallel/mesh.py"))
+    assert any(f.rule == "DCFM1701"
+               for f in lint_source(src, "dcfm_tpu/api.py"))
+    # library-only scope: tests and scripts build ad-hoc specs freely
+    assert not any(f.rule == "DCFM1701"
+                   for f in lint_source(src, "test_mod.py"))
+    assert not any(f.rule == "DCFM1701"
+                   for f in lint_source(src, "scripts/demo.py"))
+
+
 def test_bad_pragma_fires_002_for_dead_and_unknown():
     findings = lint_file(os.path.join(FIXTURES, "bad_pragma.py"))
     assert {f.rule for f in findings} == {"DCFM002"}
@@ -365,7 +397,7 @@ def test_every_rule_family_has_a_firing_fixture():
     "good_multihost.py", "good_runtime.py", "good_obs.py",
     "good_handler.py", "good_locks.py", "good_lifetime.py",
     "good_pragma.py", "good_poll.py", "good_chainaxis.py",
-    "good_densequad.py", "good_precision.py"])
+    "good_densequad.py", "good_precision.py", "good_partition.py"])
 def test_good_fixture_is_clean(name):
     findings = lint_file(os.path.join(FIXTURES, name))
     assert findings == [], [str(f) for f in findings]

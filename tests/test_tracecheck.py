@@ -1,0 +1,403 @@
+"""Trace-gate tests: the DCFM18xx jaxpr invariants on deliberately
+broken entries, the shipped registry's clean run, and the partition
+rule table's unmatched-leaf diagnostics.
+
+Everything here traces abstractly (ShapeDtypeStruct inputs) - nothing
+compiles or executes - so the whole module stays fast despite walking
+real gibbs-sweep jaxprs.  The broken entries register under the
+``fixture.`` prefix; ``discover()`` filters them out by builder path,
+which is itself pinned below.
+"""
+
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from dcfm_tpu.analysis import tracecheck
+from dcfm_tpu.analysis.engine import to_sarif
+from dcfm_tpu.analysis.registry import (SkipEntry, TraceKeyRegistry,
+                                        TraceSpec, discover, entries, get,
+                                        register_trace_entry)
+from dcfm_tpu.analysis.rules import TRACE_RULES
+from dcfm_tpu.parallel.mesh import (CHAIN_AXIS, SHARD_AXIS,
+                                    make_chain_mesh,
+                                    match_partition_rules)
+from dcfm_tpu.parallel.shard import shard_map
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 virtual CPU devices")
+
+_f32 = jnp.float32
+
+
+def _sds(shape):
+    return jax.ShapeDtypeStruct(shape, _f32)
+
+
+# ---------------------------------------------------------------------------
+# deliberately-broken entries (the trace twin of the bad_* lint fixtures)
+# ---------------------------------------------------------------------------
+
+@register_trace_entry("fixture.chains_psum", sweep_body=True)
+def _chains_psum_spec():
+    """A sweep body that pools across chains mid-sweep: the exact
+    PR-12 violation DCFM1802 exists to catch."""
+    mesh = make_chain_mesh(2, 4)
+
+    def body(x):
+        pooled = jax.lax.psum(x, CHAIN_AXIS)          # the violation
+        return pooled + jax.lax.psum(x, SHARD_AXIS)   # this one is fine
+
+    fn = shard_map(body, mesh=mesh,
+                   in_specs=P(CHAIN_AXIS, SHARD_AXIS),
+                   out_specs=P(None, None))
+    return TraceSpec(fn=fn, args=(_sds((2, 2)),), mesh=mesh)
+
+
+@register_trace_entry("fixture.shards_psum", sweep_body=True)
+def _shards_psum_spec():
+    """The sanctioned twin: the same reduction over the shard axis."""
+    mesh = make_chain_mesh(2, 4)
+
+    def body(x):
+        return jax.lax.psum(x, SHARD_AXIS)
+
+    fn = shard_map(body, mesh=mesh,
+                   in_specs=P(CHAIN_AXIS, SHARD_AXIS),
+                   out_specs=P(CHAIN_AXIS, None))
+    return TraceSpec(fn=fn, args=(_sds((2, 2)),), mesh=mesh)
+
+
+@register_trace_entry("fixture.bf16_leak")
+def _bf16_leak_spec():
+    """A bfloat16 cast inside the f32-default graph (DCFM1803)."""
+    def fn(x):
+        return jnp.sum(x.astype(jnp.bfloat16)).astype(_f32)
+
+    return TraceSpec(fn=fn, args=(_sds((8, 8)),))
+
+
+@register_trace_entry("fixture.unpinned_dot")
+def _unpinned_dot_spec():
+    """bf16 mode with an unpinned accumulation (DCFM1804)."""
+    def fn(a, b):
+        return jnp.dot(a.astype(jnp.bfloat16), b.astype(jnp.bfloat16))
+
+    return TraceSpec(fn=fn, args=(_sds((4, 4)), _sds((4, 4))),
+                     compute_dtype="bf16")
+
+
+@register_trace_entry("fixture.pinned_dot")
+def _pinned_dot_spec():
+    """The sanctioned `mm` pattern: low-precision multiply, f32 accum."""
+    def fn(a, b):
+        return jnp.dot(a.astype(jnp.bfloat16), b.astype(jnp.bfloat16),
+                       preferred_element_type=_f32)
+
+    return TraceSpec(fn=fn, args=(_sds((4, 4)), _sds((4, 4))),
+                     compute_dtype="bf16")
+
+
+@register_trace_entry("fixture.callback")
+def _callback_spec():
+    """A host callback in the hot path (DCFM1805)."""
+    def fn(x):
+        jax.debug.print("x = {x}", x=x)
+        return x * 2.0
+
+    return TraceSpec(fn=fn, args=(_sds((4,)),))
+
+
+@register_trace_entry("fixture.undonated_carry", donate_argnum=2)
+def _undonated_carry_spec():
+    """A chunk-shaped entry that forgot donate_argnums (DCFM1806)."""
+    def chunk(y, sched, carry):
+        return {"state": carry["state"] + jnp.sum(y) + sched[0]}
+
+    return TraceSpec(fn=chunk,
+                     args=(_sds((4,)), _sds((2,)),
+                           {"state": _sds((4,))}))
+
+
+@register_trace_entry("fixture.donated_carry", donate_argnum=2)
+def _donated_carry_spec():
+    """The fixed twin: same chunk, carry donated."""
+    def chunk(y, sched, carry):
+        return {"state": carry["state"] + jnp.sum(y) + sched[0]}
+
+    return TraceSpec(fn=chunk,
+                     args=(_sds((4,)), _sds((2,)),
+                           {"state": _sds((4,))}),
+                     donate_argnums=(2,))
+
+
+@register_trace_entry("fixture.mutable_key")
+def _mutable_key_spec():
+    """A static cache key carrying a dict and an identity-hashed
+    object: both defeat jit's trace cache (DCFM1807)."""
+    return TraceSpec(fn=lambda x: x * 2.0, args=(_sds((2,)),),
+                     static_key=({"rho": 0.8}, object()))
+
+
+@register_trace_entry("fixture.broken_builder")
+def _broken_builder_spec():
+    raise RuntimeError("representative mesh construction exploded")
+
+
+@register_trace_entry("fixture.concrete_dep")
+def _concrete_dep_spec():
+    """A data-dependent Python branch: untraceable abstractly."""
+    def fn(x):
+        if x[0] > 0:
+            return x
+        return -x
+
+    return TraceSpec(fn=fn, args=(_sds((2,)),))
+
+
+@register_trace_entry("fixture.skipped")
+def _skipped_spec():
+    raise SkipEntry("needs 1024 devices")
+
+
+def _fired(name):
+    return {f.rule for f in tracecheck.check_entry(get(name))}
+
+
+# ---------------------------------------------------------------------------
+# per-rule: exact finding sets on the broken entries
+# ---------------------------------------------------------------------------
+
+def test_chains_spanning_psum_fires_1802():
+    findings = tracecheck.check_entry(get("fixture.chains_psum"))
+    assert {f.rule for f in findings} == {"DCFM1802"}
+    assert len(findings) == 1
+    assert "'chains'" in findings[0].message
+    assert findings[0].message.startswith("[fixture.chains_psum]")
+
+
+def test_shard_axis_psum_is_sanctioned():
+    assert tracecheck.check_entry(get("fixture.shards_psum")) == []
+
+
+def test_bf16_leak_in_f32_graph_fires_1803():
+    findings = tracecheck.check_entry(get("fixture.bf16_leak"))
+    assert {f.rule for f in findings} == {"DCFM1803"}
+    assert "bfloat16" in findings[0].message
+    assert "f32-default graph" in findings[0].message
+
+
+def test_unpinned_bf16_dot_fires_1804():
+    findings = tracecheck.check_entry(get("fixture.unpinned_dot"))
+    assert {f.rule for f in findings} == {"DCFM1804"}
+    assert "preferred_element_type" in findings[0].message
+
+
+def test_pinned_bf16_dot_is_clean():
+    assert tracecheck.check_entry(get("fixture.pinned_dot")) == []
+
+
+def test_host_callback_fires_1805():
+    assert _fired("fixture.callback") == {"DCFM1805"}
+
+
+def test_undonated_carry_fires_1806():
+    findings = tracecheck.check_entry(get("fixture.undonated_carry"))
+    assert {f.rule for f in findings} == {"DCFM1806"}
+    assert "argument 2" in findings[0].message
+    assert "donate_argnums=(2,)" in findings[0].message
+
+
+def test_donated_carry_is_clean():
+    assert tracecheck.check_entry(get("fixture.donated_carry")) == []
+
+
+def test_mutable_static_key_fires_1807_per_component():
+    findings = tracecheck.check_entry(get("fixture.mutable_key"))
+    assert [f.rule for f in findings] == ["DCFM1807", "DCFM1807"]
+    msgs = "\n".join(f.message for f in findings)
+    assert "component #0" in msgs and "dict" in msgs
+    assert "component #1" in msgs and "identity" in msgs
+
+
+def test_builder_failure_fires_1800():
+    findings = tracecheck.check_entry(get("fixture.broken_builder"))
+    assert {f.rule for f in findings} == {"DCFM1800"}
+    assert "entry builder failed" in findings[0].message
+
+
+def test_concrete_value_dependence_fires_1800():
+    findings = tracecheck.check_entry(get("fixture.concrete_dep"))
+    assert {f.rule for f in findings} == {"DCFM1800"}
+    assert "abstract trace failed" in findings[0].message
+
+
+def test_skip_entry_yields_no_findings():
+    assert tracecheck.check_entry(get("fixture.skipped")) == []
+
+
+def test_findings_anchor_at_the_registration_site():
+    entry = get("fixture.chains_psum")
+    f = tracecheck.check_entry(entry)[0]
+    assert f.path == entry.path
+    assert f.path.endswith("test_tracecheck.py")
+    assert f.line == entry.line > 0
+
+
+# ---------------------------------------------------------------------------
+# retrace sentinel internals
+# ---------------------------------------------------------------------------
+
+def test_key_registry_sanctions_the_frozen_config_vocabulary():
+    from dcfm_tpu import ModelConfig
+    reg = TraceKeyRegistry()
+    cfg = ModelConfig(num_shards=2, factors_per_shard=3, rho=0.8)
+    assert reg.record("e", (cfg, 4, "quant8", (("shards", 2),))) == []
+
+
+def test_key_registry_flags_non_frozen_dataclass():
+    # eq=True (the default) deletes __hash__ entirely: the unhashable
+    # branch; eq=False keeps object identity hashing: the silent
+    # per-call-retrace branch.  Both are DCFM1807 material.
+    @dataclasses.dataclass
+    class UnhashableCfg:
+        n: int = 1
+
+    @dataclasses.dataclass(eq=False)
+    class IdentityCfg:
+        n: int = 1
+
+    reg = TraceKeyRegistry()
+    problems = reg.record("e", (UnhashableCfg(), IdentityCfg()))
+    assert [i for i, _ in problems] == [0, 1]
+    assert "unhashable" in problems[0][1]
+    assert "non-frozen dataclass" in problems[1][1]
+
+
+# ---------------------------------------------------------------------------
+# the whole-registry gate: discovery, isolation, clean run, cache
+# ---------------------------------------------------------------------------
+
+def test_discover_filters_fixture_entries():
+    names = {e.name for e in discover()}
+    assert names, "library registered no trace entries"
+    assert not any(n.startswith("fixture.") for n in names)
+    # ...even though the raw registry does hold them (imported above)
+    assert any(n.startswith("fixture.") for n in entries())
+
+
+def test_shipped_registry_verifies_clean():
+    """The acceptance gate: every registered library entry passes every
+    DCFM18xx check (what `dcfm-tpu lint --trace` runs in CI)."""
+    findings = tracecheck.check_entries(discover())
+    assert findings == [], "\n".join(str(f) for f in findings)
+
+
+def test_trace_cache_round_trip(tmp_path):
+    cache = str(tmp_path / "tc.json")
+    first = tracecheck.check_project(cache_path=cache, root=REPO)
+    assert first == []
+    with open(cache, encoding="utf-8") as f:
+        data = json.load(f)
+    assert set(data["entries"]) == {e.name for e in discover()}
+    # warm run serves every entry from the module-hash cache
+    assert tracecheck.check_project(cache_path=cache, root=REPO) == []
+
+
+def test_trace_changed_without_git_raises(tmp_path):
+    with pytest.raises(RuntimeError, match="--changed"):
+        tracecheck.check_project(changed_only=True, root=str(tmp_path))
+
+
+def test_trace_findings_serialize_to_sarif():
+    findings = tracecheck.check_entry(get("fixture.chains_psum"))
+    log = to_sarif(findings, REPO)
+    assert log["version"] == "2.1.0"
+    driver = log["runs"][0]["tool"]["driver"]
+    assert set(TRACE_RULES) <= {r["id"] for r in driver["rules"]}
+    res = log["runs"][0]["results"][0]
+    assert res["ruleId"] == "DCFM1802"
+    assert res["level"] == "error"
+    loc = res["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"].endswith("test_tracecheck.py")
+    assert loc["region"]["startLine"] >= 1
+
+
+def test_cli_trace_gate_is_clean():
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-m", "dcfm_tpu.analysis", "--trace"],
+        capture_output=True, text=True, cwd=REPO, env=env)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "clean" in proc.stdout
+
+
+def test_trace_gate_shares_the_baseline_without_clobbering_ast_debt(
+        tmp_path):
+    """One LINT_BASELINE.json, partitioned by rule family: the trace
+    gate neither reports the AST entries as stale nor wipes them on
+    --write-baseline."""
+    base = tmp_path / "b.json"
+    base.write_text(json.dumps({"version": 1, "entries": [
+        {"fingerprint": "0" * 40, "rule": "DCFM101",
+         "path": "scripts/x.py", "text": "k reused"}]}))
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+
+    gated = subprocess.run(
+        [sys.executable, "-m", "dcfm_tpu.analysis", "--trace",
+         "--baseline", str(base)],
+        capture_output=True, text=True, cwd=REPO, env=env)
+    assert gated.returncode == 0, gated.stdout + gated.stderr
+    assert "stale" not in gated.stdout
+
+    wrote = subprocess.run(
+        [sys.executable, "-m", "dcfm_tpu.analysis", "--trace",
+         "--baseline", str(base), "--write-baseline"],
+        capture_output=True, text=True, cwd=REPO, env=env)
+    assert wrote.returncode == 0, wrote.stdout + wrote.stderr
+    entries_after = json.loads(base.read_text())["entries"]
+    assert [e["rule"] for e in entries_after] == ["DCFM101"]
+
+
+# ---------------------------------------------------------------------------
+# partition-rule conformance: the table's unmatched-leaf diagnostics
+# ---------------------------------------------------------------------------
+
+def test_unmatched_leaf_error_names_nearest_miss_and_table():
+    """The one-edit typo case: the exception alone must be enough to
+    diagnose which rule was meant."""
+    rules = [(r"\.state\.Lambda$", P(SHARD_AXIS)),
+             (r"\.state\.X$", P())]
+    tree = {"state": {"Lamda": _sds((4, 4))}}       # typo'd leaf
+    with pytest.raises(ValueError) as exc:
+        match_partition_rules(rules, tree)
+    msg = str(exc.value)
+    assert "no partition rule matches carry leaf" in msg
+    assert "nearest miss: rule #" in msg
+    assert "similarity" in msg
+    assert "rule table (first match wins):" in msg
+    assert "#0:" in msg and "#1:" in msg
+    assert repr(r"\.state\.X$") in msg              # full table printed
+
+
+def test_callable_rules_and_scalar_passthrough():
+    rules = [(r".", lambda leaf: P() if len(leaf.shape) == 0
+              else P(SHARD_AXIS))]
+    specs = match_partition_rules(
+        rules, {"a": _sds((4,)), "b": _sds(())}, scalar_spec=None)
+    assert specs == {"a": P(SHARD_AXIS), "b": P()}
+
+
+def test_scalars_skip_the_table_by_default():
+    # an empty table would raise for any consulted leaf; scalars never
+    # consult it
+    assert match_partition_rules([], {"n": _sds(())}) == {"n": P()}
