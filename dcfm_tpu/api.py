@@ -583,6 +583,7 @@ def fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
                  num_chains=cfg.run.num_chains,
                  fetch_dtype=cfg.backend.fetch_dtype,
                  compute_dtype=cfg.backend.compute_dtype,
+                 sse_mode=cfg.backend.sse_mode,
                  checkpoint=bool(cfg.checkpoint_path),
                  resume=str(cfg.resume))
         try:
@@ -657,6 +658,12 @@ def _fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
         # graph, while the user's config - and the checkpoint fingerprint
         # built from it - round-trips unchanged.
         m = dataclasses.replace(m, compute_dtype=cfg.backend.compute_dtype)
+    if m.sse_mode != cfg.backend.sse_mode:
+        # Same internal-mirror threading for the psi/SSE strategy knob.
+        # Unlike compute_dtype, a RESUME may flip it freely: checkpoint
+        # adoption compares the user configs, where sse_mode sits on the
+        # (uncompared) backend - see utils/checkpoint.checkpoint_compatible.
+        m = dataclasses.replace(m, sse_mode=cfg.backend.sse_mode)
     key = jax.random.key(run.seed)
     k_init, k_chain = jax.random.split(key)
     if cfg.warm_start is not None:

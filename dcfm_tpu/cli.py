@@ -296,6 +296,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "stay float32 (see README 'Precision policy').  "
                         "'f32' (default) compiles graphs bitwise-identical "
                         "to a build without the knob")
+    f.add_argument("--sse-mode", default="resid",
+                   choices=["resid", "gram", "auto"],
+                   help="psi-stage SSE strategy.  'gram' computes the "
+                        "per-feature SSE from the Lambda stage's eta'eta / "
+                        "eta'Y cross-moments instead of the (n, P) residual "
+                        "and draws the residual precisions rejection-free - "
+                        "measured 3.4x on the whole sweep at the bench "
+                        "shape (see README 'Breaking the psi wall').  "
+                        "'auto' picks 'gram' when n >= K per shard.  "
+                        "'resid' (default) compiles graphs bitwise-"
+                        "identical to a build without the knob")
     f.add_argument("--combine-chunks", type=int, default=1,
                    help="split each saved draw's combine into this many "
                         "column chunks with a cross-shard rendezvous between "
@@ -533,6 +544,7 @@ def main(argv=None) -> int:
                               fetch_dtype=args.fetch_dtype,
                               upload_dtype=args.upload_dtype,
                               compute_dtype=args.compute_dtype,
+                              sse_mode=args.sse_mode,
                               profile_dir=args.profile_dir),
         permute=not args.no_permute,
         checkpoint_path=args.checkpoint,
@@ -623,6 +635,7 @@ def main(argv=None) -> int:
                   else [res.preprocess.p_original] * 2),
         "seconds": round(res.seconds, 3),
         "compute_dtype": cfg.backend.compute_dtype,
+        "sse_mode": cfg.backend.sse_mode,
         "iters_per_sec": round(res.iters_per_sec, 2),
         "chain_iters_per_sec": round(res.chain_iters_per_sec, 2),
         "phase_seconds": {k: round(v, 3)

@@ -128,6 +128,12 @@ class ModelConfig:
     # user-facing config round-trips unchanged through checkpoints.  Set
     # it on BackendConfig, not here.
     compute_dtype: str = "f32"  # "f32" | "bf16"
+    # INTERNAL mirror of BackendConfig.sse_mode (same contract as
+    # compute_dtype above): fit() threads the backend knob here so the
+    # jit caches retrace when the psi/SSE strategy changes, while the
+    # user-facing config round-trips unchanged through checkpoints.  Set
+    # it on BackendConfig, not here.
+    sse_mode: str = "resid"  # "resid" | "gram" | "auto"
     # Implementation of the Lambda-update batched K x K Cholesky sampler
     # (SURVEY.md C10).  "auto" picks the statically-unrolled elementwise
     # XLA path for K <= 16 and lax.linalg beyond - use it.  The profiled
@@ -311,6 +317,28 @@ class BackendConfig:
     # checkpoint meta records the dtype and resume refuses a mismatched
     # donor.
     compute_dtype: str = "f32"   # "f32" | "bf16"
+    # Strategy for the psi stage's per-feature SSE (models/conditionals.py
+    # `ps_update`).  "resid" - the default - re-forms the (n, P) residual
+    # Y - eta Lam' per shard and compiles graphs bitwise-identical to a
+    # build without the knob.  "gram" eliminates the residual via the
+    # identity SSE_j = Y_j'Y_j - 2 Lam_j'(EY)_j + Lam_j' E Lam_j on the
+    # K x K / K x P cross-moments the Lambda stage already materializes,
+    # and replaces the psi Gamma draw's rejection while_loop with an exact
+    # rejection-free construction (sum of Exp(1) draws; ops/gamma.py
+    # `gamma_unit_static`) - a DIFFERENT but equally exact sampler, so
+    # gram fits are statistically exchangeable with resid fits, not
+    # bitwise.  Accuracy contract: the three Gram terms and their
+    # contraction stay f32 under the sweep's "high" matmul-precision
+    # scope (under bf16 compute_dtype the Gram inputs still route through
+    # `mm`'s preferred_element_type=f32); the measured SSE error band vs
+    # the residual path is pinned in tests/test_sse_gram.py.  "auto"
+    # picks "gram" when n >= K per shard (the Gram contraction is cheaper
+    # and full-rank) and "resid" otherwise; resolved at trace time
+    # (models/conditionals.resolve_sse_mode).  Checkpoint meta records
+    # the mode; a donor with a mismatched sse_mode is adopted (state
+    # layout is unchanged and both modes target the identical conditional
+    # law), unlike compute_dtype which refuses.
+    sse_mode: str = "resid"      # "resid" | "gram" | "auto"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -633,6 +661,15 @@ def validate(cfg: FitConfig, n: int, p: int) -> None:
     if m.compute_dtype not in ("f32", "bf16"):
         raise ValueError(
             f"unknown compute_dtype {m.compute_dtype!r} (f32 | bf16); "
+            "set it on BackendConfig - the ModelConfig field is the "
+            "internal mirror fit() threads for jit-cache keying")
+    if cfg.backend.sse_mode not in ("resid", "gram", "auto"):
+        raise ValueError(
+            f"unknown sse_mode {cfg.backend.sse_mode!r} "
+            "(resid | gram | auto)")
+    if m.sse_mode not in ("resid", "gram", "auto"):
+        raise ValueError(
+            f"unknown sse_mode {m.sse_mode!r} (resid | gram | auto); "
             "set it on BackendConfig - the ModelConfig field is the "
             "internal mirror fit() threads for jit-cache keying")
     if cfg.backend.fetch_stream not in ("auto", "on", "off"):

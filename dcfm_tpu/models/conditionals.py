@@ -35,11 +35,12 @@ import jax.numpy as jnp
 from dcfm_tpu.config import ModelConfig
 from dcfm_tpu.models.priors import Prior
 from dcfm_tpu.models.state import SamplerState
-from dcfm_tpu.ops.gamma import gamma_rate
+from dcfm_tpu.ops.gamma import gamma_rate, gamma_unit_static
 from dcfm_tpu.ops.gaussian import (
     sample_mvn_precision_batched,
     sample_mvn_precision_shared,
 )
+from dcfm_tpu.ops.sse_gamma import gram_sse_ps
 
 # site ids for RNG folding - stable across refactors (6 = rank adaptation,
 # models/adapt.py; 7 = missing-data imputation)
@@ -55,6 +56,24 @@ def _shard_keys(site_key: jax.Array, shard_offset, num_local: int) -> jax.Array:
 def local_sum(x: jax.Array) -> jax.Array:
     """Cross-shard reduction for the single-device layout: plain sum over Gl."""
     return jnp.sum(x, axis=0)
+
+
+def resolve_sse_mode(mode: str, *, n: int, K: int) -> str:
+    """Resolve ModelConfig.sse_mode to the concrete psi-stage strategy.
+
+    "auto" picks "gram" when n >= K per shard: the Gram cross-moments
+    E = eta'eta and EY = eta'Y then compress n rows into full-rank K x K /
+    K x P tensors the Lambda stage already materializes, so the psi SSE
+    costs O(P K^2) instead of O(n P K) + an O(n P) reduction - and the
+    three-term cancellation stays benign (SSE ~ n while each term is
+    O(Y_j'Y_j), also ~ n).  With K > n the moments are rank-deficient and
+    BIGGER than the residual they replace, and the relative cancellation
+    error grows with the K extra accumulation terms - keep the residual.
+    Resolved at trace time (static shapes), like every other sweep knob.
+    """
+    if mode == "auto":
+        return "gram" if n >= K else "resid"
+    return mode
 
 
 def impute_missing_y(
@@ -157,6 +176,15 @@ def _gibbs_sweep(key, Y, state, cfg, prior, *, shard_offset, reduce_fn):
     # fits (tests/test_precision.py pins the parity band).
     bf16 = cfg.compute_dtype == "bf16"
 
+    # Gram-based SSE path (ModelConfig.sse_mode, the internal mirror of
+    # BackendConfig.sse_mode).  Guarded at TRACE time like compute_dtype:
+    # the "resid" default compiles exactly the pre-knob graph - bit-
+    # identical fits (tests/test_sse_gram.py pins the jaxpr) - while
+    # "gram" reuses the Lambda stage's cross-moments for the psi SSE and
+    # swaps the psi Gamma draw's rejection while_loop for the exact
+    # Exp-sum construction (ops/gamma.gamma_unit_static).
+    sse_gram = resolve_sse_mode(cfg.sse_mode, n=n, K=K) == "gram"
+
     def mm(a, b):
         if bf16:
             return jnp.matmul(a.astype(jnp.bfloat16),
@@ -237,13 +265,20 @@ def _gibbs_sweep(key, Y, state, cfg, prior, *, shard_offset, reduce_fn):
     # coordinates draw from their (irrelevant) prior and are re-zeroed.
     eta_lam = eta if state.active is None else eta * state.active[:, None, :]
 
-    def lam_terms(Ym, eta_m, ps, plam_m):
+    def lam_moments(Ym, eta_m):
         E = mm(eta_m.T, eta_m)                                  # (K, K)
         EY = mm(eta_m.T, Ym)                                    # (K, P)
+        return E, EY
+
+    def lam_qb(E, EY, ps, plam_m):
         Q = (jax.vmap(jnp.diag)(plam_m)
              + ps[:, None, None] * E[None])                     # (P, K, K)
         B = ps[:, None] * EY.T                                  # (P, K)
         return Q, B
+
+    def lam_terms(Ym, eta_m, ps, plam_m):
+        E, EY = lam_moments(Ym, eta_m)
+        return lam_qb(E, EY, ps, plam_m)
 
     def lam_update(kg, Ym, eta_m, ps, plam_m):
         Q, B = lam_terms(Ym, eta_m, ps, plam_m)
@@ -252,6 +287,17 @@ def _gibbs_sweep(key, Y, state, cfg, prior, *, shard_offset, reduce_fn):
 
     with jax.named_scope("lambda_update"):
         kl = _shard_keys(jax.random.fold_in(key, _SITE_LAM), shard_offset, Gl)
+        if sse_gram:
+            # Gram-mode hoist: the cross-moments are formed ONCE here and
+            # consumed twice - by the Lambda Q/B below and by the Gram SSE
+            # psi stage.  Masked eta (eta_lam) is correct for BOTH uses:
+            # the post-mask Lambda's inactive columns are zero, so every
+            # masked entry of E/EY meets a zero factor in the SSE
+            # contraction and the masked Gram SSE equals the unmasked
+            # residual SSE exactly (tests/test_sse_gram.py asserts it
+            # bitwise).  Under bf16 compute_dtype `mm` still accumulates
+            # in f32 (preferred_element_type) - the accuracy contract.
+            E_all, EY_all = jax.vmap(lam_moments)(Y, eta_lam)
         if cfg.lambda_kernel.startswith("pallas"):
             # "*-interpret" is the api-internal suffix fit() appends when
             # the resolved execution platform is not TPU; without it the
@@ -274,8 +320,12 @@ def _gibbs_sweep(key, Y, state, cfg, prior, *, shard_offset, reduce_fn):
                 # see README); kept for its memory behavior and as the
                 # fusion testbed.
                 from dcfm_tpu.ops.pallas_gaussian import lam_update_pallas
-                E = jnp.einsum("gnk,gnj->gkj", eta_lam, eta_lam)
-                EYt = jnp.einsum("gnp,gnk->gpk", Y, eta_lam)     # (Gl,P,K)
+                if sse_gram:
+                    E = E_all
+                    EYt = jnp.transpose(EY_all, (0, 2, 1))       # (Gl,P,K)
+                else:
+                    E = jnp.einsum("gnk,gnj->gkj", eta_lam, eta_lam)
+                    EYt = jnp.einsum("gnp,gnk->gpk", Y, eta_lam)  # (Gl,P,K)
                 Lam = lam_update_pallas(E, plam, state.ps, EYt, Zn,
                                         interpret=interp)
             else:
@@ -285,7 +335,9 @@ def _gibbs_sweep(key, Y, state, cfg, prior, *, shard_offset, reduce_fn):
                 # tile separately, ~3x wasted lanes at P=157).
                 from dcfm_tpu.ops.pallas_gaussian import (
                     chol_sample_batched_pallas)
-                Q, B = jax.vmap(lam_terms)(Y, eta_lam, state.ps, plam)
+                Q, B = (jax.vmap(lam_qb)(E_all, EY_all, state.ps, plam)
+                        if sse_gram else
+                        jax.vmap(lam_terms)(Y, eta_lam, state.ps, plam))
                 Lam = chol_sample_batched_pallas(
                     Q.reshape(Gl * P, K, K), B.reshape(Gl * P, K),
                     Zn.reshape(Gl * P, K), interpret=interp
@@ -301,10 +353,17 @@ def _gibbs_sweep(key, Y, state, cfg, prior, *, shard_offset, reduce_fn):
             Zn = jax.vmap(
                 lambda k, s: jax.random.normal(k, s.shape, s.dtype))(
                     kl, state.Lambda)
-            Q, B = jax.vmap(lam_terms)(Y, eta_lam, state.ps, plam)
+            Q, B = (jax.vmap(lam_qb)(E_all, EY_all, state.ps, plam)
+                    if sse_gram else
+                    jax.vmap(lam_terms)(Y, eta_lam, state.ps, plam))
             Lam = chol_solve_sample_batched(
                 Q.reshape(Gl * P, K, K), B.reshape(Gl * P, K),
                 Zn.reshape(Gl * P, K)).reshape(Gl, P, K)
+        elif sse_gram:
+            Q, B = jax.vmap(lam_qb)(E_all, EY_all, state.ps, plam)
+            Lam = jax.vmap(
+                lambda kg, q, b: sample_mvn_precision_batched(
+                    kg, q, b, impl=cfg.lambda_kernel))(kl, Q, B)
         else:
             Lam = jax.vmap(lam_update)(kl, Y, eta_lam, state.ps, plam)
         if state.active is not None:
@@ -321,14 +380,49 @@ def _gibbs_sweep(key, Y, state, cfg, prior, *, shard_offset, reduce_fn):
                 kp, state.prior, Lam, state.active)
 
     # ---- residual precisions ps | rest  (``:167-172``) -----------------
-    def ps_update(kg, Ym, eta_m, Lam_m):
-        resid = Ym - eta_m @ Lam_m.T                            # (n, P)
-        sse = jnp.sum(resid * resid, axis=0)                    # (P,)
-        return gamma_rate(kg, cfg.as_ + 0.5 * n, cfg.bs + 0.5 * sse), sse
+    if sse_gram:
+        # Gram identity: SSE_j = Y_j'Y_j - 2 Lam_j'(EY)_j + Lam_j' E Lam_j
+        # on the cross-moments hoisted in the Lambda stage - the (n, P)
+        # residual never forms.  All three terms and their contraction
+        # stay f32 under the sweep's "high" matmul-precision scope (the
+        # subtraction cancels; the fused op clamps at 0).  The Gamma draw
+        # uses the exact rejection-free Exp-sum construction - the
+        # measured psi wall was jax.random.gamma's Marsaglia-Tsang
+        # while_loop (~10 us/ELEMENT on CPU, 19 of 25 ms/iter at the
+        # bench shape), not the residual matmul; both legs are needed for
+        # the >= 3x sweep win.  NOTE: a different (still exact) draw than
+        # gamma_rate => gram chains are statistically exchangeable with
+        # resid chains, not bitwise.
+        with jax.named_scope("ps_update"):
+            ks = _shard_keys(jax.random.fold_in(key, _SITE_PS),
+                             shard_offset, Gl)
+            # per-sweep, not per-fit: O(nP) is noise next to the matmuls
+            # the identity removes, and under impute_missing Y's missing
+            # entries are redrawn every iteration
+            yty = jnp.sum(Y * Y, axis=1)                        # (Gl, P)
+            # the per-shard K x K dependence as ONE f32 batched matmul,
+            # leaving the fused kernel pure per-feature lane arithmetic
+            M = jax.vmap(lambda l, e: l @ e)(Lam, E_all)        # (Gl, P, K)
+            EYt = jnp.transpose(EY_all, (0, 2, 1))              # (Gl, P, K)
+            gunit = jax.vmap(
+                lambda k: gamma_unit_static(k, cfg.as_ + 0.5 * n, (P,)))(ks)
+            ps, sse = gram_sse_ps(
+                Lam.reshape(Gl * P, K), M.reshape(Gl * P, K),
+                EYt.reshape(Gl * P, K), yty.reshape(Gl * P),
+                gunit.reshape(Gl * P), bs=float(cfg.bs))
+            ps = ps.reshape(Gl, P)
+            sse = sse.reshape(Gl, P)
+    else:
+        def ps_update(kg, Ym, eta_m, Lam_m):
+            resid = Ym - eta_m @ Lam_m.T                        # (n, P)
+            sse = jnp.sum(resid * resid, axis=0)                # (P,)
+            return (gamma_rate(kg, cfg.as_ + 0.5 * n,
+                               cfg.bs + 0.5 * sse), sse)
 
-    with jax.named_scope("ps_update"):
-        ks = _shard_keys(jax.random.fold_in(key, _SITE_PS), shard_offset, Gl)
-        ps, sse = jax.vmap(ps_update)(ks, Y, eta, Lam)
+        with jax.named_scope("ps_update"):
+            ks = _shard_keys(jax.random.fold_in(key, _SITE_PS),
+                             shard_offset, Gl)
+            ps, sse = jax.vmap(ps_update)(ks, Y, eta, Lam)
 
     return SamplerState(Lambda=Lam, Z=Z, X=X, ps=ps, prior=prior_state,
                         active=state.active), sse
@@ -539,12 +633,13 @@ def covariance_blocks(
 from dcfm_tpu.analysis.registry import TraceSpec, register_trace_entry
 
 
-def _sweep_trace_spec(compute_dtype: str) -> TraceSpec:
+def _sweep_trace_spec(compute_dtype: str,
+                      sse_mode: str = "resid") -> TraceSpec:
     from dcfm_tpu.models.priors import make_prior
     from dcfm_tpu.models.state import init_state
 
     cfg = ModelConfig(num_shards=2, factors_per_shard=3, rho=0.8,
-                      compute_dtype=compute_dtype)
+                      compute_dtype=compute_dtype, sse_mode=sse_mode)
     prior = make_prior(cfg)
     key = jax.eval_shape(jax.random.key, 0)
     Y = jax.ShapeDtypeStruct((2, 8, 6), jnp.float32)
@@ -566,3 +661,16 @@ def _trace_gibbs_sweep_f32() -> TraceSpec:
 @register_trace_entry("models.gibbs_sweep[bf16]", sweep_body=True)
 def _trace_gibbs_sweep_bf16() -> TraceSpec:
     return _sweep_trace_spec("bf16")
+
+
+# The gram-SSE sweep variants compile materially different psi/Lambda
+# stages (hoisted cross-moments, the fused sse_gamma dispatch, the
+# Exp-sum Gamma draw) - both get the full DCFM18xx battery too.
+@register_trace_entry("models.gibbs_sweep[gram-f32]", sweep_body=True)
+def _trace_gibbs_sweep_gram_f32() -> TraceSpec:
+    return _sweep_trace_spec("f32", sse_mode="gram")
+
+
+@register_trace_entry("models.gibbs_sweep[gram-bf16]", sweep_body=True)
+def _trace_gibbs_sweep_gram_bf16() -> TraceSpec:
+    return _sweep_trace_spec("bf16", sse_mode="gram")

@@ -1,0 +1,206 @@
+"""Fused Gram-SSE + residual-precision rate: one dispatch per shard group.
+
+The gram-mode psi stage (models/conditionals.py, ``sse_mode="gram"``)
+replaces the (n, P) residual with the identity
+
+    SSE_j = Y_j'Y_j - 2 Lam_j'(EY)_j + Lam_j' E Lam_j
+
+on the K x K / K x P cross-moments the Lambda stage already materializes.
+The per-shard E dependence is carried by ONE matmul outside this module
+(M = Lam @ E, MXU work XLA already does well); what remains is pure
+per-feature arithmetic - two length-K contractions, the three-term
+combination (which CANCELS: both subtrahends are O(Y_j'Y_j), so every
+input stays f32 and the result is clamped at 0), and the Gamma-rate
+application ps_j = g_j / (bs + SSE_j/2) - fused here into one batched
+lane-major kernel over the whole flattened (G*P,) feature batch.  The
+unit-Gamma draws g_j ~ Gamma(as_ + n/2, 1) are passed in (drawn
+rejection-free by ops/gamma.py `gamma_unit_static`) so the RNG stays in
+the caller's per-shard key discipline, exactly like Zn in
+`chol_solve_sample_batched`.
+
+Implementations (``impl``):
+
+* ``"unrolled"`` - K statically-unrolled lane slabs; the fallback runs
+  the kernel's OWN ``_lane_sse_ps`` helper on the same padded lane-major
+  operands INSIDE a lax.scan over the same (K, TILE_B) tile slices the
+  pallas grid walks, so it is BITWISE-identical to
+  ``"pallas-interpret"``.  The scan wrapper is load-bearing, not
+  cosmetic: the interpreter lowers the grid to a loop, and XLA:CPU
+  contracts mul+add chains to FMAs inside loop bodies but NOT in flat
+  fused graphs (measured: a flat fallback drifts 1-20 ulp on the
+  three-term SSE; the scan-tiled one is exact).  Identical graph ->
+  identical contraction -> identical bits (tests/test_sse_gram.py pins
+  it).  K <= 16.
+* ``"pallas"`` / ``"pallas-interpret"`` - the fused TPU kernel (batch on
+  the lane dimension, the ops/batched_solve.py layout); interpreter mode
+  off-TPU.  K <= 16.
+* ``"plain"`` - row-major vectorized jnp (any K).
+* ``"auto"`` - pallas on TPU / unrolled elsewhere for K <= 16, plain
+  beyond.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+_MAX_K = 16   # statically-unrolled lane bound (= batched_solve._MAX_K)
+_TILE_B = 512
+
+_IMPLS = ("auto", "plain", "unrolled", "pallas", "pallas-interpret")
+
+
+def gram_sse_ps(
+    Lam: jax.Array,
+    M: jax.Array,
+    EYt: jax.Array,
+    yty: jax.Array,
+    gunit: jax.Array,
+    *,
+    bs: float,
+    impl: str = "auto",
+):
+    """Fused per-feature Gram SSE + Gamma-rate application.
+
+    Args:
+      Lam: (Bn, K) loading rows (a whole shard group flattened - the
+        caller reshapes (G, P, K) -> (G*P, K) so the batch is ONE kernel
+        launch, not a vmap'd per-shard dispatch).
+      M: (Bn, K) rows of Lam @ E (the per-shard K x K Gram factor applied
+        outside - see module docstring).
+      EYt: (Bn, K) rows of (eta'Y)' - the per-feature cross-moment.
+      yty: (Bn,) per-feature Y_j'Y_j (recomputed per sweep: O(nP) is
+        noise next to the matmuls it replaces, and under missing-data
+        imputation Y changes every iteration).
+      gunit: (Bn,) unit-rate Gamma(as_ + n/2, 1) draws.
+      bs: static rate-prior scale (ModelConfig.bs).
+      impl: see module docstring.  "pallas"/"pallas-interpret"/"unrolled"
+        with K > 16 fall back to the plain path (the unrolled slabs are
+        static in K).
+
+    Returns: (ps, sse), each (Bn,) float like the inputs, with
+      sse = max(yty - 2 Lam.EYt + Lam.M, 0) and ps = gunit / (bs + sse/2).
+    """
+    if impl not in _IMPLS:
+        raise ValueError(
+            f"unknown impl {impl!r} ({' | '.join(_IMPLS)}); a typo would "
+            "otherwise silently fall back to the plain path")
+    K = Lam.shape[-1]
+    if impl == "auto":
+        if K <= _MAX_K:
+            impl = ("pallas" if jax.default_backend() == "tpu"
+                    else "unrolled")
+        else:
+            impl = "plain"
+    if impl in ("pallas", "pallas-interpret") and K <= _MAX_K:
+        interpret = (jax.default_backend() != "tpu"
+                     if impl == "pallas" else True)
+        return _sse_ps_pallas_jit(Lam, M, EYt, yty, gunit,
+                                  float(bs), bool(interpret))
+    if impl == "unrolled" and K <= _MAX_K:
+        return _sse_ps_unrolled_jit(Lam, M, EYt, yty, gunit, float(bs))
+    return _sse_ps_plain_jit(Lam, M, EYt, yty, gunit, float(bs))
+
+
+def _lane_sse_ps(lam_ref, m_ref, eyt_ref, yty_ref, g_ref, K: int,
+                 bs: float):
+    """One lane tile: both length-K contractions as statically-unrolled
+    (1, TILE_B) slab accumulations, then the clamped three-term SSE and
+    the rate application.  Shared verbatim by the kernel and the
+    unrolled fallback - identical graph -> identical contraction ->
+    identical bits."""
+    quad = lam_ref[0:1, :] * m_ref[0:1, :]
+    dot2 = lam_ref[0:1, :] * eyt_ref[0:1, :]
+    for j in range(1, K):
+        quad = quad + lam_ref[j:j + 1, :] * m_ref[j:j + 1, :]
+        dot2 = dot2 + lam_ref[j:j + 1, :] * eyt_ref[j:j + 1, :]
+    # the cancellation clamp: in exact arithmetic SSE >= 0; in f32 the
+    # two O(yty)-sized subtrahends can overshoot by rounding on
+    # near-perfectly-fit features, and a negative SSE would flip the
+    # Gamma rate's sign
+    sse = jnp.maximum(yty_ref[0:1, :] - 2.0 * dot2 + quad, 0.0)
+    return g_ref[0:1, :] / (bs + 0.5 * sse), sse
+
+
+def _sse_ps_kernel(lam_ref, m_ref, eyt_ref, yty_ref, g_ref,
+                   ps_ref, sse_ref, *, K: int, bs: float):
+    ps, sse = _lane_sse_ps(lam_ref, m_ref, eyt_ref, yty_ref, g_ref, K, bs)
+    ps_ref[0:1, :] = ps
+    sse_ref[0:1, :] = sse
+
+
+def _pad_batch(arrs):
+    """Pad the batch axis to a _TILE_B multiple with zeros: padded lanes
+    compute sse = 0, ps = 0/bs - finite garbage, sliced out after."""
+    P = arrs[0].shape[0]
+    n_tiles = max((P + _TILE_B - 1) // _TILE_B, 1)
+    Pp = n_tiles * _TILE_B
+    if Pp == P:
+        return n_tiles, Pp, arrs
+    pad = Pp - P
+    return n_tiles, Pp, [
+        jnp.concatenate(
+            [a, jnp.zeros((pad,) + a.shape[1:], a.dtype)], axis=0)
+        for a in arrs]
+
+
+@functools.partial(jax.jit, static_argnames=("bs",))
+def _sse_ps_plain_jit(Lam, M, EYt, yty, gunit, bs):
+    quad = jnp.sum(Lam * M, axis=-1)
+    dot2 = jnp.sum(Lam * EYt, axis=-1)
+    sse = jnp.maximum(yty - 2.0 * dot2 + quad, 0.0)
+    return gunit / (bs + 0.5 * sse), sse
+
+
+@functools.partial(jax.jit, static_argnames=("bs",))
+def _sse_ps_unrolled_jit(Lam, M, EYt, yty, gunit, bs):
+    from jax import lax
+
+    P, K = Lam.shape
+    n_tiles, Pp, (Lp, Mp, Ep, yp, gp) = _pad_batch(
+        [Lam, M, EYt, yty[:, None], gunit[:, None]])
+    Lt, Mt, Et, yt, gt = Lp.T, Mp.T, Ep.T, yp.T, gp.T
+
+    # one scan step per grid tile, on the same (K / 1, _TILE_B) slices the
+    # pallas BlockSpecs deliver - see the module docstring on why the
+    # loop wrapper (not just the shared helper) is what makes this
+    # bitwise vs "pallas-interpret"
+    def tile(_, i):
+        sl = (0, i * _TILE_B)
+        args = (lax.dynamic_slice(Lt, sl, (K, _TILE_B)),
+                lax.dynamic_slice(Mt, sl, (K, _TILE_B)),
+                lax.dynamic_slice(Et, sl, (K, _TILE_B)),
+                lax.dynamic_slice(yt, sl, (1, _TILE_B)),
+                lax.dynamic_slice(gt, sl, (1, _TILE_B)))
+        return _, _lane_sse_ps(*args, K, bs)
+
+    _, (ps, sse) = lax.scan(tile, 0, jnp.arange(n_tiles))
+    return (jnp.swapaxes(ps, 0, 1).reshape(Pp)[:P],
+            jnp.swapaxes(sse, 0, 1).reshape(Pp)[:P])
+
+
+@functools.partial(jax.jit, static_argnames=("bs", "interpret"))
+def _sse_ps_pallas_jit(Lam, M, EYt, yty, gunit, bs, interpret):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    P, K = Lam.shape
+    dtype = Lam.dtype
+    n_tiles, Pp, (Lp, Mp, Ep, yp, gp) = _pad_batch(
+        [Lam, M, EYt, yty[:, None], gunit[:, None]])
+    mat_spec = pl.BlockSpec((K, _TILE_B), lambda i: (0, i),
+                            memory_space=pltpu.VMEM)
+    row_spec = pl.BlockSpec((1, _TILE_B), lambda i: (0, i),
+                            memory_space=pltpu.VMEM)
+    ps, sse = pl.pallas_call(
+        functools.partial(_sse_ps_kernel, K=K, bs=bs),
+        grid=(n_tiles,),
+        in_specs=[mat_spec] * 3 + [row_spec] * 2,
+        out_specs=[row_spec, row_spec],
+        out_shape=[jax.ShapeDtypeStruct((1, Pp), dtype),
+                   jax.ShapeDtypeStruct((1, Pp), dtype)],
+        interpret=interpret,
+    )(Lp.T, Mp.T, Ep.T, yp.T, gp.T)
+    return ps[0, :P], sse[0, :P]

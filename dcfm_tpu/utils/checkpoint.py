@@ -1155,6 +1155,15 @@ def checkpoint_compatible(
                 f"{saved.backend.compute_dtype!r}, resume requests "
                 f"{cfg.backend.compute_dtype!r} (one accumulated "
                 "posterior must come from one sweep precision)")
+    # backend.sse_mode is DELIBERATELY not compared: the carry layout is
+    # unchanged and both psi strategies draw from the identical
+    # conditional law (the Gram identity and the Exp-sum Gamma are exact
+    # - only the floating-point path and the RNG stream differ, inside
+    # the per-draw MC noise), so a donor with a mismatched sse_mode is
+    # adopted rather than refused.  The meta still records the mode the
+    # donor ran (config.backend.sse_mode round-trips through
+    # _config_to_json) and fit_start records what the resume runs -
+    # tests/test_sse_gram.py exercises the flip both ways.
     if meta["fingerprint"] != fingerprint:
         return "data fingerprint mismatch - resuming on different data"
     return None

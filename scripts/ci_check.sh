@@ -77,13 +77,16 @@ JAX_PLATFORMS=cpu python -m dcfm_tpu.analysis --trace \
 # path and the batched K x K pallas-interpret kernel compile programs
 # no other file traces - an XLA/pallas native-level abort there must
 # fail ONE file with its signal named, not take down the suite.
+# test_sse_gram.py rides the lane for the same reason: the gram-mode
+# sweep and the fused SSE+Gamma-rate pallas-interpret kernel
+# (ops/sse_gamma) compile programs no other file traces.
 echo "== serve + chaos tests incl. crash-fuzz smoke (crash-isolated lane) =="
 for f in tests/test_serve_artifact.py tests/test_serve_engine.py \
          tests/test_serve_server.py tests/test_serve_fleet.py \
          tests/test_resilience.py tests/test_online.py \
          tests/test_runtime_stream.py tests/test_obs.py \
          tests/test_chains_mesh.py tests/test_sparse_ingest.py \
-         tests/test_precision.py; do
+         tests/test_precision.py tests/test_sse_gram.py; do
     JAX_PLATFORMS=cpu python -m dcfm_tpu.analysis.isolate "$f" \
         -- -q -m 'not slow' --continue-on-collection-errors \
         -p no:cacheprovider -p no:xdist -p no:randomly || exit 1

@@ -1,0 +1,386 @@
+"""Gram-based SSE psi path (BackendConfig.sse_mode).
+
+Pins the whole sse_mode contract of the fused sweep:
+
+* the "resid" default is INERT - a config that never mentions sse_mode
+  traces the identical sweep jaxpr and fits bitwise-identically to an
+  explicit "resid" request (the knob is guarded at trace time, so the
+  default compiles the pre-knob program);
+* "gram" replaces the (n, P) residual SSE with the Gram identity
+  SSE_j = Y_j'Y_j - 2 Lam_j'(EY)_j + Lam_j' E Lam_j on the Lambda
+  stage's cross-moments, within a pinned f32 error band of the residual
+  formula (the cancellation is real but bounded), and the gram fit
+  lands inside the measured cross-seed MC spread of resid f32 fits;
+* under MGP adaptive truncation the masked Gram SSE is EXACTLY the
+  truncated one - inactive columns contribute literal zeros to both
+  contractions - so rank adaptation and sse_mode="gram" compose;
+* the fused per-feature kernel (ops/sse_gamma) is BITWISE-identical to
+  its scan-tiled fallback where the kernel exists (K <= 16) and
+  numerically correct at every K;
+* sse_mode rides checkpoints as metadata only: the carry layout is mode
+  independent, so a resume may flip the mode freely (unlike
+  compute_dtype) and the donor's mode stays in the meta record;
+* the rejection-free Exp-sum Gamma draw (ops/gamma.gamma_unit_static)
+  has the right moments at the integer / half-integer shapes the psi
+  stage uses.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tests.conftest import make_synthetic
+
+from dcfm_tpu import BackendConfig, FitConfig, ModelConfig, RunConfig, fit
+from dcfm_tpu.models.conditionals import resolve_sse_mode
+from dcfm_tpu.ops.gamma import gamma_unit_static
+from dcfm_tpu.ops.sse_gamma import gram_sse_ps
+
+
+def _cfg(sse_mode=None, *, seed=0, chunk=0, **kw):
+    backend = BackendConfig() if sse_mode is None else BackendConfig(
+        sse_mode=sse_mode)
+    return FitConfig(
+        model=ModelConfig(num_shards=2, factors_per_shard=3, rho=0.8),
+        run=RunConfig(burnin=16, mcmc=16, thin=2, seed=seed,
+                      chunk_size=chunk),
+        backend=backend, **kw)
+
+
+@pytest.fixture(scope="module")
+def data():
+    Y, St = make_synthetic(n=40, p=24, k_true=3, seed=7)
+    return Y, St
+
+
+# ---------------------------------------------------------------------------
+# resid default is inert
+# ---------------------------------------------------------------------------
+
+def test_resid_default_bitwise_identical(data):
+    """The knob's default must change NOTHING: a config that never
+    mentions sse_mode and one that asks for "resid" explicitly are the
+    same program - Sigma, traces, and final state bitwise equal."""
+    Y, _ = data
+    res_default = fit(Y, _cfg(None))
+    res_resid = fit(Y, _cfg("resid"))
+    np.testing.assert_array_equal(res_default.Sigma, res_resid.Sigma)
+    np.testing.assert_array_equal(res_default.traces, res_resid.traces)
+    np.testing.assert_array_equal(np.asarray(res_default.state.ps),
+                                  np.asarray(res_resid.state.ps))
+
+
+def _sweep_jaxpr(sse_mode, *, n=8, K=3, default=False):
+    from dcfm_tpu.models.conditionals import gibbs_sweep
+    from dcfm_tpu.models.priors import make_prior
+    from dcfm_tpu.models.state import init_state
+
+    kw = {} if default else {"sse_mode": sse_mode}
+    cfg = ModelConfig(num_shards=2, factors_per_shard=K, rho=0.8, **kw)
+    prior = make_prior(cfg)
+    key = jax.random.key(0)
+    state = init_state(key, prior, num_local_shards=2, n=n, P=6, K=K,
+                       as_=cfg.as_, bs=cfg.bs)
+    Y = jnp.zeros((2, n, 6), jnp.float32)
+    return str(jax.make_jaxpr(
+        lambda k, y, s: gibbs_sweep(k, y, s, cfg, prior))(key, Y, state))
+
+
+def test_sweep_jaxpr_pins():
+    """Graph-level pin of "the default compiles the pre-knob program":
+    the no-knob jaxpr is byte-identical to the explicit-resid one, the
+    gram jaxpr is a genuinely different program, "auto" resolves to gram
+    at trace time when n >= K, and the gram f32 graph stays bf16-free
+    (the Gram moments don't smuggle in reduced precision)."""
+    jp_default = _sweep_jaxpr(None, default=True)
+    jp_resid = _sweep_jaxpr("resid")
+    jp_gram = _sweep_jaxpr("gram")
+    assert jp_default == jp_resid
+    assert jp_gram != jp_resid
+    assert _sweep_jaxpr("auto", n=8, K=3) == jp_gram      # n >= K
+    # n < K: auto falls back to resid (same-shape jaxprs compared)
+    assert _sweep_jaxpr("auto", n=2, K=3) == _sweep_jaxpr("resid", n=2,
+                                                          K=3)
+    assert "bf16" not in jp_gram
+
+
+def test_resolve_sse_mode():
+    assert resolve_sse_mode("resid", n=1000, K=2) == "resid"
+    assert resolve_sse_mode("gram", n=2, K=1000) == "gram"
+    assert resolve_sse_mode("auto", n=16, K=16) == "gram"
+    assert resolve_sse_mode("auto", n=15, K=16) == "resid"
+
+
+def test_unknown_sse_mode_refused():
+    """A typo'd mode is a typed refusal at validate time, on BOTH the
+    user knob and the internal ModelConfig mirror."""
+    from dcfm_tpu.config import validate
+
+    bad_backend = dataclasses.replace(
+        _cfg(None), backend=BackendConfig(sse_mode="cholesky"))
+    with pytest.raises(ValueError, match="sse_mode"):
+        validate(bad_backend, 40, 24)
+    bad_model = dataclasses.replace(
+        _cfg(None), model=ModelConfig(num_shards=2, factors_per_shard=3,
+                                      rho=0.8, sse_mode="cholesky"))
+    with pytest.raises(ValueError, match="sse_mode"):
+        validate(bad_model, 40, 24)
+
+
+# ---------------------------------------------------------------------------
+# gram == resid up to a pinned f32 cancellation band
+# ---------------------------------------------------------------------------
+
+def _sse_problem(n, P, K, seed, noise=0.3):
+    """Realistic operands: Y generated BY the factor model, so the SSE
+    genuinely cancels (the Gram subtrahends are O(yty))."""
+    r = np.random.default_rng(seed)
+    eta = r.standard_normal((n, K)).astype(np.float32)
+    Lam = (r.standard_normal((P, K)) / np.sqrt(K)).astype(np.float32)
+    Y = (eta @ Lam.T
+         + noise * r.standard_normal((n, P))).astype(np.float32)
+    return jnp.asarray(Y), jnp.asarray(eta), jnp.asarray(Lam)
+
+
+def _gram_operands(Y, eta, Lam):
+    E = eta.T @ eta
+    EY = eta.T @ Y
+    return Lam @ E, EY.T, jnp.sum(Y * Y, axis=0)
+
+
+@pytest.mark.parametrize("n,K", [(200, 16), (200, 128)])
+def test_gram_sse_matches_resid_within_band(n, K):
+    """The accuracy contract, pinned at the shipped error band: max
+    relative gap between the Gram and residual SSE stays under 1e-4 in
+    f32 (measured ~7e-6 at K=16 and ~2e-5 at K=128 on model-generated
+    data; the bound leaves margin, not slack for a broken formula)."""
+    Y, eta, Lam = _sse_problem(n, 300, K, seed=K)
+    resid = Y - eta @ Lam.T
+    sse_resid = np.asarray(jnp.sum(resid * resid, axis=0))
+    M, EYt, yty = _gram_operands(Y, eta, Lam)
+    gunit = jnp.ones((300,), jnp.float32)
+    _, sse_gram = gram_sse_ps(Lam, M, EYt, yty, gunit, bs=0.3)
+    rel = np.abs(np.asarray(sse_gram) - sse_resid) / np.maximum(
+        sse_resid, 1e-9)
+    assert rel.max() < 1e-4, f"max rel SSE gap {rel.max():.2e}"
+
+
+def test_gram_fit_inside_resid_mc_band():
+    """Run the SAME fit under several resid f32 seeds to measure the MC
+    spread of rel-Frobenius error, then demand the gram fit land inside
+    that band (widened by half its width for finite-sample slack): the
+    two SSE strategies are statistically exchangeable, so the mode flip
+    may move a fit within MC noise, never outside it."""
+    Y, St = make_synthetic(n=120, p=48, k_true=3, seed=11)
+    norm = np.linalg.norm(St)
+
+    def run(sse_mode, seed):
+        cfg = FitConfig(
+            model=ModelConfig(num_shards=2, factors_per_shard=3, rho=0.8),
+            run=RunConfig(burnin=150, mcmc=150, thin=1, seed=seed),
+            backend=BackendConfig(sse_mode=sse_mode))
+        return float(np.linalg.norm(fit(Y, cfg).Sigma - St) / norm)
+
+    resid_errs = np.array([run("resid", s) for s in range(4)])
+    gram_err = run("gram", 0)
+    width = max(resid_errs.max() - resid_errs.min(), 1e-3)
+    lo = resid_errs.min() - 0.5 * width
+    hi = resid_errs.max() + 0.5 * width
+    assert lo <= gram_err <= hi, (
+        f"gram err {gram_err:.4f} outside resid MC band "
+        f"[{lo:.4f}, {hi:.4f}] (resid samples {np.round(resid_errs, 4)})")
+
+
+# ---------------------------------------------------------------------------
+# MGP truncation: masked Gram SSE == truncated Gram SSE exactly
+# ---------------------------------------------------------------------------
+
+def test_masked_gram_sse_equals_truncated_exactly():
+    """Adaptive truncation zeroes inactive Lambda columns (and masks
+    eta's); every masked entry of E/EY then meets a zero factor in both
+    length-K contractions, contributing literal float zeros - so the
+    K-wide masked Gram SSE must equal the k_active-wide one BITWISE, not
+    just approximately."""
+    K, k_act = 8, 5
+    Y, eta, Lam = _sse_problem(60, 96, K, seed=3)
+    active = jnp.asarray((np.arange(K) < k_act).astype(np.float32))
+    Lam_m = Lam * active[None, :]
+    eta_m = eta * active[None, :]
+    M, EYt, yty = _gram_operands(Y, eta_m, Lam_m)
+    gunit = jnp.full((96,), 2.0, jnp.float32)
+    ps_full, sse_full = gram_sse_ps(Lam_m, M, EYt, yty, gunit, bs=0.3,
+                                    impl="plain")
+    Mt, EYtt, _ = _gram_operands(Y, eta_m[:, :k_act], Lam_m[:, :k_act])
+    ps_trunc, sse_trunc = gram_sse_ps(Lam_m[:, :k_act], Mt, EYtt, yty,
+                                      gunit, bs=0.3, impl="plain")
+    np.testing.assert_array_equal(np.asarray(sse_full),
+                                  np.asarray(sse_trunc))
+    np.testing.assert_array_equal(np.asarray(ps_full),
+                                  np.asarray(ps_trunc))
+
+
+def test_rank_adapt_gram_fit_runs():
+    """sse_mode="gram" composes with MGP rank adaptation end to end: the
+    adaptive fit runs and returns a finite posterior in the same
+    accuracy class as the resid one."""
+    Y, St = make_synthetic(n=60, p=24, k_true=2, seed=5)
+    model = ModelConfig(num_shards=2, factors_per_shard=4, rho=0.8,
+                        rank_adapt=True)
+    run = RunConfig(burnin=40, mcmc=40, thin=2, seed=0)
+
+    def err(sse_mode):
+        cfg = FitConfig(model=model, run=run,
+                        backend=BackendConfig(sse_mode=sse_mode))
+        r = fit(Y, cfg)
+        assert np.all(np.isfinite(r.Sigma))
+        return float(np.linalg.norm(r.Sigma - St) / np.linalg.norm(St))
+
+    e_gram, e_resid = err("gram"), err("resid")
+    assert abs(e_gram - e_resid) < 0.5 * max(e_resid, 0.1)
+
+
+# ---------------------------------------------------------------------------
+# fused kernel: bitwise vs fallback, correct at every K
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("K", [4, 8, 16])
+def test_kernel_bitwise_vs_fallback(K):
+    """Where the fused kernel exists (K <= 16) the scan-tiled fallback
+    must be BITWISE equal to pallas-interpret - it executes the kernel's
+    own lane helper on the same tile slices, so they share every FMA
+    contraction decision (see the ops/sse_gamma docstring on why the
+    scan wrapper, not just the shared helper, is what pins this)."""
+    r = np.random.default_rng(K)
+    B = 700                                    # forces a padded tile
+    Y, eta, Lam = _sse_problem(50, B, K, seed=K)
+    M, EYt, yty = _gram_operands(Y, eta, Lam)
+    gunit = jnp.asarray(r.gamma(5.0, size=B).astype(np.float32))
+    ps_i, sse_i = gram_sse_ps(Lam, M, EYt, yty, gunit, bs=0.3,
+                              impl="pallas-interpret")
+    ps_u, sse_u = gram_sse_ps(Lam, M, EYt, yty, gunit, bs=0.3,
+                              impl="unrolled")
+    np.testing.assert_array_equal(np.asarray(ps_i), np.asarray(ps_u))
+    np.testing.assert_array_equal(np.asarray(sse_i), np.asarray(sse_u))
+
+
+@pytest.mark.parametrize("impl", ["plain", "unrolled", "auto"])
+def test_kernel_correct_vs_reference(impl):
+    """Every dispatch computes the documented formulas to f32 accuracy
+    against a float64 reference (K = 128 exercises the K > 16 fallback
+    of the non-plain impls)."""
+    K = 12 if impl != "plain" else 128
+    Y, eta, Lam = _sse_problem(40, 500, K, seed=1)
+    M, EYt, yty = _gram_operands(Y, eta, Lam)
+    r = np.random.default_rng(0)
+    gunit = jnp.asarray(r.gamma(5.0, size=500).astype(np.float32))
+    ps, sse = gram_sse_ps(Lam, M, EYt, yty, gunit, bs=0.3, impl=impl)
+    L64, M64 = np.asarray(Lam, np.float64), np.asarray(M, np.float64)
+    ref_sse = np.maximum(
+        np.asarray(yty, np.float64)
+        - 2.0 * np.sum(L64 * np.asarray(EYt, np.float64), axis=1)
+        + np.sum(L64 * M64, axis=1), 0.0)
+    ref_ps = np.asarray(gunit, np.float64) / (0.3 + 0.5 * ref_sse)
+    np.testing.assert_allclose(np.asarray(sse), ref_sse,
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(ps), ref_ps,
+                               rtol=1e-4, atol=1e-6)
+
+
+def test_kernel_unknown_impl_raises():
+    Y, eta, Lam = _sse_problem(10, 8, 4, seed=0)
+    M, EYt, yty = _gram_operands(Y, eta, Lam)
+    with pytest.raises(ValueError, match="impl"):
+        gram_sse_ps(Lam, M, EYt, yty, jnp.ones((8,)), bs=0.3, impl="cuda")
+
+
+# ---------------------------------------------------------------------------
+# rejection-free Gamma draw: right moments at the psi-stage shapes
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("a", [3.0, 21.5, 101.0])
+def test_gamma_unit_static_moments(a):
+    """The Exp-sum construction (+ half chi-square for half-integer
+    shapes) must reproduce Gamma(a, 1) mean AND variance - both equal a
+    - within 5 standard errors at the integer/half-integer shapes the
+    psi stage uses (a = as_ + n/2)."""
+    N = 40_000
+    g = np.asarray(gamma_unit_static(jax.random.key(int(a)), a, (N,)))
+    assert np.all(g > 0)
+    se_mean = np.sqrt(a / N)
+    assert abs(g.mean() - a) < 5 * se_mean, (g.mean(), a)
+    # Var[Gamma(a,1)] = a; SE of the sample variance ~ sqrt(2/N)*a
+    assert abs(g.var() - a) < 5 * np.sqrt(2.0 / N) * (a + 1), (g.var(), a)
+
+
+def test_gamma_unit_static_fractional_falls_back():
+    """Non-half-integer shapes can't use the Exp-sum construction; the
+    draw must still be a valid Gamma(a, 1) via the rejection sampler."""
+    a, N = 2.3, 40_000
+    g = np.asarray(gamma_unit_static(jax.random.key(1), a, (N,)))
+    assert np.all(g > 0)
+    assert abs(g.mean() - a) < 5 * np.sqrt(a / N)
+
+
+# ---------------------------------------------------------------------------
+# checkpoints: sse_mode is metadata, not identity - resumes flip freely
+# ---------------------------------------------------------------------------
+
+def test_gram_checkpoint_roundtrip_and_mode_flip(tmp_path, data):
+    """A gram fit records sse_mode in the checkpoint meta; resuming the
+    finished run is a no-op returning the identical posterior; and a
+    resume that FLIPS the mode is adopted, not refused - the carry
+    layout is mode-independent and both strategies sample the same
+    conditional (contrast compute_dtype, which refuses)."""
+    import json
+
+    Y, _ = data
+    ck = str(tmp_path / "ck.npz")
+    cfg = _cfg("gram", chunk=8, checkpoint_path=ck)
+    res = fit(Y, cfg)
+    with np.load(ck) as z:
+        meta = json.loads(bytes(z["__meta__"]).decode())
+    assert meta["config"]["backend"]["sse_mode"] == "gram"
+    res2 = fit(Y, dataclasses.replace(cfg, resume=True))
+    np.testing.assert_array_equal(res.Sigma, res2.Sigma)
+    # the flip: a finished gram donor resumed under resid is adopted
+    res3 = fit(Y, dataclasses.replace(_cfg("resid", chunk=8,
+                                           checkpoint_path=ck),
+                                      resume=True))
+    np.testing.assert_array_equal(res.Sigma, res3.Sigma)
+
+
+def test_midrun_resume_across_mode_flip(tmp_path, monkeypatch, data):
+    """A gram chain killed mid-run and resumed under resid FINISHES the
+    schedule: the adopted mode governs the remaining chunks and the
+    result stays finite (the exchangeability contract makes this legal,
+    the mode-independent carry layout makes it mechanical)."""
+    import dcfm_tpu.runtime.pipeline as pipeline
+    from tests.test_checkpoint import Killed, _SyncWriter
+
+    Y, _ = data
+    ck = str(tmp_path / "ck.npz")
+    cfg = dataclasses.replace(_cfg("gram", chunk=8, checkpoint_path=ck),
+                              checkpoint_every_chunks=1)
+    monkeypatch.setattr(pipeline, "AsyncCheckpointWriter", _SyncWriter)
+    real_save = pipeline.save_checkpoint
+    calls = {"n": 0}
+
+    def killing_save(*args, **kwargs):
+        real_save(*args, **kwargs)
+        calls["n"] += 1
+        if calls["n"] == 2:
+            raise Killed("simulated crash mid-chain")
+
+    monkeypatch.setattr(pipeline, "save_checkpoint", killing_save)
+    with pytest.raises(Killed):
+        fit(Y, cfg)
+    monkeypatch.setattr(pipeline, "save_checkpoint", real_save)
+
+    res = fit(Y, dataclasses.replace(_cfg("resid", chunk=8,
+                                          checkpoint_path=ck),
+                                     checkpoint_every_chunks=1,
+                                     resume=True))
+    assert np.all(np.isfinite(res.Sigma))
