@@ -219,6 +219,18 @@ REFIT_SHARD_W = int(os.environ.get("BENCH_REFIT_SHARD_W", 12))
 REFIT_BURNIN = int(os.environ.get("BENCH_REFIT_BURNIN", 240))
 REFIT_MCMC = int(os.environ.get("BENCH_REFIT_MCMC", 120))
 
+# Delta-promotion probe shape (serve/delta): synthetic base + a
+# partial-variant candidate with ~1/3 of the panels perturbed, because
+# a REAL warm refit cannot drive the gated ratio: api.py's warm-start
+# relineage (fold_in(k_chain, relineage)) re-keys every chain on
+# purpose, so after any refit essentially EVERY panel differs byte-wise
+# and delta_bytes ~ full_bytes measures RNG lineage, not the delta
+# machinery.  The refit probe's generation-2 cycle still ships a real
+# delta and its honest (ungated) stats ride along in delta_refit.
+DELTA_P = int(os.environ.get("BENCH_DELTA_P", 192))
+DELTA_G = int(os.environ.get("BENCH_DELTA_G", 8))
+DELTA_FRAC = float(os.environ.get("BENCH_DELTA_FRAC", 1 / 3))
+
 
 def _refit_probe():
     """Online-loop probe (dcfm_tpu/online): run the real cycle machinery
@@ -326,7 +338,97 @@ def _refit_probe():
                                "- the warm/cold ratio would be a lie")
         return {"refit_warm_s": r2.refit_s, "refit_cold_s": refit_cold_s,
                 "warm_cold_ratio": r2.refit_s / max(refit_cold_s, 1e-9),
-                "data_to_serving_s": data_to_serving_s}
+                "data_to_serving_s": data_to_serving_s,
+                # generation 2 rode the delta pipeline (a serving base
+                # existed): the REAL panels-changed / bytes-shipped
+                # stats, recorded ungated - the warm-start relineage
+                # perturbs ~every panel, see the DELTA_* knob comment
+                "delta": r2.delta}
+
+
+def _delta_probe():
+    """Delta-promotion probe (serve/delta, no jax): synthetic serving
+    base -> partial-variant candidate (DELTA_FRAC of the panels
+    perturbed) -> delta export -> materialize -> ``promote_delta`` onto
+    a live promotion root, three seeded rounds, median judged.  The
+    gated claim is the subsystem's reason to exist: shipping a
+    generation whose change is localized must move fewer bytes than
+    shipping the full artifact (delta_bytes < full_bytes)."""
+    from dcfm_tpu.serve.artifact import (
+        artifact_fingerprint, panel_crc32, write_artifact, META_FILE,
+        MEAN_PANELS_FILE, SD_PANELS_FILE)
+    from dcfm_tpu.serve.delta import DeltaArtifact, write_delta_artifact
+    from dcfm_tpu.serve.promote import (promote_artifact, promote_delta,
+                                        read_pointer)
+    from dcfm_tpu.utils.preprocess import preprocess
+
+    def _base(path, rng):
+        Y = rng.standard_normal((40, DELTA_P)).astype(np.float32)
+        pre = preprocess(Y, DELTA_G)
+        n_pairs = DELTA_G * (DELTA_G + 1) // 2
+        P = pre.shard_size
+        q = rng.integers(-127, 128, (n_pairs, P, P)).astype(np.int8)
+        sd = rng.integers(1, 128, (n_pairs, P, P)).astype(np.int8)
+        return write_artifact(
+            path, mean_q8=q, pre=pre,
+            mean_scale=rng.uniform(0.5, 1.5, n_pairs).astype(np.float32),
+            sd_q8=sd,
+            sd_scale=rng.uniform(0.5, 1.5, n_pairs).astype(np.float32))
+
+    def _variant(src, dst, rng):
+        # copy + perturb DELTA_FRAC of the pairs (both kinds), then
+        # re-record CRCs/fingerprint - a candidate whose change is
+        # honestly localized, unlike a relineaged refit's
+        import shutil as _sh
+        _sh.copytree(src, dst)
+        with open(os.path.join(dst, META_FILE), encoding="utf-8") as f:
+            meta = json.load(f)
+        n_pairs, P = meta["g"] * (meta["g"] + 1) // 2, meta["P"]
+        touched = rng.choice(n_pairs, max(1, int(n_pairs * DELTA_FRAC)),
+                             replace=False)
+        for fname, kind in ((MEAN_PANELS_FILE, "mean"),
+                            (SD_PANELS_FILE, "sd")):
+            q = np.memmap(os.path.join(dst, fname), dtype=np.int8,
+                          mode="r+", shape=(n_pairs, P, P))
+            for pair in touched:
+                q[pair] ^= 0x55
+            q.flush()
+            meta["panel_crc"][kind] = [int(panel_crc32(np.asarray(pnl)))
+                                       for pnl in q]
+        meta["fingerprint"] = artifact_fingerprint(meta)
+        with open(os.path.join(dst, META_FILE), "w",
+                  encoding="utf-8") as f:
+            json.dump(meta, f)
+        return dst
+
+    rounds = []
+    for seed in range(3):
+        rng = np.random.default_rng(100 + seed)
+        with tempfile.TemporaryDirectory() as td:
+            root = os.path.join(td, "root")
+            os.makedirs(root)
+            base = _base(os.path.join(root, "v1"), rng)
+            promote_artifact(root, "v1")
+            _variant(base.path, os.path.join(td, "cand"), rng)
+            t = time.perf_counter()
+            d = write_delta_artifact(os.path.join(td, "cand"), base,
+                                     os.path.join(root, "v2.delta"))
+            st = promote_delta(root, "v2.delta", candidate="v2")
+            wall = time.perf_counter() - t
+            assert st.generation == 2 and read_pointer(root).target == "v2"
+            d = DeltaArtifact.open(d.path)
+            n_pairs = DELTA_G * (DELTA_G + 1) // 2
+            rounds.append({
+                "delta_bytes": d.bytes_shipped,
+                "full_bytes": d.full_bytes,
+                "panels_changed_frac": d.panels_changed / (2 * n_pairs),
+                "export_promote_s": wall})
+    med = lambda k: float(np.median([r[k] for r in rounds]))
+    return {"delta_bytes": int(med("delta_bytes")),
+            "full_bytes": int(med("full_bytes")),
+            "panels_changed_frac": round(med("panels_changed_frac"), 4),
+            "export_promote_s": round(med("export_promote_s"), 4),
+            "rounds": rounds}
 
 
 def _pack_probe():
@@ -829,6 +931,13 @@ def main():
     # served latency), one round at the small probe shape.
     refit = _refit_probe()
 
+    # Delta-promotion probe (serve/delta, host CPU only): three seeded
+    # rounds of synthetic base -> partial-variant candidate -> delta
+    # export -> promote_delta, median judged; the refit probe's real
+    # generation-2 delta stats ride along ungated (relineage - see the
+    # DELTA_* knobs).
+    delta = _delta_probe()
+
     # Ingest-phase probe (scale-out ingestion): streaming sparse vs dense
     # preprocess of the same logical ~1%-density matrix, one subprocess
     # each for clean ru_maxrss high-water marks.  Host CPU only.
@@ -1007,6 +1116,17 @@ def main():
         "refit_cold_s": round(refit["refit_cold_s"], 2),
         "warm_cold_ratio": round(refit["warm_cold_ratio"], 4),
         "data_to_serving_s": round(refit["data_to_serving_s"], 2),
+        # Delta-promotion phase (serve/delta): bytes a replica pulls for
+        # a localized generation change vs re-shipping the full
+        # artifact, median-of-3 synthetic rounds (gated below);
+        # delta_refit is the refit probe's REAL generation-2 delta -
+        # honest and ungated, the warm-start relineage perturbs ~every
+        # panel byte-wise by design.
+        "delta_bytes": delta["delta_bytes"],
+        "full_bytes": delta["full_bytes"],
+        "panels_changed_frac": delta["panels_changed_frac"],
+        "delta": delta,
+        "delta_refit": refit["delta"],
         # Ingest phase (null under BENCH_INGEST=0): streaming sparse vs
         # dense preprocess of the same logical matrix, each pipeline's
         # wall + subprocess-clean peak-RSS delta.  ingest_s/ingest_MBps
@@ -1107,6 +1227,22 @@ def main():
               f"{refit['warm_cold_ratio']:.3f} >= 1.0 "
               f"(warm {refit['refit_warm_s']:.2f}s, "
               f"cold {refit['refit_cold_s']:.2f}s)", file=sys.stderr)
+        status = 1
+    # * delta promotion: a delta for a localized change must ship fewer
+    #   bytes than the full artifact - at or above it, the packed-panel
+    #   format (or its meta accounting) stopped paying for itself.
+    #   Gated only at the default probe shape: an env-shrunk shape can
+    #   make the verbatim meta copy legitimately dominate the panel
+    #   bytes.  Judged on the synthetic median-of-3, NOT the refit
+    #   probe's real delta (relineage, see the DELTA_* knobs).
+    default_delta = (DELTA_P, DELTA_G, DELTA_FRAC) == (192, 8, 1 / 3)
+    if default_delta and delta["delta_bytes"] >= delta["full_bytes"]:
+        print(f"DELTA SIZE REGRESSION: median delta_bytes "
+              f"{delta['delta_bytes']} >= full_bytes "
+              f"{delta['full_bytes']} at panels_changed_frac "
+              f"{delta['panels_changed_frac']} - shipping the delta "
+              f"costs as much as re-shipping the artifact",
+              file=sys.stderr)
         status = 1
     if (default_shape and stream.get("snapshots", 0) > 0
             and overlap_med is not None and overlap_med <= 0.5):

@@ -1555,6 +1555,64 @@ def _check_partition_specs(mod: _Module, rep: _Reporter) -> None:
 
 
 # =====================================================================
+# DCFM1901 - promotion-pointer discipline
+# =====================================================================
+
+_POINTER_MUTATORS = {"os.replace", "os.rename", "os.link"}
+_POINTER_CONST = "dcfm_tpu.serve.promote.POINTER_FILE"
+
+
+def _names_pointer(mod: _Module, node: ast.AST) -> bool:
+    """True when any subexpression of ``node`` names the promotion
+    pointer: the literal ``"CURRENT"`` (or a ``"CURRENT."``-prefixed
+    tmp/audit sibling) or a name resolving to
+    ``serve.promote.POINTER_FILE`` through the import aliases."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+            if sub.value == "CURRENT" or sub.value.startswith("CURRENT."):
+                return True
+        elif isinstance(sub, (ast.Name, ast.Attribute)):
+            full = mod.resolve(sub)
+            if full == _POINTER_CONST or full == "POINTER_FILE":
+                return True
+    return False
+
+
+def _check_pointer_mutation(mod: _Module, rep: _Reporter) -> None:
+    """DCFM1901: os.replace/os.rename/os.link targeting a ``CURRENT``
+    promotion pointer outside serve/promote.py.  The pointer
+    compare-and-swap (verify, monotonic generation, atomic replace,
+    audit hardlink, promotion event) lives in exactly one function; a
+    second writer can re-number history or flip the fleet to an
+    unverified artifact without a recorded promotion.  serve/promote.py
+    itself - the CAS's home - is exempt."""
+    parts = str(mod.path).replace("\\", "/").split("/")
+    if parts[-1] == "promote.py" and len(parts) >= 2 \
+            and parts[-2] == "serve":
+        return
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        full = mod.resolve(node.func)
+        if full not in _POINTER_MUTATORS:
+            continue
+        if not any(_names_pointer(mod, a) for a in node.args) and \
+                not any(_names_pointer(mod, k.value)
+                        for k in node.keywords):
+            continue
+        fn = full.rsplit(".", 1)[-1]
+        rep.emit(
+            "DCFM1901", node,
+            f"os.{fn}(...) targets a CURRENT promotion pointer outside "
+            "serve/promote.py - the pointer compare-and-swap (verify, "
+            "monotonic generation, atomic replace, audit hardlink, "
+            "promotion event) lives in exactly one place.  Route the "
+            "move through promote_artifact / promote_delta, or "
+            "annotate a sanctioned exception with "
+            "`# dcfm: ignore[DCFM1901] - <why>`")
+
+
+# =====================================================================
 # DCFM002 - stale suppressions
 # =====================================================================
 
@@ -1621,6 +1679,7 @@ def lint_source(source: str, path: str = "<string>",
     _check_dense_quadratic(mod, rep)
     _check_precision_matmul(mod, rep)
     _check_partition_specs(mod, rep)
+    _check_pointer_mutation(mod, rep)
     _check_stale_pragmas(mod, rep)      # must stay last: reads the ledger
     rep.findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return rep.findings

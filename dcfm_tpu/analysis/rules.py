@@ -268,6 +268,18 @@ RULES = {r.id: r for r in [
          "constructions carry an inline "
          "`# dcfm: ignore[DCFM1701] - <why>`",
          library_only=True),
+    # ---- DCFM19xx: promotion-pointer discipline ----------------------
+    Rule("DCFM1901", "pointer-mutation-outside-promote", "pointer",
+         "an os.replace/os.link call whose target names a CURRENT "
+         "promotion pointer, outside serve/promote.py - the pointer "
+         "compare-and-swap (verify, monotonic generation, atomic "
+         "replace, audit hardlink, promotion event) lives in exactly "
+         "one function; a second writer can re-number history or flip "
+         "the fleet to an unverified artifact without a recorded "
+         "promotion.  Route pointer moves through promote_artifact / "
+         "promote_delta; a sanctioned exception carries an inline "
+         "`# dcfm: ignore[DCFM1901] - <why>`",
+         library_only=True),
 ]}
 
 

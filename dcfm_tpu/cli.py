@@ -158,6 +158,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "shedding with typed 503 + Retry-After")
     s.add_argument("--shed-low", type=float, default=0.50,
                    help="queue fill at which shedding stops (hysteresis)")
+    s.add_argument("--swap-adopt", choices=("auto", "off"), default="auto",
+                   help="hot-swap memmap adoption: 'auto' serves pairs "
+                        "the CRC tables prove unchanged from the OLD "
+                        "epoch's memmaps (re-warm cost scales with "
+                        "changed panels, not p^2), 'off' re-opens every "
+                        "panel from the new artifact")
     s.add_argument("--fleet-backoff", type=float, default=0.5,
                    help="base respawn backoff after an instant worker "
                         "death (doubles per consecutive instant death)")
@@ -192,6 +198,34 @@ def build_parser() -> argparse.ArgumentParser:
     pr.add_argument("--no-verify", action="store_true",
                     help="skip the full per-panel CRC sweep (workers "
                          "still refuse a corrupt candidate at swap time)")
+    pr.add_argument("--delta", action="store_true",
+                    help="CANDIDATE is a delta directory (dcfm-tpu "
+                         "delta): materialize it against the artifact "
+                         "CURRENT names, then promote the byte-identical "
+                         "reconstruction through the same "
+                         "compare-and-swap")
+    pr.add_argument("--expect-generation", type=int, default=None,
+                    help="refuse unless the promotion would write "
+                         "exactly this generation (the online loop's "
+                         "monotonicity gate)")
+
+    d = sub.add_parser(
+        "delta", help="encode a candidate artifact as a per-panel delta "
+        "against a base generation (only changed panel bytes ship; "
+        "maps + meta travel verbatim), or --apply one back into a "
+        "byte-identical full artifact")
+    d.add_argument("candidate", help="candidate artifact directory "
+                   "(with --apply: the delta directory)")
+    d.add_argument("--base", required=True,
+                   help="base artifact directory, or a promotion root "
+                        "(its CURRENT target is used)")
+    d.add_argument("--out", required=True,
+                   help="output directory (the delta; with --apply: the "
+                        "reconstructed full artifact)")
+    d.add_argument("--apply", action="store_true",
+                   help="materialize CANDIDATE (a delta) against --base "
+                        "into a full artifact, CRC-verified "
+                        "byte-identical to the original candidate")
 
     f = sub.add_parser("fit", help="fit the model and write Sigma-hat")
     f.add_argument("data", help="observations, (n, p) .npy or .csv")
@@ -477,12 +511,52 @@ def main(argv=None) -> int:
         from dcfm_tpu.serve.artifact import export_main
         return export_main(args)
     if args.command == "promote":
+        if args.delta:
+            from dcfm_tpu.serve.delta import DeltaArtifact
+            from dcfm_tpu.serve.promote import promote_delta
+            st = promote_delta(args.root, args.candidate,
+                               verify=not args.no_verify,
+                               expect_generation=args.expect_generation)
+            d = DeltaArtifact.open(
+                args.candidate if os.path.isabs(args.candidate)
+                else os.path.join(args.root, args.candidate))
+            print(json.dumps({
+                "promoted": st.target, "generation": st.generation,
+                "fingerprint": st.fingerprint, "delta": True,
+                "panels_changed": d.panels_changed,
+                "bytes_shipped": d.bytes_shipped,
+                "full_bytes": d.full_bytes}), flush=True)
+            return 0
         from dcfm_tpu.serve.promote import promote_artifact
         st = promote_artifact(args.root, args.candidate,
-                              verify=not args.no_verify)
+                              verify=not args.no_verify,
+                              expect_generation=args.expect_generation)
         print(json.dumps({
             "promoted": st.target, "generation": st.generation,
             "fingerprint": st.fingerprint}), flush=True)
+        return 0
+    if args.command == "delta":
+        from dcfm_tpu.serve.artifact import PosteriorArtifact
+        from dcfm_tpu.serve.delta import (materialize_delta,
+                                          write_delta_artifact)
+        from dcfm_tpu.serve.promote import is_pointer_root, read_pointer
+        base_path = args.base
+        if is_pointer_root(base_path):
+            base_path = read_pointer(base_path).path
+        base = PosteriorArtifact.open(base_path)
+        if args.apply:
+            art = materialize_delta(base, args.candidate, args.out)
+            print(json.dumps({
+                "out": args.out, "applied": args.candidate,
+                "fingerprint": art.fingerprint}), flush=True)
+            return 0
+        d = write_delta_artifact(args.candidate, base, args.out)
+        print(json.dumps({
+            "out": args.out, "base_fingerprint": d.base_fingerprint,
+            "candidate_fingerprint": d.candidate_fingerprint,
+            "panels_changed": d.panels_changed,
+            "bytes_shipped": d.bytes_shipped,
+            "full_bytes": d.full_bytes}), flush=True)
         return 0
     from dcfm_tpu.config import (
         BackendConfig, FitConfig, ModelConfig, RunConfig)

@@ -97,6 +97,25 @@ def summarize(run_dir: str, events=None, torn: int = 0) -> dict:
                  "generation": e.get("generation"),
                  "verified": e.get("verified")}
                 for e in by.get("artifact_promote", [])]
+    # delta-promotion events (serve/delta.py): what each generation
+    # actually shipped vs a full artifact, plus recorded fallbacks
+    delta_exports = [{"panels_changed": e.get("panels_changed"),
+                      "panels_total": e.get("panels_total"),
+                      "bytes_shipped": e.get("bytes_shipped"),
+                      "full_bytes": e.get("full_bytes")}
+                     for e in by.get("delta_export", [])]
+    delta_promos = [{"target": e.get("target"),
+                     "generation": e.get("generation"),
+                     "panels_changed": e.get("panels_changed"),
+                     "panels_total": e.get("panels_total"),
+                     "bytes_shipped": e.get("bytes_shipped"),
+                     "full_bytes": e.get("full_bytes"),
+                     "drift": e.get("drift")}
+                    for e in by.get("delta_promote", [])]
+    delta_fallbacks = [{"reason": e.get("reason"),
+                        "kind": e.get("kind"),
+                        "generation": e.get("generation")}
+                       for e in by.get("delta_fallback", [])]
     # online fit->serve loop events (dcfm-tpu watch run dirs)
     detections = [{"kind": e.get("kind"), "n": e.get("n"),
                    "p": e.get("p"),
@@ -151,6 +170,9 @@ def summarize(run_dir: str, events=None, torn: int = 0) -> dict:
                             if e.get("active")]),
         "serve_client_aborts": len(by.get("serve_client_abort", [])),
         "artifact_promotions": promotes,
+        "delta_exports": delta_exports,
+        "delta_promotions": delta_promos,
+        "delta_fallbacks": delta_fallbacks,
         "fleet_poisoned": bool(by.get("fleet_poisoned")),
         "fleet_watchdog_fired": bool(by.get("fleet_watchdog_fired")),
         "fleet_drained": bool(by.get("fleet_drained")),
@@ -232,6 +254,18 @@ def _print_summary(s: dict, out: List[str]) -> None:
             out.append(f"artifact promoted: {pr['target']} -> "
                        f"generation {pr['generation']} "
                        f"(verified={pr['verified']})")
+    if s["delta_promotions"]:
+        out.append(f"delta promotions: {len(s['delta_promotions'])}")
+        for dp in s["delta_promotions"]:
+            out.append(f"  delta promoted: {dp['target']} -> generation "
+                       f"{dp['generation']} "
+                       f"({dp['panels_changed']}/{dp['panels_total']} "
+                       f"panels shipped, {dp['bytes_shipped']} of "
+                       f"{dp['full_bytes']} full bytes, "
+                       f"drift {dp['drift']})")
+    for df in s["delta_fallbacks"]:
+        out.append(f"delta FELL BACK to full promotion (generation "
+                   f"{df['generation']}, {df['kind']}): {df['reason']}")
     if s["serve_swaps"]:
         out.append(f"hot-swaps: {len(s['serve_swaps'])}")
         for sw in s["serve_swaps"]:

@@ -11,6 +11,16 @@ generation serving.  Every stage lands in the flight recorder:
 * ``online_refused`` - a gate said no (stage, reason); the pointer did
   NOT move.
 
+When a generation is already serving, the candidate additionally ships
+as a per-panel DELTA against it (serve/delta.py): the streamed
+candidate is replaced by the delta's byte-identical materialization
+BEFORE the gates run (so CRC and drift validate exactly what a replica
+reconstructs), and gate 3 promotes through ``promote_delta`` - emitting
+``delta_export`` / ``delta_promote`` events that count panels and bytes
+actually shipped.  Any delta-side failure (shape change, missing CRC
+tables, torn delta) records ``delta_fallback`` and promotes the full
+candidate instead - never a refusal loop.
+
 **Detection** is manifest-based: the watched directory holds one
 ``Y.npy`` (the current full data matrix) and the cycle compares its
 ``(n, p, fingerprint)`` against the last promoted manifest.  Rows
@@ -44,6 +54,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import shutil
 import time
 from typing import Callable, Optional
 
@@ -52,9 +63,11 @@ import numpy as np
 from dcfm_tpu.config import (BackendConfig, FitConfig, ModelConfig,
                              RunConfig, WarmStart)
 from dcfm_tpu.obs.recorder import record
-from dcfm_tpu.serve.artifact import ArtifactError
+from dcfm_tpu.serve.artifact import ArtifactError, PosteriorArtifact
+from dcfm_tpu.serve.delta import materialize_delta, write_delta_artifact
 from dcfm_tpu.serve.promote import (PointerError, promote_artifact,
-                                    read_pointer, verify_candidate)
+                                    promote_delta, read_pointer,
+                                    verify_candidate)
 
 DATA_FILE = "Y.npy"
 
@@ -120,6 +133,10 @@ class CycleResult:
     refit_s: float
     cycle_s: float               # detect -> pointer flip wall
     drift: Optional[float]       # rel-Frobenius vs the previous artifact
+    # delta-promotion stats ({"panels_changed", "panels_total",
+    # "bytes_shipped", "full_bytes"}) when this generation shipped as a
+    # per-panel delta against the previous one; None = full promotion
+    delta: Optional[dict] = None
 
 
 def read_manifest(data_dir: str) -> dict:
@@ -253,6 +270,48 @@ def run_cycle(settings: CycleSettings, Y, plan: CyclePlan, *,
     refit_s = time.perf_counter() - t_fit
 
     cand_path = os.path.join(settings.root, plan.candidate)
+    # Delta emission: when a generation is already serving, encode the
+    # candidate as a per-panel delta against it and REPLACE the streamed
+    # candidate with the delta's materialization - byte-identical by
+    # contract, so gates 1 and 2 below validate exactly what a replica
+    # pulling the delta will reconstruct.  ANY failure here (base
+    # missing its CRC tables, shape change across generations, a torn
+    # delta) falls back to the full candidate with a recorded
+    # ``delta_fallback`` - a delta problem must never refuse a cycle
+    # that holds a perfectly good full artifact.
+    delta_name = None
+    delta_stats = None
+    if plan.target_generation > 1:
+        try:
+            base = PosteriorArtifact.open(
+                read_pointer(settings.root).path)
+            d = write_delta_artifact(
+                cand_path, base,
+                os.path.join(settings.root, plan.candidate + ".delta"))
+            mat = cand_path + ".mat"
+            if os.path.exists(mat):
+                shutil.rmtree(mat)
+            materialize_delta(base, d, mat)
+            # same-directory rename dance: the pointer still names the
+            # OLD generation, so every intermediate state is invisible
+            # to the fleet and a crash anywhere re-runs the cycle
+            orig = cand_path + ".orig"
+            if os.path.exists(orig):
+                shutil.rmtree(orig)
+            os.rename(cand_path, orig)
+            os.rename(mat, cand_path)
+            shutil.rmtree(orig)
+            delta_name = plan.candidate + ".delta"
+            delta_stats = {
+                "panels_changed": d.panels_changed,
+                "panels_total": d.n_pairs * (2 if d.has_sd else 1),
+                "bytes_shipped": d.bytes_shipped,
+                "full_bytes": d.full_bytes,
+            }
+        except (ArtifactError, OSError) as e:
+            record("delta_fallback",
+                   reason=f"{type(e).__name__}: {e}", kind=plan.kind,
+                   generation=plan.target_generation)
     # Gate 1 - CRC-clean: a refit killed after its last checkpoint but
     # before the stream finalized leaves a candidate that refuses to
     # open (meta invalidated) or fails a panel CRC.
@@ -270,7 +329,6 @@ def run_cycle(settings: CycleSettings, Y, plan: CyclePlan, *,
         prev = None
     if prev is not None:
         try:
-            from dcfm_tpu.serve.artifact import PosteriorArtifact
             S_prev = PosteriorArtifact.open(prev.path).assemble()
             S_new = art.assemble()
         except (ArtifactError, OSError) as e:
@@ -284,19 +342,30 @@ def run_cycle(settings: CycleSettings, Y, plan: CyclePlan, *,
                     f"{settings.max_drift} over the common "
                     f"{k}x{k} block", plan, obs_dir)
     # Gate 3 - monotonic generation, enforced inside the atomic write.
+    # A delta generation promotes through promote_delta: the SAME
+    # compare-and-swap, plus the delta_promote event that counts what
+    # the fleet will actually pull (the candidate was already
+    # materialized above, so promote_delta adopts it as-is).
     try:
-        state = promote_artifact(settings.root, plan.candidate,
-                                 verify=False,
-                                 expect_generation=plan.target_generation)
+        if delta_name is not None:
+            state = promote_delta(settings.root, delta_name,
+                                  verify=False,
+                                  expect_generation=plan.target_generation,
+                                  candidate=plan.candidate, drift=drift)
+        else:
+            state = promote_artifact(
+                settings.root, plan.candidate, verify=False,
+                expect_generation=plan.target_generation)
     except (ArtifactError, OSError) as e:
         _refuse("promote", str(e), plan, obs_dir)
     cycle_s = time.perf_counter() - t0
     record("online_promote", generation=state.generation,
            target=state.target, fingerprint=state.fingerprint,
            kind=plan.kind, warm=cfg.warm_start is not None,
-           drift=drift, refit_s=refit_s, cycle_s=cycle_s)
+           drift=drift, refit_s=refit_s, cycle_s=cycle_s,
+           delta=delta_name is not None)
     return CycleResult(
         generation=state.generation, artifact=cand_path,
         checkpoint=plan.checkpoint, manifest=plan.manifest,
         warm=cfg.warm_start is not None, refit_s=refit_s,
-        cycle_s=cycle_s, drift=drift)
+        cycle_s=cycle_s, drift=drift, delta=delta_stats)

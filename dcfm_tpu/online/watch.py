@@ -143,10 +143,14 @@ class Watcher:
                           "checkpoint": result.checkpoint,
                           "generation": result.generation})
         self.cycles += 1
+        d = result.delta
         self.log(f"promoted generation {result.generation} "
                  f"({'warm' if result.warm else 'cold'}, "
                  f"refit {result.refit_s:.1f}s, "
-                 f"data-to-serving {result.cycle_s:.1f}s)")
+                 f"data-to-serving {result.cycle_s:.1f}s"
+                 + (f", delta {d['panels_changed']}/{d['panels_total']}"
+                    f" panels, {d['bytes_shipped']}/{d['full_bytes']} B"
+                    if d else ", full artifact") + ")")
         return result
 
     # -- the daemon loop ---------------------------------------------------

@@ -90,6 +90,27 @@ class PanelCache:
                 self._od.move_to_end(key)
         return panel
 
+    def seed(self, key, panel) -> None:
+        """Insert a ready panel WITHOUT touching the hit/miss counters -
+        the adoption path carries dequantized panels across a hot-swap,
+        and a carried panel is neither a hit nor a miss of THIS cache."""
+        with self._lock:
+            if key in self._od:
+                return
+            self._od[key] = panel
+            self._bytes += panel.nbytes
+            while self._bytes > self.budget_bytes and len(self._od) > 1:
+                _, old = self._od.popitem(last=False)
+                self._bytes -= old.nbytes
+                self.evictions += 1
+
+    def snapshot(self) -> list:
+        """One consistent ``[(key, panel), ...]`` view of the resident
+        panels, LRU-coldest first - what a successor engine inspects
+        when adopting across a swap."""
+        with self._lock:
+            return list(self._od.items())
+
     def stats(self) -> dict:
         with self._lock:
             return {"hits": self.hits, "misses": self.misses,
@@ -141,7 +162,8 @@ class QueryEngine:
     """Entry/block/row/SD/interval queries over one opened artifact."""
 
     def __init__(self, artifact: PosteriorArtifact, *,
-                 cache_bytes: int = 256 << 20):
+                 cache_bytes: int = 256 << 20,
+                 adopt_from: "QueryEngine" = None):
         self.artifact = artifact
         self.cache = PanelCache(cache_bytes)
         g, P = artifact.g, artifact.P
@@ -153,6 +175,65 @@ class QueryEngine:
         self._factor = {"mean": artifact.mean_scale / 127.0}
         if artifact.has_sd:
             self._factor["sd"] = artifact.sd_scale / 127.0
+        # memmap adoption across a hot-swap (delta promotions): pairs
+        # whose bytes AND scale are unchanged from the predecessor keep
+        # serving from ITS memmaps - the new generation's panel files
+        # are never paged in for them, so re-warm I/O is proportional to
+        # changed-and-hot, not p^2
+        self._adopt_src = None             # predecessor PosteriorArtifact
+        self._adopted_raw = {}             # kind -> predecessor memmap
+        self._adopted_pairs = {}           # kind -> frozenset of pairs
+        self.panels_adopted = 0            # pairs adopted, summed over kinds
+        self.cache_seeded = 0              # dequantized panels carried over
+        if adopt_from is not None:
+            self._adopt(adopt_from)
+
+    def _adopt(self, old: "QueryEngine") -> None:
+        """Adopt the unchanged half of a predecessor engine.
+
+        Eligibility: same (g, P, has_sd) and complete per-panel CRC
+        tables on BOTH artifacts (the tables identify unchanged panels
+        byte-exactly).  The adoption predicate is stricter than the
+        delta format's shipping predicate: a pair is unchanged only if
+        its panel CRC matches AND its dequant scale is bitwise equal -
+        a scale-only change alters served values without touching panel
+        bytes.  Ineligible pairs (and ineligible swaps) fall through to
+        the new artifact's own memmaps; correctness never depends on
+        adoption, only re-warm cost does."""
+        art, prev = self.artifact, old.artifact
+        if (art.g, art.P, art.has_sd) != (prev.g, prev.P, prev.has_sd):
+            return
+        kinds = ("mean", "sd") if art.has_sd else ("mean",)
+        if not all(k in art.panel_crc and k in prev.panel_crc
+                   for k in kinds):
+            return
+        for kind in kinds:
+            same_crc = art.panel_crc[kind] == prev.panel_crc[kind]
+            new_s = np.asarray(self._factor[kind], np.float32)
+            old_s = np.asarray(old._factor[kind], np.float32)
+            same_scale = (new_s.view(np.int32) == old_s.view(np.int32))
+            pairs = frozenset(
+                int(i) for i in np.flatnonzero(same_crc & same_scale))
+            if not pairs:
+                continue
+            self._adopted_pairs[kind] = pairs
+            self._adopted_raw[kind], _ = prev.panels(kind)
+            self.panels_adopted += len(pairs)
+        if self.panels_adopted:
+            self._adopt_src = prev
+            # carry the predecessor's already-dequantized unchanged
+            # panels: identical bytes * identical scale = identical
+            # float32 panel, so the hot set restarts warm for free
+            for (kind, pair), panel in old.cache.snapshot():
+                if pair in self._adopted_pairs.get(kind, ()):
+                    self.cache.seed((kind, pair), panel)
+                    self.cache_seeded += 1
+
+    def panel_source(self, kind: str, pair: int) -> str:
+        """``"adopted"`` when this (kind, pair) serves from the
+        predecessor generation's memmap, else ``"new"``."""
+        return ("adopted" if pair in self._adopted_pairs.get(kind, ())
+                else "new")
 
     # -- coordinates ---------------------------------------------------
     def shard_index(self, idx) -> np.ndarray:
@@ -176,7 +257,15 @@ class QueryEngine:
         touch - and is re-checked after an eviction - while hot panels
         served from cache pay nothing.  Artifacts without recorded CRCs
         (pre-integrity exports, sparse synthetics) skip the check."""
-        raw, _ = self.artifact.panels(kind)
+        adopted = pair in self._adopted_pairs.get(kind, ())
+        if adopted:
+            # unchanged pair: read the PREDECESSOR generation's memmap
+            # (same bytes, pinned by CRC) - the new panel file stays cold
+            raw = self._adopted_raw[kind]
+            src = self._adopt_src
+        else:
+            raw, _ = self.artifact.panels(kind)
+            src = self.artifact
         factor = self._factor[kind]
 
         def make():
@@ -186,7 +275,7 @@ class QueryEngine:
             plan = fault_plan()
             if plan is not None:
                 plan.on_write("panel", f"{kind}:{pair}")
-            self.artifact.verify_panel(kind, pair)
+            src.verify_panel(kind, pair)
             p = raw[pair].astype(np.float32) * factor[pair]
             if diag:
                 p = 0.5 * (p + p.T)

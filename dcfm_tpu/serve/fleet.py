@@ -125,7 +125,8 @@ class FleetSupervisor:
                 "--io-timeout", str(getattr(a, "io_timeout", 10.0)),
                 "--swap-poll", str(getattr(a, "swap_poll", 0.5)),
                 "--shed-high", str(getattr(a, "shed_high", 0.75)),
-                "--shed-low", str(getattr(a, "shed_low", 0.50))]
+                "--shed-low", str(getattr(a, "shed_low", 0.50)),
+                "--swap-adopt", str(getattr(a, "swap_adopt", "auto"))]
         env = dict(
             os.environ,
             DCFM_OBS_DIR=self.run_dir,

@@ -46,6 +46,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
+import shutil
 from typing import Optional
 
 from dcfm_tpu.obs.recorder import record
@@ -197,3 +198,62 @@ def promote_artifact(root: str, candidate: str, *,
     st = os.stat(ppath)
     return PointerState(name, generation, fingerprint, cand_path,
                         (st.st_mtime_ns, st.st_size, st.st_ino))
+
+
+def promote_delta(root: str, delta: str, *,
+                  verify: bool = True,
+                  expect_generation: Optional[int] = None,
+                  candidate: Optional[str] = None,
+                  drift: Optional[float] = None) -> PointerState:
+    """Materialize a delta against the artifact ``CURRENT`` names, then
+    promote the reconstruction through the SAME compare-and-swap as
+    :func:`promote_artifact` (verification, monotonic generation, atomic
+    pointer write - a refusal at any stage keeps the old artifact
+    serving).
+
+    ``delta`` is a delta directory (name inside the root, or a path);
+    ``candidate`` overrides the materialization target directory name
+    (default: the candidate name recorded in the delta, falling back to
+    ``v<generation>``).  ``drift`` is recorded into the
+    ``delta_promote`` event when the caller (the online loop) measured
+    it.
+
+    The materialization is idempotent across retries: a target that
+    already holds the finished candidate (fingerprint matches) is
+    adopted as-is; a torn or foreign target is rebuilt from base +
+    delta.  A crash mid-materialization therefore needs no cleanup -
+    the retry re-materializes and promotes.
+
+    Raises :class:`PointerError` when the root has no serving base and
+    :class:`~dcfm_tpu.serve.delta.DeltaBaseMismatchError` when the
+    serving artifact is not the delta's base - both are the caller's
+    cue to fall back to a full promotion (this function never has the
+    full candidate to fall back to itself)."""
+    from dcfm_tpu.serve.delta import DeltaArtifact, materialize_delta
+    dpath = delta if os.path.isabs(delta) else os.path.join(root, delta)
+    d = DeltaArtifact.open(dpath)
+    ptr = read_pointer(root)            # PointerError -> no base, fall back
+    base = PosteriorArtifact.open(ptr.path)
+    name = candidate or d.candidate_name or f"v{ptr.generation + 1}"
+    cand_path = os.path.join(root, name)
+    adopted = False
+    if os.path.isdir(cand_path):
+        try:
+            adopted = (PosteriorArtifact.open(cand_path).fingerprint
+                       == d.candidate_fingerprint)
+        except (ArtifactError, OSError):
+            adopted = False             # torn prior attempt: rebuild it
+    if not adopted:
+        if os.path.exists(cand_path):
+            shutil.rmtree(cand_path)
+        materialize_delta(base, d, cand_path)
+    state = promote_artifact(root, name, verify=verify,
+                             expect_generation=expect_generation)
+    record("delta_promote", target=name, generation=state.generation,
+           fingerprint=state.fingerprint,
+           base_fingerprint=d.base_fingerprint,
+           panels_changed=d.panels_changed,
+           panels_total=d.n_pairs * (2 if d.has_sd else 1),
+           bytes_shipped=d.bytes_shipped, full_bytes=d.full_bytes,
+           drift=drift, materialized=not adopted)
+    return state

@@ -79,7 +79,7 @@ from dcfm_tpu.obs import metrics as obs_metrics
 from dcfm_tpu.obs.recorder import record
 from dcfm_tpu.resilience.faults import fault_event
 from dcfm_tpu.serve.artifact import (
-    ArtifactCorruptError, ArtifactError, PosteriorArtifact)
+    MAPS_FILE, ArtifactCorruptError, ArtifactError, PosteriorArtifact)
 from dcfm_tpu.serve.batcher import (
     BatcherClosed, DeadlineExceeded, Overloaded, QueryBatcher)
 from dcfm_tpu.serve.engine import QueryEngine
@@ -286,7 +286,17 @@ class PosteriorServer:
                  max_batch: int = 256, request_timeout: float = 2.0,
                  io_timeout: float = 10.0, reuse_port: bool = False,
                  swap_poll: float = 0.5, shed_high: float = 0.75,
-                 shed_low: float = 0.50, worker_index=None):
+                 shed_low: float = 0.50, worker_index=None,
+                 swap_adopt: str = "auto"):
+        if swap_adopt not in ("auto", "off"):
+            raise ValueError(
+                f"swap_adopt must be 'auto' or 'off', got {swap_adopt!r}")
+        # "auto": a hot-swap adopts the old epoch's memmaps (and its
+        # dequantized cache) for pairs the CRC tables prove unchanged,
+        # so re-warm work scales with changed-and-hot, not p^2.  "off"
+        # re-opens every panel from the new artifact - the pre-adoption
+        # behavior, kept as an operational escape hatch.
+        self._swap_adopt = swap_adopt
         self._cache_bytes = int(cache_bytes)
         self._max_queue = int(max_queue)
         self._max_batch = int(max_batch)
@@ -596,13 +606,21 @@ class PosteriorServer:
                                  generation)
             self._ptr_stat = key
             return
-        engine = QueryEngine(art, cache_bytes=self._cache_bytes)
+        # delta-aware engine build: adopt the old epoch's memmaps (and
+        # already-dequantized panels) for every pair the two CRC tables
+        # prove unchanged - after a delta promotion only the changed
+        # panels' bytes are ever pulled from the new generation
+        engine = QueryEngine(
+            art, cache_bytes=self._cache_bytes,
+            adopt_from=(old.engine if self._swap_adopt == "auto" else None))
         # hot-set pre-warmer: replay the OLD engine's hottest panels
         # into the new engine BEFORE the flip, so a promotion under
         # load does not reset the cache cold (the panel grid only grows
         # across generations; keys past the new grid are skipped).  The
         # set is persisted beside the new artifact so a restarted
-        # worker on this generation warms the same way.
+        # worker on this generation warms the same way.  Adopted pairs
+        # replay for free (seeded straight from the old cache), so the
+        # warm-up dequant cost is proportional to changed-and-hot.
         hot = old.engine.hot_panels(PREWARM_LIMIT) or _load_hotset(art.path)
         _save_hotset(art.path, hot)
         self._prewarmed = engine.prewarm(hot)
@@ -615,10 +633,23 @@ class PosteriorServer:
         self._ptr_stat = key
         fault_event("swap_commit")
         self._swaps.inc()
+        panels_total = art.n_pairs * (2 if art.has_sd else 1)
+        panels_changed = panels_total - engine.panels_adopted
+        try:
+            maps_bytes = os.path.getsize(os.path.join(art.path, MAPS_FILE))
+        except OSError:
+            maps_bytes = 0
         record("serve_swap", generation=generation,
                from_generation=old.generation,
                fingerprint=art.fingerprint,
                prewarm_panels=self._prewarmed,
+               # re-warm economics of THIS swap: how many pairs kept
+               # serving from the old epoch's memmaps, how many panel
+               # reads the new generation actually costs
+               panels_adopted=engine.panels_adopted,
+               panels_changed=panels_changed,
+               cache_seeded=engine.cache_seeded,
+               bytes_shipped=panels_changed * art.P * art.P + maps_bytes,
                worker=self.worker_index)
         # drain in-flight requests on the OLD engine: close() serves
         # everything already queued before joining the worker, so the
@@ -929,7 +960,8 @@ def serve_main(args) -> int:
         swap_poll=getattr(args, "swap_poll", 0.5),
         shed_high=getattr(args, "shed_high", 0.75),
         shed_low=getattr(args, "shed_low", 0.50),
-        worker_index=worker_index)
+        worker_index=worker_index,
+        swap_adopt=getattr(args, "swap_adopt", "auto"))
     host, port = server.address
     record("serve_start", worker=worker_index, pid=os.getpid(),
            generation=server.generation,

@@ -80,9 +80,15 @@ JAX_PLATFORMS=cpu python -m dcfm_tpu.analysis --trace \
 # test_sse_gram.py rides the lane for the same reason: the gram-mode
 # sweep and the fused SSE+Gamma-rate pallas-interpret kernel
 # (ops/sse_gamma) compile programs no other file traces.
+# test_serve_delta.py rides the lane: its chaos test SIGKILLs a real
+# `dcfm-tpu promote --delta` subprocess mid-materialization (the
+# delta_materialize kill point) and its storm test swaps a live
+# in-process server under 64 threads - a runaway child or a native
+# abort must fail one file with its signal named.
 echo "== serve + chaos tests incl. crash-fuzz smoke (crash-isolated lane) =="
 for f in tests/test_serve_artifact.py tests/test_serve_engine.py \
          tests/test_serve_server.py tests/test_serve_fleet.py \
+         tests/test_serve_delta.py \
          tests/test_resilience.py tests/test_online.py \
          tests/test_runtime_stream.py tests/test_obs.py \
          tests/test_chains_mesh.py tests/test_sparse_ingest.py \

@@ -1,0 +1,30 @@
+"""known-bad fixture: os.replace/os.rename/os.link aiming at a
+``CURRENT`` promotion pointer outside serve/promote.py (DCFM1901) -
+every spelling of the pointer path (literal, audit sibling, the
+POINTER_FILE constant, an aliased mutator) fires."""
+
+import os
+from os import replace as mv
+
+from dcfm_tpu.serve.promote import POINTER_FILE
+
+
+def hijack_literal(root, target):
+    # the classic rogue writer: a second CAS done by hand
+    os.replace(target, os.path.join(root, "CURRENT"))
+
+
+def hijack_audit_sibling(root, target):
+    # re-numbering promotion history is the same violation
+    os.rename(target, os.path.join(root, "CURRENT.gen1"))
+
+
+def hijack_constant(root, target):
+    # routing the path through the promote module's own constant does
+    # not sanctify the mutation
+    os.link(target, os.path.join(root, POINTER_FILE))
+
+
+def hijack_aliased(root, target):
+    # `from os import replace as mv` resolves through the alias table
+    mv(target, root + "/" + "CURRENT")

@@ -1,0 +1,34 @@
+"""known-good twin of bad_pointer.py: pointer moves routed through the
+one sanctioned CAS (serve/promote), ordinary tmp+replace writes left
+alone, and the one reviewed exception carries a pragma."""
+
+import os
+
+from dcfm_tpu.serve.promote import promote_artifact, promote_delta
+
+
+def promote(root, candidate):
+    # the sanctioned path: verify + monotonic generation + atomic
+    # replace + audit hardlink + promotion event, in one place
+    return promote_artifact(root, candidate)
+
+
+def promote_from_delta(root, delta):
+    return promote_delta(root, delta)
+
+
+def save_state(path, payload):
+    # ordinary crash-safe file writes (state.json, meta.json, ...) are
+    # not pointer mutations - no CURRENT in sight
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        f.write(payload)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def sanctioned_oneoff(root, tmp):
+    # a reviewed exception (say, a migration script relocating a root)
+    # stays visible and audited via the pragma
+    os.replace(tmp, os.path.join(root, "CURRENT"))  # dcfm: ignore[DCFM1901] - doc example of the sanctioned escape hatch

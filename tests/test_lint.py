@@ -367,6 +367,39 @@ def test_partition_rule_exempts_the_rule_table_home():
                    for f in lint_source(src, "scripts/demo.py"))
 
 
+def test_bad_pointer_fires_1901():
+    assert _rules_fired("bad_pointer.py") == {"DCFM1901"}
+
+
+def test_bad_pointer_flags_every_mutator_spelling():
+    findings = lint_file(os.path.join(FIXTURES, "bad_pointer.py"))
+    msgs = [f.message for f in findings if f.rule == "DCFM1901"]
+    # literal CURRENT, the CURRENT.gen1 audit sibling, the
+    # POINTER_FILE constant, and the aliased `from os import replace`
+    assert len(msgs) == 4
+    assert any(m.startswith("os.replace(...)") for m in msgs)
+    assert any(m.startswith("os.rename(...)") for m in msgs)
+    assert any(m.startswith("os.link(...)") for m in msgs)
+
+
+def test_pointer_rule_exempts_the_cas_home():
+    """serve/promote.py IS the compare-and-swap: the same replace is
+    quiet there and flagged everywhere else in the library."""
+    src = ("import os\n"
+           "def cas(root, tmp):\n"
+           "    os.replace(tmp, os.path.join(root, 'CURRENT'))\n")
+    assert not any(f.rule == "DCFM1901"
+                   for f in lint_source(src,
+                                        "dcfm_tpu/serve/promote.py"))
+    assert any(f.rule == "DCFM1901"
+               for f in lint_source(src, "dcfm_tpu/serve/fleet.py"))
+    # library-only scope: tests and scripts stage pointers freely
+    assert not any(f.rule == "DCFM1901"
+                   for f in lint_source(src, "test_mod.py"))
+    assert not any(f.rule == "DCFM1901"
+                   for f in lint_source(src, "scripts/demo.py"))
+
+
 def test_bad_pragma_fires_002_for_dead_and_unknown():
     findings = lint_file(os.path.join(FIXTURES, "bad_pragma.py"))
     assert {f.rule for f in findings} == {"DCFM002"}
@@ -397,7 +430,8 @@ def test_every_rule_family_has_a_firing_fixture():
     "good_multihost.py", "good_runtime.py", "good_obs.py",
     "good_handler.py", "good_locks.py", "good_lifetime.py",
     "good_pragma.py", "good_poll.py", "good_chainaxis.py",
-    "good_densequad.py", "good_precision.py", "good_partition.py"])
+    "good_densequad.py", "good_precision.py", "good_partition.py",
+    "good_pointer.py"])
 def test_good_fixture_is_clean(name):
     findings = lint_file(os.path.join(FIXTURES, name))
     assert findings == [], [str(f) for f in findings]

@@ -1,0 +1,473 @@
+"""Delta artifact promotion: format, byte-identity, refusal, chaos.
+
+Pins ``serve/delta.py`` end to end:
+
+* the byte-identity contract: ``materialize_delta(base,
+  write_delta_artifact(cand, base))`` reconstructs panel binaries,
+  ``maps.npz`` AND ``meta.json`` byte-for-byte equal to the candidate
+  (the per-panel CRC tables prove it, the verbatim meta copy lands it);
+* an empty delta (idempotent re-promotion) and a maps-only change both
+  roundtrip byte-identically with zero panel bytes shipped;
+* a single bit-flip anywhere in the delta payload refuses at
+  materialize time with the typed ArtifactCorruptError, the promotion
+  pointer unmoved and the old generation still serving its exact bytes;
+* a delta applied to the wrong base refuses with the typed
+  DeltaBaseMismatchError (the full-artifact fallback cue);
+* SIGKILL mid-materialization (the ``delta_materialize`` kill point)
+  leaves the pointer and serving generation untouched and a torn
+  unopenable target; a clean retry promotes - crash-only, like every
+  write path upstream;
+* memmap adoption across a hot-swap: unchanged pairs serve from the
+  PREDECESSOR generation's memmaps (object identity, not a re-open),
+  the stricter scale-aware predicate refuses scale-only "unchanged"
+  panels, and the pre-warmer carries the hot set over bitwise;
+* ``dcfm-tpu delta`` / ``dcfm-tpu promote --delta`` operator paths and
+  the flight-recorder trail ``dcfm-tpu events`` summarizes.
+"""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from dcfm_tpu.obs.cli import summarize
+from dcfm_tpu.obs.recorder import FlightRecorder, install, uninstall
+from dcfm_tpu.serve.artifact import (
+    ArtifactCorruptError, ArtifactError, MAPS_FILE, MEAN_PANELS_FILE,
+    META_FILE, SD_PANELS_FILE, PosteriorArtifact, artifact_fingerprint,
+    panel_crc32, write_artifact)
+from dcfm_tpu.serve.delta import (
+    CANDIDATE_META_FILE, DELTA_META_FILE, MEAN_DELTA_FILE, DeltaArtifact,
+    DeltaBaseMismatchError, DeltaError, changed_pairs, materialize_delta,
+    write_delta_artifact)
+from dcfm_tpu.serve.engine import QueryEngine
+from dcfm_tpu.serve.promote import (promote_artifact, promote_delta,
+                                    read_pointer)
+from dcfm_tpu.serve.server import GENERATION_HEADER, PosteriorServer
+from dcfm_tpu.utils.preprocess import preprocess
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+G = 3                       # 6 canonical pairs: diag pairs are 0, 3, 5
+P_ORIG = 24
+
+
+def _make_artifact(path, *, seed=0, p=P_ORIG, g=G):
+    """Small CRC'd artifact with random panels - no fit, no jax (the
+    serve plane's own test idiom, see test_serve_fleet)."""
+    rng = np.random.default_rng(seed)
+    Y = rng.standard_normal((40, p)).astype(np.float32)
+    pre = preprocess(Y, g)
+    n_pairs = g * (g + 1) // 2
+    P = pre.shard_size
+    q = rng.integers(-127, 128, size=(n_pairs, P, P)).astype(np.int8)
+    pair = 0
+    for a in range(g):
+        for b in range(a, g):
+            if a == b:
+                q[pair] = np.triu(q[pair]) + np.triu(q[pair], 1).T
+            pair += 1
+    return write_artifact(
+        path, mean_q8=q, pre=pre,
+        mean_scale=rng.uniform(0.5, 1.5, n_pairs).astype(np.float32),
+        sd_q8=rng.integers(1, 128, size=(n_pairs, P, P)).astype(np.int8),
+        sd_scale=rng.uniform(0.5, 1.5, n_pairs).astype(np.float32)).path
+
+
+def _partial_variant(src, dst, *, mean_pairs=(), sd_pairs=()):
+    """Copy ``src`` and XOR-perturb exactly the named pairs' panels
+    (symmetry-preserving, so diagonal pairs stay legal), re-recording
+    CRCs + fingerprint - a candidate whose change is honestly
+    localized, which a relineaged warm refit never is."""
+    shutil.copytree(src, dst)
+    with open(os.path.join(dst, META_FILE), "r", encoding="utf-8") as f:
+        meta = json.load(f)
+    n_pairs = meta["g"] * (meta["g"] + 1) // 2
+    P = meta["P"]
+    for fname, kind, pairs in ((MEAN_PANELS_FILE, "mean", mean_pairs),
+                               (SD_PANELS_FILE, "sd", sd_pairs)):
+        if not pairs:
+            continue
+        q = np.memmap(os.path.join(dst, fname), dtype=np.int8,
+                      mode="r+", shape=(n_pairs, P, P))
+        for pair in pairs:
+            q[pair] ^= 0x55
+        q.flush()
+        meta["panel_crc"][kind] = [int(panel_crc32(np.asarray(pnl)))
+                                   for pnl in q]
+    meta["fingerprint"] = artifact_fingerprint(meta)
+    with open(os.path.join(dst, META_FILE), "w", encoding="utf-8") as f:
+        json.dump(meta, f)
+    return dst
+
+
+def _flip_byte(path, offset=7):
+    with open(path, "r+b") as f:
+        f.seek(offset)
+        b = f.read(1)
+        f.seek(offset)
+        f.write(bytes([b[0] ^ 0x5A]))
+
+
+def _read(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def _assert_byte_identical(out, cand):
+    for name in (MEAN_PANELS_FILE, SD_PANELS_FILE, MAPS_FILE, META_FILE):
+        assert _read(os.path.join(out, name)) == \
+            _read(os.path.join(cand, name)), name
+
+
+# ---------------------------------------------------------------------------
+# format + byte identity
+# ---------------------------------------------------------------------------
+
+def test_roundtrip_byte_identical(tmp_path):
+    """THE contract: materialize(base, delta(cand, base)) == cand, byte
+    for byte, with only the changed panels' bytes in the delta."""
+    v1 = _make_artifact(str(tmp_path / "v1"), seed=1)
+    cand = _partial_variant(v1, str(tmp_path / "cand"),
+                            mean_pairs=(1, 4), sd_pairs=(2,))
+    base = PosteriorArtifact.open(v1)
+    d = write_delta_artifact(cand, base, str(tmp_path / "delta"))
+    assert [int(i) for i in d.changed["mean"]] == [1, 4]
+    assert [int(i) for i in d.changed["sd"]] == [2]
+    assert d.panels_changed == 3
+    # the packed payload is exactly the changed panels
+    P = base.P
+    assert os.path.getsize(os.path.join(d.path, MEAN_DELTA_FILE)) \
+        == 2 * P * P
+    assert d.bytes_shipped < d.full_bytes
+    art = materialize_delta(base, d, str(tmp_path / "out"))
+    _assert_byte_identical(str(tmp_path / "out"), cand)
+    assert art.fingerprint == PosteriorArtifact.open(cand).fingerprint
+    # the reconstruction serves: full CRC sweep passes
+    for kind in ("mean", "sd"):
+        for pair in range(art.n_pairs):
+            art.verify_panel(kind, pair)
+
+
+def test_changed_pairs_is_the_crc_diff(tmp_path):
+    v1 = _make_artifact(str(tmp_path / "v1"), seed=2)
+    cand = _partial_variant(v1, str(tmp_path / "cand"), mean_pairs=(0, 5))
+    got = changed_pairs(PosteriorArtifact.open(v1),
+                        PosteriorArtifact.open(cand))
+    assert [int(i) for i in got["mean"]] == [0, 5]
+    assert list(got["sd"]) == []
+
+
+def test_empty_delta_roundtrips(tmp_path):
+    """Identical candidate -> zero panels shipped, no packed file, and
+    materialization still lands a byte-identical artifact (idempotent
+    re-promotion ships O(meta), not O(p^2))."""
+    v1 = _make_artifact(str(tmp_path / "v1"), seed=3)
+    cand = str(tmp_path / "cand")
+    shutil.copytree(v1, cand)
+    d = write_delta_artifact(cand, PosteriorArtifact.open(v1),
+                             str(tmp_path / "delta"))
+    assert d.panels_changed == 0
+    assert not os.path.exists(os.path.join(d.path, MEAN_DELTA_FILE))
+    materialize_delta(v1, d, str(tmp_path / "out"))
+    _assert_byte_identical(str(tmp_path / "out"), cand)
+
+
+def test_shape_mismatch_is_a_fallback_cue(tmp_path):
+    v1 = _make_artifact(str(tmp_path / "v1"), seed=4, g=2)
+    v2 = _make_artifact(str(tmp_path / "v2"), seed=4, g=3)
+    with pytest.raises(DeltaError, match="ship the full artifact"):
+        write_delta_artifact(v2, PosteriorArtifact.open(v1),
+                             str(tmp_path / "delta"))
+
+
+def test_missing_crc_table_is_a_fallback_cue(tmp_path):
+    v1 = _make_artifact(str(tmp_path / "v1"), seed=5)
+    cand = _partial_variant(v1, str(tmp_path / "cand"), mean_pairs=(1,))
+    mp = os.path.join(v1, META_FILE)
+    with open(mp, "r", encoding="utf-8") as f:
+        meta = json.load(f)
+    del meta["panel_crc"]
+    with open(mp, "w", encoding="utf-8") as f:
+        json.dump(meta, f)
+    with pytest.raises(DeltaError, match="CRC"):
+        write_delta_artifact(cand, PosteriorArtifact.open(v1),
+                             str(tmp_path / "delta"))
+
+
+def test_torn_delta_refuses_to_open(tmp_path):
+    """delta.json is written last: a crash mid-export leaves a directory
+    DeltaArtifact.open refuses (the meta-last discipline)."""
+    v1 = _make_artifact(str(tmp_path / "v1"), seed=6)
+    cand = _partial_variant(v1, str(tmp_path / "cand"), mean_pairs=(1,))
+    d = write_delta_artifact(cand, PosteriorArtifact.open(v1),
+                             str(tmp_path / "delta"))
+    os.unlink(os.path.join(d.path, DELTA_META_FILE))
+    with pytest.raises(DeltaError, match="not a delta artifact"):
+        DeltaArtifact.open(d.path)
+
+
+# ---------------------------------------------------------------------------
+# refusal: corruption and wrong base
+# ---------------------------------------------------------------------------
+
+def test_bit_flip_refuses_and_old_generation_keeps_serving(tmp_path):
+    """Acceptance: one flipped bit in a delta panel refuses at
+    materialize with the pointer unmoved and generation 1 still
+    serving its exact bytes."""
+    root = str(tmp_path)
+    v1 = _make_artifact(os.path.join(root, "v1"), seed=7)
+    promote_artifact(root, "v1")
+    ref = PosteriorArtifact.open(v1).assemble()
+    cand = _partial_variant(v1, str(tmp_path / "cand"),
+                            mean_pairs=(1,), sd_pairs=(4,))
+    write_delta_artifact(cand, PosteriorArtifact.open(v1),
+                         os.path.join(root, "v2.delta"))
+    _flip_byte(os.path.join(root, "v2.delta", MEAN_DELTA_FILE))
+    srv = PosteriorServer(root, port=0, swap_poll=0.0)
+    srv.start()
+    try:
+        with pytest.raises(ArtifactCorruptError, match="fails its CRC32"):
+            promote_delta(root, "v2.delta", candidate="v2")
+        # pointer never moved, the target was never made openable
+        st = read_pointer(root)
+        assert (st.generation, st.target) == (1, "v1")
+        assert not os.path.exists(
+            os.path.join(root, "v2", META_FILE))
+        status, body, hdr = srv.handle("/v1/entry",
+                                       {"i": ["0"], "j": ["1"]})
+        assert status == 200 and hdr[GENERATION_HEADER] == "1"
+        assert np.float32(body["value"]) == np.float32(ref[0, 1])
+    finally:
+        srv.close()
+
+
+def test_wrong_base_refuses_with_the_typed_mismatch(tmp_path):
+    v1 = _make_artifact(str(tmp_path / "v1"), seed=8)
+    other = _make_artifact(str(tmp_path / "other"), seed=99)
+    cand = _partial_variant(v1, str(tmp_path / "cand"), mean_pairs=(2,))
+    d = write_delta_artifact(cand, PosteriorArtifact.open(v1),
+                             str(tmp_path / "delta"))
+    with pytest.raises(DeltaBaseMismatchError,
+                       match="pull the full candidate"):
+        materialize_delta(other, d, str(tmp_path / "out"))
+    assert not os.path.exists(str(tmp_path / "out"))
+
+
+def test_rotted_base_panel_refuses_before_meta_lands(tmp_path):
+    """An unchanged panel whose BASE bytes rotted on disk fails the
+    materialize-time sweep against the candidate's CRC table - the
+    output stays unopenable."""
+    v1 = _make_artifact(str(tmp_path / "v1"), seed=9)
+    cand = _partial_variant(v1, str(tmp_path / "cand"), mean_pairs=(1,))
+    d = write_delta_artifact(cand, PosteriorArtifact.open(v1),
+                             str(tmp_path / "delta"))
+    # rot an UNCHANGED panel region of the base (pair 0 starts at 0)
+    _flip_byte(os.path.join(v1, MEAN_PANELS_FILE), offset=3)
+    out = str(tmp_path / "out")
+    with pytest.raises(ArtifactCorruptError, match="stays unopenable"):
+        materialize_delta(v1, d, out)
+    with pytest.raises(ArtifactError):
+        PosteriorArtifact.open(out)
+
+
+# ---------------------------------------------------------------------------
+# chaos: SIGKILL mid-materialization (the promote --delta operator path)
+# ---------------------------------------------------------------------------
+
+def test_sigkill_mid_materialize_keeps_serving_then_clean_retry(tmp_path):
+    """Acceptance chaos point: a SIGKILL at the ``delta_materialize``
+    seam (panel bytes landed, meta not yet written) leaves the pointer
+    and serving generation untouched and the target unopenable; the
+    SAME promote command retried without the fault completes."""
+    # the candidate is STAGED outside the promotion root (the online
+    # loop's layout): the delta names it "v2", so promote --delta
+    # materializes root/v2 rather than adopting the staging dir
+    root = str(tmp_path / "root")
+    os.makedirs(root)
+    v1 = _make_artifact(os.path.join(root, "v1"), seed=10)
+    promote_artifact(root, "v1")
+    cand = str(tmp_path / "v2")
+    _partial_variant(v1, cand, mean_pairs=(0, 3), sd_pairs=(5,))
+    write_delta_artifact(cand, PosteriorArtifact.open(v1),
+                         os.path.join(root, "v2.delta"))
+    cmd = [sys.executable, "-m", "dcfm_tpu.cli", "promote", root,
+           "v2.delta", "--delta"]
+    env = dict(os.environ)
+    env["DCFM_FAULT_PLAN"] = json.dumps({"faults": [
+        {"op": "kill_event", "event": "delta_materialize",
+         "at_occurrence": 1}]})
+    cp = subprocess.run(cmd, capture_output=True, text=True, cwd=REPO,
+                        env=env, timeout=120)
+    assert cp.returncode == -9, (cp.returncode, cp.stderr)
+    st = read_pointer(root)
+    assert (st.generation, st.target) == (1, "v1")
+    # the torn materialization is unopenable (panel bytes, no meta)
+    assert os.path.exists(os.path.join(root, "v2", MEAN_PANELS_FILE))
+    with pytest.raises(ArtifactError):
+        PosteriorArtifact.open(os.path.join(root, "v2"))
+    # clean retry: same command, no fault plan
+    env.pop("DCFM_FAULT_PLAN")
+    cp = subprocess.run(cmd, capture_output=True, text=True, cwd=REPO,
+                        env=env, timeout=120)
+    assert cp.returncode == 0, cp.stderr
+    out = json.loads(cp.stdout)
+    assert out["generation"] == 2 and out["delta"] is True
+    assert out["panels_changed"] == 3
+    st = read_pointer(root)
+    assert (st.generation, st.target) == (2, "v2")
+    _assert_byte_identical(os.path.join(root, "v2"), cand)
+
+
+def test_promote_delta_is_idempotent(tmp_path):
+    """Re-promoting the same delta adopts the already-materialized
+    byte-identical target instead of rebuilding it, and the generation
+    still only moves forward."""
+    root = str(tmp_path)
+    v1 = _make_artifact(os.path.join(root, "v1"), seed=11)
+    promote_artifact(root, "v1")
+    cand = _partial_variant(v1, str(tmp_path / "cand"), mean_pairs=(2,))
+    write_delta_artifact(cand, PosteriorArtifact.open(v1),
+                         os.path.join(root, "v2.delta"))
+    st = promote_delta(root, "v2.delta", candidate="v2")
+    assert st.generation == 2
+    # second promotion of the same delta: pointer moves to gen 3 (the
+    # CAS is monotonic) but the target bytes are adopted, not rebuilt
+    ino = os.stat(os.path.join(root, "v2", MEAN_PANELS_FILE)).st_ino
+    st = promote_delta(root, "v2.delta", candidate="v2")
+    assert st.generation == 3
+    assert os.stat(
+        os.path.join(root, "v2", MEAN_PANELS_FILE)).st_ino == ino
+
+
+# ---------------------------------------------------------------------------
+# memmap adoption + hot-set pre-warm (the re-warm ∝ changed∩hot claim)
+# ---------------------------------------------------------------------------
+
+def test_engine_adopts_unchanged_pairs_from_predecessor(tmp_path):
+    v1 = _make_artifact(str(tmp_path / "v1"), seed=12)
+    cand = _partial_variant(v1, str(tmp_path / "cand"),
+                            mean_pairs=(1, 4), sd_pairs=(2,))
+    a1 = PosteriorArtifact.open(v1)
+    a2 = PosteriorArtifact.open(cand)
+    e1 = QueryEngine(a1, cache_bytes=1 << 20)
+    # warm a hot set: two pairs that survive, one that changes
+    for pair, diag in ((0, True), (2, False), (1, False)):
+        e1._panel("mean", pair, diag)
+    e2 = QueryEngine(a2, cache_bytes=1 << 20, adopt_from=e1)
+    # mean: pairs {0,2,3,5} unchanged; sd: {0,1,3,4,5} unchanged
+    assert e2.panels_adopted == 4 + 5
+    assert e2.panel_source("mean", 0) == "adopted"
+    assert e2.panel_source("mean", 1) == "new"
+    assert e2.panel_source("sd", 2) == "new"
+    assert e2.panel_source("sd", 4) == "adopted"
+    # adopted pairs serve from the PREDECESSOR's memmap OBJECT - not a
+    # re-open of the new generation's file
+    assert e2._adopted_raw["mean"] is a1.mean_panels
+    # the pre-warmer carried exactly the unchanged hot panels (0 and 2;
+    # pair 1 changed and must be re-dequantized from the new bytes)
+    assert e2.cache_seeded == 2
+    # bitwise oracle: every value equals a cold engine on the candidate
+    cold = QueryEngine(a2, cache_bytes=1 << 20)
+    diag_pairs = {0, 3, 5}
+    for kind in ("mean", "sd"):
+        for pair in range(a2.n_pairs):
+            np.testing.assert_array_equal(
+                e2._panel(kind, pair, pair in diag_pairs),
+                cold._panel(kind, pair, pair in diag_pairs))
+
+
+def test_scale_only_change_defeats_adoption_but_not_shipping(tmp_path):
+    """The two predicates differ on purpose: a scale-only change ships
+    ZERO panel bytes (maps travel verbatim) yet the engine must NOT
+    adopt the pair - identical bytes times a different scale is a
+    different served value."""
+    v1 = _make_artifact(str(tmp_path / "v1"), seed=13)
+    cand = str(tmp_path / "cand")
+    shutil.copytree(v1, cand)
+    mp = os.path.join(cand, MAPS_FILE)
+    maps = dict(np.load(mp))
+    maps["mean_scale"] = (maps["mean_scale"]
+                          * np.float32(2.0)).astype(np.float32)
+    np.savez(mp, **maps)
+    a1, a2 = PosteriorArtifact.open(v1), PosteriorArtifact.open(cand)
+    d = write_delta_artifact(a2, a1, str(tmp_path / "delta"))
+    assert d.panels_changed == 0            # shipping: nothing changed
+    materialize_delta(a1, d, str(tmp_path / "out"))
+    _assert_byte_identical(str(tmp_path / "out"), cand)
+    e1 = QueryEngine(a1, cache_bytes=1 << 20)
+    e2 = QueryEngine(a2, cache_bytes=1 << 20, adopt_from=e1)
+    # adoption: every mean pair's dequant scale changed -> none adopted
+    assert all(e2.panel_source("mean", pair) == "new"
+               for pair in range(a2.n_pairs))
+    # sd scales are untouched, those pairs still adopt
+    assert all(e2.panel_source("sd", pair) == "adopted"
+               for pair in range(a2.n_pairs))
+
+
+# ---------------------------------------------------------------------------
+# operator CLI + flight-recorder trail
+# ---------------------------------------------------------------------------
+
+def test_cli_delta_export_and_apply_roundtrip(tmp_path):
+    root = str(tmp_path)
+    v1 = _make_artifact(os.path.join(root, "v1"), seed=14)
+    promote_artifact(root, "v1")
+    cand = _partial_variant(v1, str(tmp_path / "cand"), mean_pairs=(3,))
+    delta_dir = str(tmp_path / "delta")
+    # --base accepts a promotion root: its CURRENT target is the base
+    cp = subprocess.run(
+        [sys.executable, "-m", "dcfm_tpu.cli", "delta", cand,
+         "--base", root, "--out", delta_dir],
+        capture_output=True, text=True, cwd=REPO, timeout=120)
+    assert cp.returncode == 0, cp.stderr
+    out = json.loads(cp.stdout)
+    assert out["panels_changed"] == 1
+    assert out["bytes_shipped"] < out["full_bytes"]
+    applied = str(tmp_path / "applied")
+    cp = subprocess.run(
+        [sys.executable, "-m", "dcfm_tpu.cli", "delta", delta_dir,
+         "--base", root, "--out", applied, "--apply"],
+        capture_output=True, text=True, cwd=REPO, timeout=120)
+    assert cp.returncode == 0, cp.stderr
+    assert json.loads(cp.stdout)["fingerprint"] == \
+        PosteriorArtifact.open(cand).fingerprint
+    _assert_byte_identical(applied, cand)
+
+
+def test_events_cli_summarizes_the_delta_trail(tmp_path):
+    """Satellite: delta_export / delta_promote land in the recorder and
+    ``dcfm-tpu events`` surfaces them beside full promotions."""
+    root = str(tmp_path / "root")
+    os.makedirs(root)
+    obs = str(tmp_path / "obs")
+    rec = FlightRecorder(obs, role="test")
+    install(rec)
+    try:
+        v1 = _make_artifact(os.path.join(root, "v1"), seed=15)
+        promote_artifact(root, "v1")
+        cand = _partial_variant(v1, str(tmp_path / "cand"),
+                                mean_pairs=(1,), sd_pairs=(1,))
+        write_delta_artifact(cand, PosteriorArtifact.open(v1),
+                             os.path.join(root, "v2.delta"))
+        promote_delta(root, "v2.delta", candidate="v2", drift=0.125)
+    finally:
+        uninstall(rec)
+        rec.close()
+    s = summarize(obs)
+    assert len(s["delta_exports"]) == 1
+    assert s["delta_exports"][0]["panels_changed"] == 2
+    assert len(s["delta_promotions"]) == 1
+    dp = s["delta_promotions"][0]
+    assert dp["target"] == "v2" and dp["generation"] == 2
+    assert dp["panels_changed"] == 2
+    assert dp["bytes_shipped"] < dp["full_bytes"]
+    assert dp["drift"] == 0.125
+    assert s["delta_fallbacks"] == []
+    # the human summary names the delta promotion too
+    from dcfm_tpu.obs.cli import events_main
+    assert events_main([obs]) == 0

@@ -43,12 +43,15 @@ import numpy as np
 import pytest
 
 from dcfm_tpu.obs.cli import summarize
+from dcfm_tpu.obs.recorder import FlightRecorder, install, uninstall
 from dcfm_tpu.resilience.faults import serve_fuzz_spec
 from dcfm_tpu.serve.artifact import (
     ArtifactError, MEAN_PANELS_FILE, META_FILE, PosteriorArtifact,
     artifact_fingerprint, panel_crc32, write_artifact)
+from dcfm_tpu.serve.delta import write_delta_artifact
 from dcfm_tpu.serve.loadgen import run_load
-from dcfm_tpu.serve.promote import promote_artifact, read_pointer
+from dcfm_tpu.serve.promote import (promote_artifact, promote_delta,
+                                    read_pointer)
 from dcfm_tpu.serve.server import GENERATION_HEADER, PosteriorServer
 from dcfm_tpu.utils.preprocess import preprocess
 
@@ -229,6 +232,119 @@ def test_hot_swap_under_64_thread_storm(tmp_path):
     assert res["generation"]["max"] == 2       # the swap landed under load
     assert st == 200 and m["swap"]["swaps"] == 1
     assert m["swap"]["refused"] == 0
+
+
+def _partial_variant_artifact(src, dst, pairs):
+    """Copy ``src`` and XOR-perturb exactly ``pairs``' mean panels
+    (symmetry-preserving), re-recording CRCs + fingerprint - the
+    honestly-localized change a delta promotion exists for."""
+    shutil.copytree(src, dst)
+    with open(os.path.join(dst, META_FILE), "r", encoding="utf-8") as f:
+        meta = json.load(f)
+    n_pairs = meta["g"] * (meta["g"] + 1) // 2
+    q = np.memmap(os.path.join(dst, MEAN_PANELS_FILE), dtype=np.int8,
+                  mode="r+", shape=(n_pairs, meta["P"], meta["P"]))
+    for pair in pairs:
+        q[pair] ^= 0x55
+    q.flush()
+    meta["panel_crc"]["mean"] = [int(panel_crc32(np.asarray(panel)))
+                                 for panel in q]
+    meta["fingerprint"] = artifact_fingerprint(meta)
+    with open(os.path.join(dst, META_FILE), "w", encoding="utf-8") as f:
+        json.dump(meta, f)
+    return dst
+
+
+def test_hot_swap_to_delta_generation_under_storm(tmp_path):
+    """The delta tentpole's storm acceptance: generation 2 arrives as a
+    DELTA promoted mid-storm.  Zero drops, every 200 bitwise matches
+    the artifact its generation header names, the swap ships only the
+    changed panels' bytes (recorder-counted), and the new epoch serves
+    unchanged pairs from the OLD epoch's adopted memmaps - not a
+    re-open of the new generation's files."""
+    root = str(tmp_path / "root")
+    os.makedirs(root)
+    v1 = _make_artifact(os.path.join(root, "v1"), seed=6)
+    # stage the candidate OUTSIDE the root; only its delta lands inside
+    stage = _partial_variant_artifact(v1, str(tmp_path / "v2"),
+                                      pairs=(0, 2))
+    a1 = PosteriorArtifact.open(v1)
+    ref = {1: a1.assemble(),
+           2: PosteriorArtifact.open(stage).assemble()}
+    d = write_delta_artifact(stage, a1, os.path.join(root, "v2.delta"))
+    assert d.panels_changed == 2 and list(d.changed["sd"]) == []
+    promote_artifact(root, "v1")
+    rec = FlightRecorder(str(tmp_path / "obs"), role="storm")
+    install(rec)
+    srv = PosteriorServer(root, port=0, max_queue=2048, max_batch=64,
+                          request_timeout=60.0, swap_poll=0.0)
+    host, port = srv.start()
+    first_engine = srv._epoch.engine
+    seen = {"ok": 0}
+    promote_once = threading.Event()
+
+    def expect(kind, path, body, gen):
+        seen["ok"] += 1
+        if seen["ok"] == 200 and not promote_once.is_set():
+            promote_once.set()
+            promote_delta(root, "v2.delta")
+        q = urllib.parse.parse_qs(urllib.parse.urlparse(path).query)
+        i, j = int(q["i"][0]), int(q["j"][0])
+        want = np.float32(ref[gen][i, j])
+        got = np.float32(body["value"])
+        if got != want:
+            return (f"generation {gen} entry ({i},{j}): "
+                    f"got {got!r} want {want!r}")
+        return None
+
+    try:
+        res = run_load(f"http://{host}:{port}", threads=64,
+                       requests_per_thread=25, seed=11, p=P_ORIG,
+                       retries=2, timeout=60.0, expect=expect,
+                       route_mix=(("entry", 1),))
+        st, m, _ = _get(f"http://{host}:{port}", "/metrics")
+    finally:
+        srv.close()
+        uninstall(rec)
+        rec.close()
+    assert res["dropped"] == 0
+    assert res["untyped"] == []
+    assert res["value_errors"] == []
+    assert res["generation"]["violations"] == 0
+    assert res["generation"]["min"] == 1
+    assert res["generation"]["max"] == 2
+    assert st == 200 and m["swap"]["swaps"] == 1
+    # adoption: mean pair 1 and all three sd pairs are unchanged and
+    # serve from the predecessor epoch's memmap OBJECTS
+    eng = srv._epoch.engine
+    assert eng.artifact.fingerprint == \
+        PosteriorArtifact.open(stage).fingerprint
+    assert eng.panels_adopted == 1 + 3
+    assert eng.panel_source("mean", 0) == "new"
+    assert eng.panel_source("mean", 1) == "adopted"
+    assert eng.panel_source("sd", 0) == "adopted"
+    assert eng._adopted_raw["mean"] is first_engine.artifact.mean_panels
+    # the recorder trail: the delta promotion shipped fewer bytes than
+    # a full artifact, and the swap event counted the adoption
+    s = summarize(str(tmp_path / "obs"))
+    assert len(s["delta_promotions"]) == 1
+    dp = s["delta_promotions"][0]
+    assert dp["panels_changed"] == 2
+    assert dp["bytes_shipped"] < dp["full_bytes"]
+    swap_events = []
+    with open(rec.path, encoding="utf-8") as f:
+        for line in f:
+            e = json.loads(line)
+            if e.get("event") == "serve_swap":
+                swap_events.append(e)
+    assert len(swap_events) == 1
+    sw = swap_events[0]
+    assert sw["panels_adopted"] == 4
+    assert sw["panels_changed"] == 2
+    # exactly the changed panels' bytes + the always-shipped maps - the
+    # four adopted panels' bytes never move
+    maps_bytes = os.path.getsize(os.path.join(root, "v2", "maps.npz"))
+    assert sw["bytes_shipped"] == 2 * a1.P * a1.P + maps_bytes
 
 
 def test_corrupt_candidate_refused_old_keeps_serving(tmp_path):
