@@ -431,6 +431,98 @@ def _delta_probe():
             "rounds": rounds}
 
 
+# Elastic-resume probe shape (elastic execution, ROADMAP item 5a):
+# small on purpose - the gated claim is a schedule RATIO at one shape
+# (adopting a half-run 4-chain checkpoint on 2 surviving chains must
+# beat restarting those 2 chains from iteration zero), not a
+# throughput number.  BENCH_ELASTIC=0 disables.
+ELASTIC_P = int(os.environ.get("BENCH_ELASTIC_P", 96))
+ELASTIC_G = int(os.environ.get("BENCH_ELASTIC_G", 8))
+ELASTIC_N = int(os.environ.get("BENCH_ELASTIC_N", 160))
+ELASTIC_BURNIN = int(os.environ.get("BENCH_ELASTIC_BURNIN", 120))
+ELASTIC_MCMC = int(os.environ.get("BENCH_ELASTIC_MCMC", 120))
+
+
+def _elastic_probe():
+    """Elastic-resume phase (ROADMAP 5a): checkpoint a 4-chain run
+    half-way through its draws (the preemption), then measure
+
+    * ``elastic_cold_s``: the non-elastic alternative - the 2
+      surviving chains restarted from iteration zero on the full
+      schedule, which is what a strict chain-count gate forces after
+      capacity loss;
+    * ``elastic_resume_s``: ``load_checkpoint_elastic`` adopting the
+      4-chain checkpoint on the 2 survivors (bitwise carries, the
+      dropped chains' draws folded into the pool) and finishing the
+      same schedule.
+
+    The elastic run re-executes only the remaining half and keeps
+    every draw all four donor chains banked, so its wall must sit
+    under the cold restart's (gated < 1 at the default shape).  The
+    cold control runs FIRST so residual XLA compile for the 2-chain
+    program lands on it, not in the gated number; the donor runs its
+    own 4-chain program either way (recorded, ungated)."""
+    from dcfm_tpu import FitConfig, ModelConfig, RunConfig
+    from dcfm_tpu.api import fit as _fit
+
+    rng = np.random.default_rng(11)
+    k_true = 3
+    L = rng.standard_normal((ELASTIC_P, k_true)).astype(np.float32)
+    F = rng.standard_normal((ELASTIC_N, k_true)).astype(np.float32)
+    Y = (F @ L.T + 0.3 * rng.standard_normal(
+        (ELASTIC_N, ELASTIC_P))).astype(np.float32)
+    total = ELASTIC_BURNIN + ELASTIC_MCMC
+    chunk = max(1, total // 8)
+
+    def cfg(chains, mcmc, **kw):
+        return FitConfig(
+            model=ModelConfig(num_shards=ELASTIC_G,
+                              factors_per_shard=k_true, rho=0.9),
+            run=RunConfig(burnin=ELASTIC_BURNIN, mcmc=mcmc, thin=2,
+                          seed=7, chunk_size=chunk, num_chains=chains),
+            **kw)
+
+    with tempfile.TemporaryDirectory() as td:
+        # cold control FIRST: the full-schedule 2-chain compile lands
+        # here, not in the gated elastic number
+        t0 = time.perf_counter()
+        _fit(Y, cfg(2, ELASTIC_MCMC))
+        cold_s = time.perf_counter() - t0
+
+        # the donor: 4 chains stopped at the half-draws boundary.  A
+        # finished checkpoint + a LONGER schedule is a chain extension
+        # (same (burnin, thin) identity, total_iters ahead of its it),
+        # so running the donor at mcmc/2 IS the preemption - nothing
+        # to SIGKILL, and the half-way file is the donor's FINAL save,
+        # not a cadence artifact racing the crash point.
+        ck = os.path.join(td, "elastic.ckpt.npz")
+        t0 = time.perf_counter()
+        _fit(Y, cfg(4, ELASTIC_MCMC // 2, checkpoint_path=ck,
+                    checkpoint_every_chunks=2, checkpoint_keep_last=2))
+        donor_s = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        res = _fit(Y, cfg(2, ELASTIC_MCMC, checkpoint_path=ck,
+                          checkpoint_every_chunks=2,
+                          checkpoint_keep_last=2, resume=True))
+        resume_s = time.perf_counter() - t0
+        el = res.elastic_resume
+        if el is None or (el["from_chains"], el["to_chains"]) != (4, 2):
+            # a silently non-elastic resume would time the WRONG path
+            # and gate a fiction
+            raise RuntimeError(
+                f"elastic probe: resume was not a 4->2 adoption ({el})")
+        if res.Sigma is None or not np.all(np.isfinite(res.Sigma)):
+            raise RuntimeError(
+                "elastic probe: non-finite Sigma after elastic resume")
+        return {"elastic_resume_s": resume_s, "elastic_cold_s": cold_s,
+                "elastic_donor_s": donor_s,
+                "elastic_vs_cold_ratio": resume_s / max(cold_s, 1e-9),
+                "from_chains": el["from_chains"],
+                "to_chains": el["to_chains"],
+                "fold_draws": el["fold_draws"]}
+
+
 def _pack_probe():
     """Chains-packing efficiency probe: 4 chains packed on the full
     device set vs 1 chain on a quarter of it - equal per-device shard
@@ -938,6 +1030,13 @@ def main():
     # DELTA_* knobs).
     delta = _delta_probe()
 
+    # Elastic-resume probe (runtime/resume + utils/checkpoint): adopt a
+    # half-run 4-chain checkpoint on 2 surviving chains vs restarting
+    # those 2 chains from iteration zero, one round at the small probe
+    # shape.  BENCH_ELASTIC=0 disables.
+    elastic = (None if os.environ.get("BENCH_ELASTIC", "1") == "0"
+               else _elastic_probe())
+
     # Ingest-phase probe (scale-out ingestion): streaming sparse vs dense
     # preprocess of the same logical ~1%-density matrix, one subprocess
     # each for clean ru_maxrss high-water marks.  Host CPU only.
@@ -1127,6 +1226,19 @@ def main():
         "panels_changed_frac": delta["panels_changed_frac"],
         "delta": delta,
         "delta_refit": refit["delta"],
+        # Elastic-resume phase (null under BENCH_ELASTIC=0): a 4-chain
+        # checkpoint adopted on 2 surviving chains vs those 2 chains
+        # restarted cold - the elastic path re-runs only the remaining
+        # schedule and keeps all four donors' draws in the pool, so the
+        # ratio is gated < 1 at the default shape.  elastic_donor_s
+        # (the 4-chain half-run) rides along ungated.
+        "elastic_resume_s": (round(elastic["elastic_resume_s"], 2)
+                             if elastic else None),
+        "elastic_cold_s": (round(elastic["elastic_cold_s"], 2)
+                           if elastic else None),
+        "elastic_vs_cold_ratio": (round(elastic["elastic_vs_cold_ratio"],
+                                        4) if elastic else None),
+        "elastic": elastic,
         # Ingest phase (null under BENCH_INGEST=0): streaming sparse vs
         # dense preprocess of the same logical matrix, each pipeline's
         # wall + subprocess-clean peak-RSS delta.  ingest_s/ingest_MBps
@@ -1243,6 +1355,23 @@ def main():
               f"{delta['panels_changed_frac']} - shipping the delta "
               f"costs as much as re-shipping the artifact",
               file=sys.stderr)
+        status = 1
+    # * elastic resume: adopting the half-run 4-chain checkpoint on 2
+    #   surviving chains must beat restarting those 2 chains cold - the
+    #   elastic path skips the whole completed half, so a ratio at or
+    #   above 1.0 means the adoption (meta read + re-lineage + fold +
+    #   device_put) stopped paying for itself.  Only gated at the
+    #   default probe schedule: an env-shrunk one (e.g.
+    #   BENCH_ELASTIC_MCMC=16) leaves so little schedule to skip that
+    #   the fixed adoption cost legitimately dominates.
+    default_elastic = (ELASTIC_P, ELASTIC_N, ELASTIC_BURNIN,
+                       ELASTIC_MCMC) == (96, 160, 120, 120)
+    if elastic and default_elastic \
+            and elastic["elastic_vs_cold_ratio"] >= 1.0:
+        print(f"ELASTIC RESUME REGRESSION: elastic/cold wall ratio "
+              f"{elastic['elastic_vs_cold_ratio']:.3f} >= 1.0 "
+              f"(elastic {elastic['elastic_resume_s']:.2f}s, "
+              f"cold {elastic['elastic_cold_s']:.2f}s)", file=sys.stderr)
         status = 1
     if (default_shape and stream.get("snapshots", 0) > 0
             and overlap_med is not None and overlap_med <= 0.5):

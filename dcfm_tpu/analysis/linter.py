@@ -1613,6 +1613,87 @@ def _check_pointer_mutation(mod: _Module, rep: _Reporter) -> None:
 
 
 # =====================================================================
+# DCFM2001 - elastic-resume topology discipline
+# =====================================================================
+
+_TOPOLOGY_CALLS = {"jax.device_count", "jax.local_device_count",
+                   "jax.process_count", "jax.devices"}
+# Function-name hints that put a def on the resume/checkpoint carry
+# path.  Deliberately function-scoped, not module-scoped: mesh sizing
+# and launch-time capacity probes legitimately read live topology, and
+# the hazard is specifically arithmetic that must survive a restart on
+# DIFFERENT capacity (elastic resume, README "Elastic execution").
+_RESUME_HINTS = ("resume", "checkpoint", "rewind", "restore",
+                 "carryover", "elastic", "window", "warm")
+
+
+def _topology_site(mod: _Module, node: ast.AST) -> str:
+    """The dotted jax topology query when ``node`` is one (a direct
+    call; ``len(jax.devices())`` is caught via the inner call when the
+    enclosing expression is walked), else ''."""
+    if not isinstance(node, ast.Call):
+        return ""
+    full = mod.resolve(node.func)
+    return full if full in _TOPOLOGY_CALLS else ""
+
+
+def _check_topology_constants(mod: _Module, rep: _Reporter) -> None:
+    """DCFM2001: live topology queries feeding carry-shape or
+    window-divisor arithmetic inside resume/checkpoint-path functions.
+    Elastic resume restarts a checkpoint on a DIFFERENT capacity than
+    the one that saved it: a shape or divisor derived from
+    jax.device_count()/jax.process_count()/len(jax.devices()) silently
+    mis-sizes carries or mis-divides the pooled accumulators once the
+    topology changes.  Bookkeeping must flow from the checkpoint's
+    recorded meta (``topology``, ``chain_acc_starts``, ``fold_draws``).
+    Quiet by construction: recording live capacity INTO meta (a dict
+    literal), equality gates (ast.Compare), and per-process file
+    naming (plain call arguments) - only arithmetic (ast.BinOp) and
+    subscript bounds are carry/divisor flow."""
+    for fdef in ast.walk(mod.tree):
+        if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        low = fdef.name.lower()
+        if not any(h in low for h in _RESUME_HINTS):
+            continue
+        # one-hop taint: `n = jax.process_count()` then `total * n`
+        tainted: dict = {}
+        for node in ast.walk(fdef):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                site = _topology_site(mod, node.value)
+                if site:
+                    tainted[node.targets[0].id] = site
+        for node in ast.walk(fdef):
+            if isinstance(node, ast.BinOp):
+                exprs = [node.left, node.right]
+            elif isinstance(node, ast.Subscript):
+                exprs = [node.slice]
+            else:
+                continue
+            for expr in exprs:
+                for sub in ast.walk(expr):
+                    full = _topology_site(mod, sub)
+                    if not full and isinstance(sub, ast.Name):
+                        full = tainted.get(sub.id, "")
+                    if not full:
+                        continue
+                    rep.emit(
+                        "DCFM2001", sub,
+                        f"{full}() feeds carry-shape/divisor "
+                        f"arithmetic in '{fdef.name}' - elastic resume "
+                        "restarts a checkpoint on a DIFFERENT topology "
+                        "than the one that saved it, so window "
+                        "divisors and per-chain shapes must flow from "
+                        "the recorded checkpoint meta (topology / "
+                        "chain_acc_starts / fold_draws, via "
+                        "read_checkpoint_meta / elastic_meta), never "
+                        "from live capacity.  A sanctioned site "
+                        "carries an inline "
+                        "`# dcfm: ignore[DCFM2001] - <why>`")
+
+
+# =====================================================================
 # DCFM002 - stale suppressions
 # =====================================================================
 
@@ -1680,6 +1761,7 @@ def lint_source(source: str, path: str = "<string>",
     _check_precision_matmul(mod, rep)
     _check_partition_specs(mod, rep)
     _check_pointer_mutation(mod, rep)
+    _check_topology_constants(mod, rep)
     _check_stale_pragmas(mod, rep)      # must stay last: reads the ledger
     rep.findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return rep.findings

@@ -280,6 +280,20 @@ RULES = {r.id: r for r in [
          "promote_delta; a sanctioned exception carries an inline "
          "`# dcfm: ignore[DCFM1901] - <why>`",
          library_only=True),
+    # ---- DCFM20xx: elastic-resume topology discipline ----------------
+    Rule("DCFM2001", "topology-constant-in-resume-path", "topology",
+         "a live topology query (jax.device_count / jax.process_count "
+         "/ len(jax.devices())) feeding carry-shape or window-divisor "
+         "arithmetic inside a resume/checkpoint-path function - "
+         "elastic resume restarts a checkpoint on a DIFFERENT capacity "
+         "than the one that saved it, so shape and divisor bookkeeping "
+         "must flow from the checkpoint's recorded meta (topology / "
+         "chain_acc_starts / fold_draws).  Recording the live capacity "
+         "INTO that meta, comparing it in a gate, or naming a "
+         "per-process file with it is the sanctioned direction; a "
+         "deliberate exception carries an inline "
+         "`# dcfm: ignore[DCFM2001] - <why>`",
+         library_only=True),
 ]}
 
 

@@ -45,14 +45,15 @@ from dcfm_tpu.models.sampler import (
     run_chunk, schedule_array)
 from dcfm_tpu.models.state import num_upper_pairs, packed_pair_indices
 from dcfm_tpu.parallel.mesh import (
-    make_chain_mesh, make_mesh, shards_per_device)
+    legal_chain_grid, make_chain_mesh, make_mesh, shards_per_device)
 from dcfm_tpu.parallel.multihost import place_sharded_global
 from dcfm_tpu.parallel.shard import (
     build_mesh_chain, place_sharded, place_sharded_streaming)
 from dcfm_tpu.runtime.fetch import (
     accumulator_window, assemble_q8_sigma, cast_f32_jit, cast_for_link,
-    fetch_jit, fetch_sd_jit, owned_copy_jit, pool_chains, quant8_drain,
-    quant8_fetch_assemble, quant8_start, replicate_jit, upload_host_array)
+    elastic_pooled_draws, fetch_jit, fetch_sd_jit, owned_copy_jit,
+    pool_chains, quant8_drain, quant8_fetch_assemble, quant8_start,
+    replicate_jit, upload_host_array)
 from dcfm_tpu.runtime.pipeline import StreamingFetcher, run_chain
 from dcfm_tpu.runtime.resume import sidecar_esig
 from dcfm_tpu.utils.checkpoint import data_fingerprint
@@ -201,6 +202,13 @@ class FitResult:
     # iters_per_sec all reflect the truncated count.
     stopped_at_iter: Optional[int] = None
     rhat_trajectory: Optional[np.ndarray] = None
+    # Elastic resume (FitConfig.elastic; checkpoint meta v7): set when
+    # this fit adopted a checkpoint written on a different chain count -
+    # a dict of the adoption's bookkeeping (from_chains, to_chains,
+    # kept, dropped, birthed, fold_draws, chain_acc_starts,
+    # elastic_lineage, from_topology, to_topology).  None for a
+    # same-topology run.
+    elastic_resume: Optional[dict] = None
     # Flight-recorder run directory (FitConfig.obs; dcfm_tpu/obs): the
     # append-only JSONL event log of this fit - chunk boundaries, stream
     # snapshots/drains, checkpoint saves, sentinel rewinds, resume
@@ -754,21 +762,28 @@ def _fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
     n_pairs = num_upper_pairs(m.num_shards)
     P_shard = pre.data.shape[2]
 
-    def _window(acc_start: int, total: Optional[int] = None):
+    def _window(acc_start: int, total: Optional[int] = None,
+                elastic=None):
         # shared with the post-hoc epilogue - see accumulator_window's
         # docstring for why there is exactly one copy of this.  ``total``
         # overrides the window's END: an R-hat early stop truncates the
         # run at a chunk boundary, and the streamed fetch's final
         # divisor must count only the draws actually saved
         # (StreamingFetcher.truncate feeds the stop iteration here).
+        # ``elastic`` (runtime.resume.ElasticResume) carries the
+        # per-chain window starts + folded draws after an elastic
+        # resume; None keeps the uniform divisor bitwise.
         _, inv, bessel = accumulator_window(
             run.total_iters if total is None else total,
-            run.burnin, run.thin, acc_start, C)
+            run.burnin, run.thin, acc_start, C,
+            chain_acc_starts=(None if elastic is None
+                              else elastic.chain_acc_starts),
+            fold_draws=(0 if elastic is None else elastic.fold_draws))
         return inv, bessel
 
     streamer_factory = None
     if stream_on:
-        def streamer_factory(acc_start):
+        def streamer_factory(acc_start, elastic=None):
             land_mean = land_sd = None
             if cfg.stream_artifact:
                 # land straight in the serve artifact's memmap layout:
@@ -784,7 +799,8 @@ def _fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
             return StreamingFetcher(
                 fetch_jit(m.num_shards, C, "quant8", None), _window,
                 (n_pairs, P_shard, P_shard), acc_start,
-                sd_fn=sd_fn, land_mean=land_mean, land_sd=land_sd)
+                sd_fn=sd_fn, land_mean=land_mean, land_sd=land_sd,
+                elastic=elastic)
 
     t0 = time.perf_counter()
     with profile_ctx:
@@ -799,8 +815,8 @@ def _fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
             # from the GLOBAL chain index in both layouts, so the chains
             # themselves are identical; single-process only (the
             # multi-host mesh must span all processes' devices 1-D).
-            pack = (C > 1 and not multiproc and n_mesh % C == 0
-                    and m.num_shards % (n_mesh // C) == 0)
+            pack = legal_chain_grid(C, n_mesh, m.num_shards,
+                                    multiproc=multiproc)
             mesh = (make_chain_mesh(C, n_mesh, devices) if pack
                     else make_mesh(n_mesh, devices))
             shards_per_device(m.num_shards, mesh)  # validates divisibility
@@ -969,8 +985,12 @@ def _fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
         # the window divisor counts only the draws saved since.  The
         # SAME helper feeds the streamed fetch's window_fn - bitwise
         # interchangeability of the two paths depends on it.
+        el = rr.elastic
         n_saved, inv_count, bessel = accumulator_window(
-            done + executed, run.burnin, run.thin, acc_start, C)
+            done + executed, run.burnin, run.thin, acc_start, C,
+            chain_acc_starts=(None if el is None
+                              else el.chain_acc_starts),
+            fold_draws=(0 if el is None else el.fold_draws))
 
         Y_imputed = None
         # gated on the input actually having NaN entries: a user may
@@ -986,7 +1006,17 @@ def _fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
                 else carry.y_imp_acc), np.float32)
             if C > 1:
                 yi = pool_chains(yi)    # the chains' posterior means
-            rec = restore_data_matrix(yi / max(n_saved, 1), pre,
+            if el is not None:
+                # mixed-age chains + folded draws: the pooled mean is
+                # sum-over-everything / total_draws; pool_chains already
+                # divided by C, so the residual divisor is total/C
+                total = elastic_pooled_draws(
+                    done + executed, run.burnin, run.thin,
+                    el.chain_acc_starts, el.fold_draws)
+                y_div = max(total, 1) / C
+            else:
+                y_div = max(n_saved, 1)
+            rec = restore_data_matrix(yi / y_div, pre,
                                       destandardize=True)
             # observed entries are the caller's exact values; only the
             # NaN positions take the posterior-mean imputation
@@ -1212,6 +1242,8 @@ def _fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
         sentinel_rewinds=rewinds,
         stream_stats=stream_stats,
         artifact_path=artifact_path,
+        elastic_resume=(dataclasses.asdict(rr.elastic)
+                        if rr.elastic is not None else None),
         stopped_at_iter=rr.stopped_at_iter,
         rhat_trajectory=(np.asarray(rr.rhat_trajectory, np.float64)
                          if rr.rhat_trajectory is not None else None),

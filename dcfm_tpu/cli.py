@@ -394,6 +394,19 @@ def build_parser() -> argparse.ArgumentParser:
                         "incompatible checkpoint is a hard refusal, never "
                         "a silent restart (a same-topology resumed chain "
                         "is bitwise-identical to an uninterrupted one)")
+    f.add_argument("--elastic", dest="elastic", action="store_const",
+                   const=True, default="auto",
+                   help="always allow elastic adoption: a checkpoint "
+                        "written on a different chain count resumes onto "
+                        "--chains (surviving chains continue bitwise, "
+                        "dropped chains' draws fold into the pooled "
+                        "estimate, new chains birth on fresh RNG "
+                        "lineages).  The default ('auto') allows the "
+                        "same unless DCFM_NO_ELASTIC=1 is set")
+    f.add_argument("--no-elastic", dest="elastic", action="store_const",
+                   const=False,
+                   help="refuse (typed) a checkpoint whose chain count "
+                        "differs from --chains instead of adopting it")
     f.add_argument("--keep-last", type=int, default=1, metavar="K",
                    help="retain K checkpoint generations (the live file "
                         "plus K-1 rotated .bakN predecessors); >= 2 lets "
@@ -623,6 +636,7 @@ def main(argv=None) -> int:
         permute=not args.no_permute,
         checkpoint_path=args.checkpoint,
         resume=resume,
+        elastic=args.elastic,
         checkpoint_every_chunks=args.checkpoint_every,
         checkpoint_mode=args.checkpoint_mode,
         checkpoint_full_every=args.checkpoint_full_every,

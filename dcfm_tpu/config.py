@@ -400,6 +400,16 @@ class FitConfig:
     # config and it picks up where it died.
     checkpoint_path: Optional[str] = None
     resume: "bool | str" = False  # False | True | "auto"
+    # Elastic resume (ROADMAP 5(a)): may a checkpoint written at a
+    # DIFFERENT chain count be adopted onto this run's num_chains?
+    # Shrinking keeps the surviving chains' carries verbatim (their next
+    # draws bitwise-continue the donors) and folds the dropped chains'
+    # accumulated draws into the pooled running sums; growing births the
+    # extra chains on a fresh re-lineaged stream.  "auto" (default)
+    # adopts elastically unless the DCFM_NO_ELASTIC=1 environment veto
+    # is set (the supervisor's --no-elastic exports it to every child);
+    # True always adopts; False preserves the strict refusal.
+    elastic: "bool | str" = "auto"  # False | True | "auto"
     # Save every k-th chunk boundary (the final chunk always saves, so a
     # finished run stays resumable-as-noop).  Saves are write-behind
     # (utils/checkpoint.AsyncCheckpointWriter), but each snapshot still
@@ -613,6 +623,9 @@ def validate(cfg: FitConfig, n: int, p: int) -> None:
             f"resume must be False, True, or 'auto', got {cfg.resume!r}")
     if cfg.resume and not cfg.checkpoint_path:
         raise ValueError("resume requires checkpoint_path")
+    if cfg.elastic not in (False, True, "auto"):
+        raise ValueError(
+            f"elastic must be False, True, or 'auto', got {cfg.elastic!r}")
     cek = cfg.checkpoint_every_chunks
     if not (cek == "auto" or (isinstance(cek, int) and cek >= 1)):
         raise ValueError(

@@ -53,6 +53,25 @@ def summarize(run_dir: str, events=None, torn: int = 0) -> dict:
                 "iteration": e.get("iteration"),
                 "acc_start": e.get("acc_start")}
                for e in by.get("resume_decision", [])]
+    # elastic adoptions (runtime/resume._try_elastic) and the
+    # supervisor's relaunch capacity probes: the topology-change trail
+    # beside the resume decisions
+    elastics = [{"role": e.get("role"), "decision": e.get("decision"),
+                 "from_chains": e.get("from_chains"),
+                 "to_chains": e.get("to_chains"),
+                 "kept": e.get("kept"), "dropped": e.get("dropped"),
+                 "birthed": e.get("birthed"),
+                 "fold_draws": e.get("fold_draws"),
+                 "iteration": e.get("iteration"),
+                 "reason": e.get("reason"),
+                 "from_topology": e.get("from_topology"),
+                 "to_topology": e.get("to_topology")}
+                for e in by.get("elastic_resume", [])]
+    capacity_probes = [{"recorded_topology": e.get("recorded_topology"),
+                        "current_topology": e.get("current_topology"),
+                        "degraded": e.get("degraded"),
+                        "posture": e.get("posture")}
+                       for e in by.get("elastic_capacity", [])]
     faults = [{k: v for k, v in e.items()
                if k in ("op", "when", "event_name", "at_iteration",
                         "iteration", "target", "path", "write", "role")}
@@ -153,6 +172,8 @@ def summarize(run_dir: str, events=None, torn: int = 0) -> dict:
         "last_checkpoint_iteration": (saves[-1].get("iteration")
                                       if saves else None),
         "resume_decisions": resumes,
+        "elastic_resumes": elastics,
+        "elastic_capacity_probes": capacity_probes,
         "sentinel_rewinds": rewinds,
         "early_stops": early_stops,
         "faults_injected": faults,
@@ -215,6 +236,27 @@ def _print_summary(s: dict, out: List[str]) -> None:
         out.append(f"resume decision [{r['role']}]: {r['decision']} at "
                    f"iteration {r['iteration']} "
                    f"(acc_start {r['acc_start']})")
+    for e in s.get("elastic_resumes", ()):
+        ft, tt = e.get("from_topology") or {}, e.get("to_topology") or {}
+        topo = (f" [{ft.get('num_chains')}x{ft.get('num_devices')}"
+                f" -> {tt.get('num_chains')}x{tt.get('num_devices')}]"
+                if ft or tt else "")
+        if e["decision"] == "elastic":
+            out.append(
+                f"elastic resume [{e['role']}]: {e['from_chains']} -> "
+                f"{e['to_chains']} chains at iteration "
+                f"{e['iteration']} (kept {e['kept']}, dropped "
+                f"{e['dropped']}, birthed {e['birthed']}, folded "
+                f"{e['fold_draws']} draws into the pool){topo}")
+        else:
+            out.append(f"elastic resume [{e['role']}]: refused "
+                       f"({e.get('reason')}){topo}")
+    for c in s.get("elastic_capacity_probes", ()):
+        if c.get("degraded"):
+            out.append(
+                "capacity probe: topology changed "
+                f"{c['recorded_topology']} -> {c['current_topology']} "
+                f"(posture: {c['posture']})")
     for r in s["sentinel_rewinds"]:
         out.append(f"sentinel rewind: iteration {r['iteration']} -> "
                    f"{r['to_iteration']}")

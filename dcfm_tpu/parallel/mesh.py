@@ -142,6 +142,23 @@ def make_chain_mesh(num_chains: int, num_devices: int = 0,
     return Mesh(grid, (CHAIN_AXIS, SHARD_AXIS))
 
 
+def legal_chain_grid(num_chains: int, num_devices: int,
+                     num_shards: int, *, multiproc: bool = False) -> bool:
+    """True when a packed 2-D (chains x shards) mesh is legal for this
+    C x N topology: C > 1 chain rows dividing the N-device mesh evenly,
+    with the g shards dividing each row's N/C devices.  THE one seam the
+    pack decision (api.fit) and an elastic resume's re-layout both go
+    through - a checkpoint taken on any C x N grid restarts on any
+    C' x N' for which this predicate holds (and falls back to the vmap
+    layout otherwise, which is always legal).  Multi-process runs keep
+    the 1-D global mesh: the multi-host mesh must span all processes'
+    devices on the shard axis.
+    """
+    return (num_chains > 1 and not multiproc
+            and num_devices % num_chains == 0
+            and num_shards % (num_devices // num_chains) == 0)
+
+
 def chain_rows(mesh: Mesh) -> int:
     """Size of the chain mesh axis (1 on a plain 1-D shard mesh)."""
     return mesh.shape.get(CHAIN_AXIS, 1) if CHAIN_AXIS in mesh.axis_names \

@@ -121,6 +121,17 @@ _VALID_OPS = {"kill", "kill_event", "poison_state", "torn_write",
 FUZZ_EVENTS = ("resume_gate", "resume_gate_post", "sidecar_gate",
                "sidecar_load", "sidecar_commit", "sidecar_commit_post")
 
+# Elastic-resume events (runtime/resume._try_elastic): ``elastic_gate``
+# fires after the adoption decision, ``elastic_fold`` between the fresh
+# carry init and the donor load/fold, ``elastic_fold_post`` after the
+# fold completed.  The fold only READS the donor checkpoint, so a
+# SIGKILL anywhere in the window leaves the old generation intact - the
+# relaunch either re-adopts cleanly or refuses typed, never resumes a
+# half-folded (mis-divided) accumulator.  ``elastic_fuzz_spec`` sweeps
+# kills over these windows; DCFM_FAULT_FUZZ=seed:index:elastic selects
+# that stream.
+ELASTIC_EVENTS = ("elastic_gate", "elastic_fold", "elastic_fold_post")
+
 
 class FaultPlanError(ValueError):
     """Malformed DCFM_FAULT_PLAN."""
@@ -179,11 +190,13 @@ class FaultPlan:
             fuzz = os.environ.get(FUZZ_ENV_VAR)
             if not fuzz:
                 return None
-            m = re.match(r"^(-?\d+):(\d+)$", fuzz.strip())
+            m = re.match(r"^(-?\d+):(\d+)(:elastic)?$", fuzz.strip())
             if not m:
                 raise FaultPlanError(
-                    f"{FUZZ_ENV_VAR} must be 'seed:index', got {fuzz!r}")
-            return cls(fuzz_spec(int(m.group(1)), int(m.group(2))))
+                    f"{FUZZ_ENV_VAR} must be 'seed:index[:elastic]', "
+                    f"got {fuzz!r}")
+            gen = elastic_fuzz_spec if m.group(3) else fuzz_spec
+            return cls(gen(int(m.group(1)), int(m.group(2))))
         if raw.startswith("@"):
             with open(raw[1:], "r", encoding="utf-8") as f:
                 raw = f.read()
@@ -451,6 +464,31 @@ def fuzz_spec(seed: int, index: int, *,
         faults.append({"op": "kill_event", "event": rng.choice(list(events)),
                        "at_occurrence": 1, "at_launch": 2,
                        "process": rng.randrange(nproc)})
+    return {"faults": faults}
+
+
+def elastic_fuzz_spec(seed: int, index: int, *,
+                      boundaries=(2, 4, 6, 8),
+                      events=ELASTIC_EVENTS) -> dict:
+    """The ``index``-th crash point of the ELASTIC fuzz stream
+    (``DCFM_FAULT_FUZZ=seed:index:elastic``): launch 1 dies at a random
+    checkpointing boundary, and launch 2 - which the harness runs on a
+    DIFFERENT chain count, so its resume goes through the elastic
+    adoption - is usually killed inside a random ``ELASTIC_EVENTS``
+    window (sometimes not at all, so clean adoptions are swept too).
+    Launch 3 (or 2) must finish with an intact pooled Sigma: the fold
+    only reads the donor file, so every kill point leaves a resumable
+    generation behind.  Single-process by construction - no process
+    gates (the elastic fold is a single-host operation; multi-process
+    donors adopt through the set-donor path on one process)."""
+    rng = random.Random(f"dcfm-elastic-fuzz:{int(seed)}:{int(index)}")
+    boundaries = tuple(int(b) for b in boundaries)
+    faults = [{"op": "kill", "when": "post_save",
+               "at_iteration": rng.choice(boundaries), "at_launch": 1}]
+    if rng.random() < 0.75:
+        faults.append({"op": "kill_event",
+                       "event": rng.choice(list(events)),
+                       "at_occurrence": 1, "at_launch": 2})
     return {"faults": faults}
 
 

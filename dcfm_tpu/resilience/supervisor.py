@@ -212,6 +212,49 @@ def _progress_iteration(path: str) -> int:
     return best
 
 
+def _capacity_probe(checkpoint_path: str, num_processes: int,
+                    rec, log: Callable[[str], None]) -> None:
+    """Relaunch capacity probe (elastic resume): compare the newest
+    readable generation's RECORDED topology (checkpoint meta v7) with
+    the capacity this supervisor is relaunching on, and narrate the
+    elastic posture instead of letting a mismatch die silently in the
+    child.  Deliberately jax-free like every parent-side probe: the
+    current device count is only known when the launcher exported
+    ``DCFM_DEVICE_COUNT`` (clamped-capacity relaunches do); otherwise
+    the probe compares process counts alone.  The DECISION stays in the
+    child's resume gate - with ``FitConfig.elastic`` allowing it the
+    child adopts the checkpoint onto its configured chain count, with
+    ``--no-elastic`` (``DCFM_NO_ELASTIC=1``) it refuses typed - the
+    probe's ``elastic_capacity`` event is the supervisor-side record of
+    which posture the relaunch went in with."""
+    from dcfm_tpu.utils.checkpoint import read_checkpoint_meta
+    recorded = None
+    try:
+        recorded = read_checkpoint_meta(checkpoint_path).get("topology")
+    except Exception:  # dcfm: ignore[DCFM601] - absent/corrupt/pre-v7 file: nothing to compare against
+        pass
+    if recorded is None:
+        return
+    env_dev = os.environ.get("DCFM_DEVICE_COUNT")
+    current = {"num_processes": int(num_processes),
+               "num_devices": int(env_dev) if env_dev else None}
+    degraded = (int(recorded.get("num_processes", 1)) != num_processes
+                or (current["num_devices"] is not None
+                    and current["num_devices"]
+                    != recorded.get("num_devices")))
+    posture = ("disabled" if os.environ.get("DCFM_NO_ELASTIC") == "1"
+               else "elastic")
+    rec.emit("elastic_capacity", recorded_topology=recorded,
+             current_topology=current, degraded=degraded,
+             posture=posture)
+    if degraded:
+        log(f"capacity changed vs checkpoint topology {recorded} -> "
+            f"{current}; children "
+            + ("will refuse adoption (--no-elastic)"
+               if posture == "disabled"
+               else "resume elastically on surviving capacity"))
+
+
 def _unanimous_iteration(per_slot_holdings) -> int:
     """THE one encoding of the unanimously-held-generation rule: the
     newest iteration present in EVERY slot's holdings (any iterable of
@@ -586,6 +629,7 @@ def _supervision_loop(
 
     while True:
         it_before = _pre_pass()
+        _capacity_probe(checkpoint_path, num_processes, rec, log)
         report.launches += 1
         rec.emit("supervisor_launch", attempt=report.launches,
                  checkpoint_iteration=it_before,
@@ -867,7 +911,8 @@ def run_supervised_cli(child_argv: list, *, checkpoint: str,
                        backoff_max: float = 60.0,
                        poison_deaths: int = 2,
                        launch_timeout: Optional[float] = None,
-                       pod: int = 0, port_base: int = 29900) -> int:
+                       pod: int = 0, port_base: int = 29900,
+                       no_elastic: bool = False) -> int:
     """The ONE home of the CLI supervision protocol, shared by
     ``dcfm-tpu fit --supervise`` and ``dcfm-tpu supervise``: run the
     dcfm-tpu subcommand ``child_argv`` under :func:`supervise_command`
@@ -881,6 +926,12 @@ def run_supervised_cli(child_argv: list, *, checkpoint: str,
     stderr; returns the process exit code (0 success, 3
     poisoned/exhausted/hung)."""
     argv = [sys.executable, "-m", "dcfm_tpu.cli"] + list(child_argv)
+    if no_elastic:
+        # the escape hatch: every child (which inherits this process's
+        # environment through both spawn paths) sees the veto and its
+        # resume gate refuses a topology-changed checkpoint typed
+        # instead of adopting it (FitConfig.elastic="auto" honors it)
+        os.environ["DCFM_NO_ELASTIC"] = "1"
     try:
         if pod > 1:
             def spawn(attempt: int) -> list:
@@ -962,6 +1013,11 @@ def supervise_cli(argv: list) -> int:
                    help="pod mode: coordinator port for attempt k is "
                         "port-base + k (a fresh port per relaunch never "
                         "races the dead coordinator's socket)")
+    p.add_argument("--no-elastic", action="store_true",
+                   help="veto elastic adoption: children refuse (typed) "
+                        "a checkpoint written on a different chain "
+                        "count instead of adopting it onto the current "
+                        "capacity (exports DCFM_NO_ELASTIC=1)")
     p.add_argument("command", nargs=argparse.REMAINDER,
                    help="the dcfm-tpu command to supervise (a leading "
                         "'--' separator is accepted)")
@@ -989,4 +1045,5 @@ def supervise_cli(argv: list) -> int:
         backoff_base=args.backoff, backoff_max=args.backoff_max,
         poison_deaths=args.poison_deaths,
         launch_timeout=args.watchdog or None,
-        pod=args.pod, port_base=args.port_base)
+        pod=args.pod, port_base=args.port_base,
+        no_elastic=args.no_elastic)

@@ -36,19 +36,59 @@ from dcfm_tpu.utils.estimate import (
 from dcfm_tpu.utils.preprocess import PreprocessResult
 
 
+def elastic_pooled_draws(total_iters: int, burnin: int, thin: int,
+                         chain_acc_starts, fold_draws: int = 0) -> int:
+    """Total saved draws the pooled accumulators hold after an elastic
+    resume: each chain's own window ``(acc_start_c, total_iters]`` plus
+    the draws folded in from dropped chains (``fold_draws``, recorded in
+    checkpoint meta v7).  Integer-exact by construction - the divisor
+    bookkeeping never rounds."""
+    return fold_draws + sum(
+        num_saved_draws(total_iters, burnin, thin)
+        - num_saved_draws(int(a), burnin, thin)
+        for a in chain_acc_starts)
+
+
 def accumulator_window(total_iters: int, burnin: int, thin: int,
-                       acc_start: int, num_chains: int):
+                       acc_start: int, num_chains: int,
+                       chain_acc_starts=None, fold_draws: int = 0):
     """``(n_saved, inv_count, bessel)`` for the accumulator window
     ``(acc_start, total_iters]`` - the ONE encoding of the divisor the
     fetch jits quantize with.  Both the streamed fetch (via
     ``StreamingFetcher``'s window_fn) and the post-hoc epilogue call
     THIS helper: the streamed==post-hoc bitwise contract requires the
     two paths to feed the jits identical float32 divisors, so the
-    computation must not exist twice."""
+    computation must not exist twice.
+
+    ``chain_acc_starts`` / ``fold_draws`` (elastic resume, checkpoint
+    meta v7): per-chain window starts for mixed-age chains plus draws
+    folded in from dropped chains.  The fetch jits compute
+    ``mean-over-chains * inv_count``, so the elastic inv_count is
+    ``num_chains / total_draws`` - pooled Sigma is the running sum over
+    EVERY draw ever taken divided by that exact count.  The uniform
+    case (all starts equal, nothing folded) reduces to the original
+    arithmetic bitwise (``C/(C*n)`` and ``1/n`` are the same correctly
+    rounded float), so non-elastic runs are untouched."""
     n_saved = (num_saved_draws(total_iters, burnin, thin)
                - num_saved_draws(acc_start, burnin, thin))
-    inv_count = np.float32(1.0 / max(n_saved, 1))
-    n_draws = max(n_saved * num_chains, 1)
+    if chain_acc_starts is None and not fold_draws:
+        inv_count = np.float32(1.0 / max(n_saved, 1))
+        n_draws = max(n_saved * num_chains, 1)
+        bessel = np.float32(n_draws / (n_draws - 1) if n_draws > 1 else 1.0)
+        return n_saved, inv_count, bessel
+    if chain_acc_starts is None:
+        chain_acc_starts = [acc_start] * num_chains
+    total_draws = elastic_pooled_draws(total_iters, burnin, thin,
+                                       chain_acc_starts, fold_draws)
+    # n_saved stays the WIDEST chain's window: callers use it only to
+    # gate "are there draws at all" and the oldest surviving chain's
+    # window is exactly that
+    n_saved = max(n_saved, max(
+        (num_saved_draws(total_iters, burnin, thin)
+         - num_saved_draws(int(a), burnin, thin))
+        for a in chain_acc_starts))
+    inv_count = np.float32(num_chains / max(total_draws, 1))
+    n_draws = max(total_draws, 1)
     bessel = np.float32(n_draws / (n_draws - 1) if n_draws > 1 else 1.0)
     return n_saved, inv_count, bessel
 

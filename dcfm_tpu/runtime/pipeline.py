@@ -180,12 +180,18 @@ class StreamingFetcher:
                  sd_fn: Optional[Callable] = None,
                  land_mean: Optional[np.ndarray] = None,
                  land_sd: Optional[np.ndarray] = None,
-                 max_inflight: int = 2, n_slices: int = 8):
+                 max_inflight: int = 2, n_slices: int = 8,
+                 elastic: Any = None):
         self._mean_fn = mean_fn
         self._sd_fn = sd_fn
         self._window_fn = window_fn
         self._acc_start = acc_start
-        self._inv_count, self._bessel = window_fn(acc_start)
+        # elastic bookkeeping (runtime.resume.ElasticResume or None):
+        # forwarded to window_fn as a keyword ONLY when set, so plain
+        # window_fn callables (tests, non-elastic runs) keep their
+        # historical (acc_start[, total]) signature
+        self._elastic = elastic
+        self._inv_count, self._bessel = self._window(acc_start)
         self._shape = tuple(shape)
         self._n_slices = n_slices
         self.land_mean = (np.empty(self._shape, np.int8)
@@ -226,13 +232,29 @@ class StreamingFetcher:
         double-buffer saturation."""
         return self._error is not None
 
-    def reset_window(self, acc_start: int) -> None:
+    def _window(self, acc_start: int, total: Optional[int] = None):
+        """Invoke window_fn, forwarding elastic bookkeeping as a keyword
+        only when present so legacy (acc_start[, total]) callables keep
+        working unchanged."""
+        args = (acc_start,) if total is None else (acc_start, total)
+        if self._elastic is not None:
+            return self._window_fn(*args, elastic=self._elastic)
+        return self._window_fn(*args)
+
+    _UNSET = object()
+
+    def reset_window(self, acc_start: int, elastic: Any = _UNSET) -> None:
         """Sentinel rewind moved the accumulation window: recompute the
         final divisor.  Already-queued snapshots of the pre-rewind
         accumulator drain harmlessly - snapshot semantics mean every
-        stale landing is superseded by the final boundary's."""
+        stale landing is superseded by the final boundary's.  A rewind
+        may also land on a generation with DIFFERENT elastic bookkeeping
+        (pre-adoption file -> None); passing ``elastic`` replaces the
+        stored record, omitting it keeps the current one."""
         self._acc_start = acc_start
-        self._inv_count, self._bessel = self._window_fn(acc_start)
+        if elastic is not StreamingFetcher._UNSET:
+            self._elastic = elastic
+        self._inv_count, self._bessel = self._window(acc_start)
 
     def truncate(self, total_iters: int) -> None:
         """Early stop moved the window's END: recompute the final
@@ -240,7 +262,7 @@ class StreamingFetcher:
         accept ``(acc_start, total_iters)`` - api.fit's does).  The
         stop boundary's FINAL snapshot is the first submit after this
         call, so every already-queued landing is superseded as usual."""
-        self._inv_count, self._bessel = self._window_fn(
+        self._inv_count, self._bessel = self._window(
             self._acc_start, total_iters)
 
     def submit(self, acc, sq_acc=None, *, final: bool = False) -> bool:
@@ -374,6 +396,12 @@ class ChainRunResult:
     # donated carry round-trips the chunk jit with its placement
     # pinned, so every boundary aliases instead of copying.
     relayouts: int = 0
+    # Elastic resume bookkeeping (runtime.resume.ElasticResume or None):
+    # set when this run adopted a checkpoint written on a different
+    # chain count (or re-loaded one that had) - the epilogue's pooled
+    # divisor and Y_imputed normalisation must use its per-chain window
+    # starts + folded-draw count instead of the uniform window.
+    elastic: Any = None
 
 
 def early_stop_metrics(traces: list, trace0: int, burnin: int):
@@ -552,8 +580,14 @@ def run_chain(*, cfg, model, run, sched, phase: dict, multiproc: bool,
     # known (the final window divisor depends on acc_start); a no-op
     # resume (executed == 0) never streams - the epilogue's post-hoc
     # fetch serves it.
-    streamer = (streamer_factory(acc_start)
-                if streamer_factory is not None and executed else None)
+    # An elastic adoption changes the pooled window (per-chain starts +
+    # folded draws); the factory is only handed the record when one
+    # exists so single-arg factories (tests) keep working.
+    streamer = None
+    if streamer_factory is not None and executed:
+        streamer = (streamer_factory(acc_start, rctx.elastic)
+                    if rctx.elastic is not None
+                    else streamer_factory(acc_start))
     queue_ = chunk_schedule(executed, chunk)
     qi = 0
     # R-hat early stop (RunConfig.early_stop="rhat"): a HOST-side,
@@ -691,7 +725,10 @@ def run_chain(*, cfg, model, run, sched, phase: dict, multiproc: bool,
                 m_active = dataclasses.replace(
                     m_active, ridge_jitter=sentinel.escalated_jitter())
                 if streamer is not None:
-                    streamer.reset_window(acc_start)
+                    # the rewound generation carries its OWN elastic
+                    # record (rewind_source refreshed rctx.elastic from
+                    # that file's meta; a pre-adoption file -> None)
+                    streamer.reset_window(acc_start, elastic=rctx.elastic)
                 queue_ = chunk_schedule(run.total_iters - it_now, chunk)
                 qi = 0
                 since_save = 0
@@ -704,6 +741,10 @@ def run_chain(*, cfg, model, run, sched, phase: dict, multiproc: bool,
                 draws_so_far = (
                     num_saved_draws(it_now, run.burnin, run.thin)
                     - num_saved_draws(acc_start, run.burnin, run.thin))
+                if rctx.elastic is not None:
+                    # folded draws from dropped chains live in the
+                    # accumulator even before this run saves anything
+                    draws_so_far += rctx.elastic.fold_draws
                 if last or draws_so_far > 0:
                     fault_event("stream_submit")
                     try:
@@ -806,12 +847,32 @@ def run_chain(*, cfg, model, run, sched, phase: dict, multiproc: bool,
                           if full_due and not last
                           else cfg.checkpoint_path)
                 t_ck = time.perf_counter()
+                # elastic bookkeeping rides every NON-light save: the
+                # per-chain window starts, folded-draw count and lineage
+                # counter are what make the next resume's divisor (and a
+                # further elastic adoption) correct.  Light saves drop
+                # the accumulators, so their resume restarts a uniform
+                # window - recording the defaults there is correct.
+                # Read rctx.elastic at submit time: a sentinel rewind
+                # may have replaced it since the streamer was built.
+                ek = {}
+                if rctx.elastic is not None:
+                    # the birth-lineage counter rides EVERY save (a light
+                    # resume must not rewind it); the window bookkeeping
+                    # only rides saves that keep the accumulators
+                    ek = dict(
+                        elastic_lineage=rctx.elastic.elastic_lineage)
+                    if not (light_mode and not full_due):
+                        ek.update(
+                            chain_acc_starts=list(
+                                rctx.elastic.chain_acc_starts),
+                            fold_draws=rctx.elastic.fold_draws)
                 try:
                     writer.submit(save_fn, target, carry, cfg,
                                   fingerprint=fingerprint,
                                   state_only=light_mode and not full_due,
                                   acc_start=acc_start,
-                                  keep_last=cfg.checkpoint_keep_last)
+                                  keep_last=cfg.checkpoint_keep_last, **ek)
                     saved_this_boundary = True
                 except Exception as e:
                     # submit joins the previous save; see _save_failure
@@ -873,4 +934,4 @@ def run_chain(*, cfg, model, run, sched, phase: dict, multiproc: bool,
         rewinds=sentinel.rewinds if sentinel is not None else 0,
         trace0=trace0, streamer=streamer,
         stopped_at_iter=stopped_at, rhat_trajectory=rhat_traj,
-        relayouts=relayouts)
+        relayouts=relayouts, elastic=rctx.elastic)

@@ -36,21 +36,88 @@ from dcfm_tpu.obs.recorder import record
 from dcfm_tpu.resilience.faults import fault_event
 from dcfm_tpu.utils.checkpoint import (
     _verify_crc, checkpoint_compatible, config_from_checkpoint_meta,
-    discover_checkpoint, load_checkpoint, load_checkpoint_multiprocess,
+    discover_checkpoint, elastic_meta, load_checkpoint,
+    load_checkpoint_elastic, load_checkpoint_multiprocess,
     load_checkpoint_resharded, proc_path, read_checkpoint_meta,
     retained_checkpoints)
+
+
+@dataclasses.dataclass(frozen=True)
+class ElasticResume:
+    """One elastic adoption's bookkeeping - what the resumed run must
+    thread into its fetch divisor (runtime.fetch.accumulator_window) and
+    every subsequent checkpoint save (the v7 meta fields), so pooled
+    Sigma stays exact across further crashes and rewinds."""
+
+    from_chains: int
+    to_chains: int
+    kept: int
+    dropped: int
+    birthed: int
+    fold_draws: int
+    chain_acc_starts: tuple
+    elastic_lineage: int
+    from_topology: Optional[dict] = None
+    to_topology: Optional[dict] = None
 
 
 @dataclasses.dataclass
 class ResumeContext:
     """The slice of fit() state the resume gates close over: the config,
     the data fingerprint the checkpoint must match, whether this is a
-    multi-process SPMD run, and the init key (shape-only uses)."""
+    multi-process SPMD run, and the init key (shape-only uses).
+
+    ``elastic`` is an OUT field: the gates return their historical
+    ``(carry, done, acc_start)`` 3-tuple (callers and test seams pin
+    that contract), and any elastic bookkeeping - a fresh adoption, or
+    the carried-over state of a v7 checkpoint that was itself saved
+    after one - is written here for the pipeline to read after the
+    call.  None means the uniform divisor path."""
 
     cfg: FitConfig
     fingerprint: Optional[str]
     multiproc: bool
     k_init: Any
+    elastic: Optional[ElasticResume] = None
+
+
+def _elastic_allowed(cfg: FitConfig) -> bool:
+    """May this run adopt a chain-count-mismatched checkpoint?  True,
+    or "auto" without the supervisor's DCFM_NO_ELASTIC=1 veto."""
+    el = getattr(cfg, "elastic", "auto")
+    if el is True:
+        return True
+    return el == "auto" and os.environ.get("DCFM_NO_ELASTIC") != "1"
+
+
+def _run_topology_now(cfg: FitConfig) -> dict:
+    """The CURRENT capacity, for the flight-recorder event only - the
+    divisor/shape bookkeeping always flows from checkpoint meta."""
+    return {"num_chains": int(cfg.run.num_chains),
+            "num_devices": jax.device_count(),
+            "num_processes": jax.process_count()}
+
+
+def _elastic_carryover(meta: dict,
+                       cfg: FitConfig) -> Optional[ElasticResume]:
+    """The elastic state a SAME-chain-count resume of a v7 checkpoint
+    must keep threading: non-uniform per-chain window starts (mixed-age
+    chains after a grow) or a non-zero folded draw count (after a
+    shrink).  None for the uniform case - including every v6 file."""
+    C = int(cfg.run.num_chains)
+    starts, fold, lineage = elastic_meta(meta, C)
+    # lineage > 0 with a uniform window still needs carrying: the birth
+    # counter must never rewind, or a later grow could replay a previous
+    # birth's init key (uniform starts make the elastic divisor reduce
+    # to the uniform one, so keeping the record costs nothing)
+    if not fold and len(set(starts)) <= 1 and not lineage:
+        return None
+    return ElasticResume(
+        from_chains=C, to_chains=C, kept=C, dropped=0, birthed=0,
+        fold_draws=int(fold), chain_acc_starts=tuple(starts),
+        elastic_lineage=int(lineage),
+        from_topology=meta.get("topology"),
+        to_topology=_run_topology_now(cfg))
 
 
 def sidecar_esig(elig) -> np.ndarray:
@@ -146,6 +213,7 @@ def _try_full_sidecar(ctx: ResumeContext, template, light_kept: int):
         else:
             carry, smeta = load_checkpoint_resharded(source[1][1],
                                                      template)
+        ctx.elastic = _elastic_carryover(smeta, ctx.cfg)
         return carry, int(smeta["iteration"]), s_acc0
     except Exception:  # dcfm: ignore[DCFM601] - sidecar load is best-effort; caller falls back to light resume
         return None
@@ -162,13 +230,19 @@ def _warm_incompatible(meta: dict, cfg: FitConfig) -> Optional[str]:
     and the same model up to ``num_shards`` (the one model field that
     grows when a new feature shard arrives - K, prior family, and the
     adapt schedule shape the state pytree itself)."""
-    if int(meta["version"]) != 6:
+    if int(meta["version"]) not in (6, 7):
         return (f"donor checkpoint is format v{meta['version']}, "
-                "warm start requires v6")
+                "warm start requires v6/v7")
     old = config_from_checkpoint_meta(meta)
-    if old.run.num_chains != cfg.run.num_chains:
+    if old.run.num_chains != cfg.run.num_chains and (
+            old.run.num_chains == 1 or cfg.run.num_chains == 1):
+        # a chain-count change is tolerated between multi-chain runs
+        # (extra donor rows are sliced off, missing rows keep the fresh
+        # init via the origin-block graft) - but the chain axis itself
+        # appears/disappears at 1, so there is no graft geometry there
         return (f"donor ran {old.run.num_chains} chains, this run "
-                f"{cfg.run.num_chains} - the state graft is per-chain")
+                f"{cfg.run.num_chains} - the chain axis appears/"
+                "disappears at num_chains=1, no graft geometry")
     if dataclasses.replace(old.model,
                            num_shards=cfg.model.num_shards) != cfg.model:
         return ("donor model config differs beyond num_shards - the "
@@ -225,6 +299,15 @@ def _try_warm_start(ctx: ResumeContext, init_fn, Yd):
             return None
         fresh = init_fn(ctx.k_init, Yd)
         s_leaves, s_def = jax.tree.flatten(fresh.state)
+        # topology change between online cycles (elastic posture): a
+        # donor with MORE chains seeds this run from its first
+        # cfg.run.num_chains rows (every state leaf is chain-major when
+        # num_chains > 1); fewer donor chains need no slice - the
+        # origin-block graft leaves the extra fresh rows on their cold
+        # init
+        donor_chains = config_from_checkpoint_meta(meta).run.num_chains
+        chain_slice = (cfg.run.num_chains
+                       if donor_chains > cfg.run.num_chains else None)
         grafted, verbatim = [], 0
         with np.load(ws.checkpoint) as z:
             # donor Lambda is leaf_0: refuse up front if the per-shard
@@ -243,6 +326,8 @@ def _try_warm_start(ctx: ResumeContext, init_fn, Yd):
                 name = f"leaf_{i}"
                 arr = z[name]
                 _verify_crc(meta, name, arr, ws.checkpoint)
+                if chain_slice is not None:
+                    arr = arr[:chain_slice]
                 g = _graft_state_leaf(arr, fl)
                 verbatim += int(arr.shape == tuple(np.shape(fl)))
                 grafted.append(jax.device_put(g, fl.sharding))
@@ -261,6 +346,79 @@ def _try_warm_start(ctx: ResumeContext, init_fn, Yd):
         return None
 
 
+def _try_elastic(ctx: ResumeContext, init_fn, Yd, *, kind, found,
+                 meta) -> Optional[tuple]:
+    """Elastic adoption of a chain-count-mismatched checkpoint
+    -> (carry, done, acc_start) with ``ctx.elastic`` set, or None when
+    the donor is not elastically adoptable (the caller falls back to
+    the strict refusal / fresh start).
+
+    Only runs when the chain count is the SOLE incompatibility
+    (checkpoint_compatible with ignore_chains=True returns None) and
+    FitConfig.elastic allows it.  The ``elastic_gate`` /
+    ``elastic_fold`` / ``elastic_fold_post`` fault seams bracket the
+    decision and the fold for the seeded fuzz harness - the fold only
+    READS the donor file, so a kill anywhere in the window leaves the
+    old generation intact and the relaunch simply re-adopts."""
+    cfg, run = ctx.cfg, ctx.cfg.run
+    if not _elastic_allowed(cfg):
+        return None
+    try:
+        if checkpoint_compatible(meta, cfg, ctx.fingerprint,
+                                 ignore_chains=True) is not None:
+            return None     # more than the chain count differs
+        donor_chains = int(
+            config_from_checkpoint_meta(meta).run.num_chains)
+        if donor_chains == run.num_chains:
+            return None     # not a chain mismatch at all
+    except Exception:  # dcfm: ignore[DCFM601] - unreadable donor config: not elastically adoptable
+        return None
+    # crash seam BEFORE the decision commits to anything
+    fault_event("elastic_gate")
+    try:
+        template = jax.eval_shape(init_fn, ctx.k_init, Yd)
+        _, _, lineage = elastic_meta(meta, donor_chains)
+        new_lineage = int(lineage) + 1
+        fresh = None
+        if run.num_chains > donor_chains:
+            # birth rows from a RE-LINEAGED init: fold_in of the bumped
+            # lineage counter, so a birthed chain never replays any
+            # donor's stream (and a chain re-birthed after a second
+            # elastic resume never replays a previous birth's)
+            fresh = init_fn(
+                jax.random.fold_in(ctx.k_init, new_lineage), Yd)
+        fault_event("elastic_fold")
+        carry, meta, info = load_checkpoint_elastic(
+            cfg.checkpoint_path, template, run.num_chains,
+            fresh_carry=fresh,
+            paths=None if kind == "plain" else found[1])
+        fault_event("elastic_fold_post")
+    except Exception as e:
+        record("elastic_resume", decision="refused",
+               reason=f"{type(e).__name__}: {e}")
+        return None
+    it = int(meta["iteration"])
+    starts = info["chain_acc_starts"]
+    acc0 = min(starts) if starts else it
+    ctx.elastic = ElasticResume(
+        from_chains=info["from_chains"], to_chains=info["to_chains"],
+        kept=info["kept"], dropped=info["dropped"],
+        birthed=info["birthed"], fold_draws=info["fold_draws"],
+        chain_acc_starts=tuple(starts), elastic_lineage=new_lineage,
+        from_topology=info.get("from_topology"),
+        to_topology=_run_topology_now(cfg))
+    record("elastic_resume", decision="elastic",
+           from_chains=info["from_chains"], to_chains=info["to_chains"],
+           kept=info["kept"], dropped=info["dropped"],
+           birthed=info["birthed"], fold_draws=info["fold_draws"],
+           elastic_lineage=new_lineage, iteration=it, acc_start=acc0,
+           from_topology=info.get("from_topology"),
+           to_topology=_run_topology_now(cfg))
+    record("resume_decision", decision="elastic", iteration=it,
+           acc_start=acc0)
+    return carry, it, acc0
+
+
 def resume_state(ctx: ResumeContext, init_fn, Yd):
     """-> (carry, done, acc_start).  resume=True demands a compatible
     checkpoint; resume="auto" (elastic recovery) falls back to a fresh
@@ -273,6 +431,7 @@ def resume_state(ctx: ResumeContext, init_fn, Yd):
     """
     cfg, run = ctx.cfg, ctx.cfg.run
     auto = cfg.resume == "auto"
+    ctx.elastic = None
     source = None
     if cfg.resume:
         # One discovery picks the most-progressed source among the
@@ -294,6 +453,7 @@ def resume_state(ctx: ResumeContext, init_fn, Yd):
         # contract must survive library upgrades, not crash-loop on
         # them.
         kind, found = source
+        meta = None
         try:
             meta = read_checkpoint_meta(
                 cfg.checkpoint_path if kind == "plain" else found[1][0])
@@ -302,6 +462,14 @@ def resume_state(ctx: ResumeContext, init_fn, Yd):
             if not auto:
                 raise
             reason = "unreadable or incompatible checkpoint"
+        if reason is not None and meta is not None:
+            # elastic seam: when the ONLY mismatch is the chain count
+            # and FitConfig.elastic allows it, adopt the donor onto
+            # this run's chains instead of refusing (ROADMAP 5(a))
+            el = _try_elastic(ctx, init_fn, Yd, kind=kind, found=found,
+                              meta=meta)
+            if el is not None:
+                return el
         if reason is not None and not auto:
             raise ValueError(f"refusing to resume: {reason}")
         if reason is None:
@@ -346,8 +514,26 @@ def resume_state(ctx: ResumeContext, init_fn, Yd):
                             "accumulators")
                     record("resume_decision", decision="light",
                            kind=kind, iteration=it, acc_start=it)
+                    # light resume restarts a uniform window, but the
+                    # birth-lineage counter must survive it (see
+                    # _elastic_carryover)
+                    lin = int(meta.get("elastic_lineage", 0))
+                    if lin:
+                        ctx.elastic = ElasticResume(
+                            from_chains=run.num_chains,
+                            to_chains=run.num_chains,
+                            kept=run.num_chains, dropped=0, birthed=0,
+                            fold_draws=0,
+                            chain_acc_starts=(it,) * run.num_chains,
+                            elastic_lineage=lin,
+                            from_topology=meta.get("topology"),
+                            to_topology=_run_topology_now(cfg))
                     return carry, it, it
                 acc0 = int(meta.get("acc_start", 0))
+                # a v7 file saved after an elastic resume carries
+                # non-uniform window starts / a folded draw count that
+                # the divisor must keep honoring on a SAME-count resume
+                ctx.elastic = _elastic_carryover(meta, cfg)
                 record("resume_decision", decision="resume", kind=kind,
                        iteration=it, acc_start=acc0)
                 return carry, it, acc0
@@ -385,6 +571,13 @@ def resume_state_multiproc(ctx: ResumeContext, init_fn, Yd):
     """
     cfg, run = ctx.cfg, ctx.cfg.run
     auto = cfg.resume == "auto"
+    # Multi-process elastic adoption stays a typed refusal: the fold is
+    # a host-side numpy splice with no collective agreement story (the
+    # same reason warm starts never run multi-process).  The refusal
+    # message names the --chains fix; a v7 set saved AFTER a
+    # single-process elastic resume still resumes here at its own chain
+    # count, with the carried-over divisor bookkeeping below.
+    ctx.elastic = None
     carry0 = init_fn(ctx.k_init, Yd)
     loaded, failure = None, None
     template = None
@@ -527,6 +720,7 @@ def resume_state_multiproc(ctx: ResumeContext, init_fn, Yd):
                         lambda a: (a.delete()
                                    if isinstance(a, jax.Array)
                                    else None), loaded[0])
+                    ctx.elastic = _elastic_carryover(smeta2, cfg)
                     record("resume_decision", decision="sidecar",
                            agree=True,
                            iteration=int(smeta2["iteration"]),
@@ -555,6 +749,7 @@ def resume_state_multiproc(ctx: ResumeContext, init_fn, Yd):
                     "stored - extend run.mcmc, or use "
                     "checkpoint_full_every so a .full sidecar exists")
         else:
+            ctx.elastic = _elastic_carryover(meta, cfg)
             record("resume_decision", decision="resume", agree=True,
                    kind=("plain" if kind_code == 0 else "set"),
                    iteration=my_iter,
@@ -605,8 +800,15 @@ def rewind_source(ctx: ResumeContext, template):
             c, r_meta = load_checkpoint(p, template)
             r_it = int(r_meta["iteration"])
             if r_meta.get("state_only"):
-                # light file: accumulation restarts at its iteration
+                # light file: accumulation restarts at its iteration -
+                # uniform window, so any earlier elastic bookkeeping
+                # clears with the accumulators
+                ctx.elastic = None
                 return c, r_it, r_it
+            # the chosen generation's OWN elastic state, always: a
+            # rewind past the elastic adoption must also rewind the
+            # divisor bookkeeping (a pre-elastic generation clears it)
+            ctx.elastic = _elastic_carryover(r_meta, cfg)
             return c, r_it, int(r_meta.get("acc_start", 0))
         except Exception:  # dcfm: ignore[DCFM601] - walk the retention chain: next generation is the handling
             continue    # corrupt/unreadable generation: try the next

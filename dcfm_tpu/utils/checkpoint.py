@@ -75,9 +75,18 @@ class CheckpointCorruptError(ValueError):
 # grid is exactly symmetric, so the dense accumulators are packed
 # losslessly on restore (_pack_dense_acc) and a resumed chain continues
 # bit-for-bit.  Versions < 5 still refuse with the friendly message.
-_FORMAT_VERSION = 6
+# v7: elastic-resume bookkeeping in the META only (the payload layout is
+# byte-identical to v6): per-chain accumulator window starts
+# (``chain_acc_starts``), the pooled draw count folded in from chains a
+# previous elastic shrink dropped (``fold_draws``), the birth-lineage
+# counter (``elastic_lineage`` - jax.random.fold_in domain for chains
+# birthed on a grow, bumped every elastic resume so a re-grown chain
+# never replays a stream), and the writing ``topology``.  v6 files
+# migrate losslessly: uniform starts ([acc_start] * num_chains),
+# fold_draws 0, lineage 0 (elastic_meta).
+_FORMAT_VERSION = 7
 _LEGACY_DENSE_VERSION = 5
-_LOADABLE_VERSIONS = (_FORMAT_VERSION, _LEGACY_DENSE_VERSION)
+_LOADABLE_VERSIONS = (_FORMAT_VERSION, 6, _LEGACY_DENSE_VERSION)
 
 
 # ChainCarry fields a state-only ("light") save drops.  The accumulators
@@ -175,6 +184,33 @@ def _acc_leaf_indices(carry: Any) -> list:
     keep = {id(l) for l in jax.tree.leaves(_slim(carry))}
     return [i for i, l in enumerate(jax.tree.leaves(carry))
             if id(l) not in keep]
+
+
+def _run_topology(num_chains: int) -> dict:
+    """The topology a checkpoint is written under - RECORDED into meta so
+    later resumes compare against what the file says, never against the
+    live ``jax.device_count()`` (the DCFM2001 hazard class: a topology
+    constant read at resume time describes the NEW grid, not the one the
+    carry was shaped by)."""
+    return {
+        "num_chains": int(num_chains),
+        "num_devices": jax.device_count(),
+        "num_processes": jax.process_count(),
+    }
+
+
+def elastic_meta(meta: dict, num_chains: int) -> Tuple[list, int, int]:
+    """``(chain_acc_starts, fold_draws, elastic_lineage)`` for a loadable
+    checkpoint's meta - the v7 elastic bookkeeping, with the lossless v6
+    defaults (uniform starts at ``acc_start``, nothing folded, lineage 0)
+    when the file predates the fields.  ``num_chains`` is the chain count
+    the file was written at (its config's, not the resuming run's)."""
+    acc_start = int(meta.get("acc_start", 0))
+    starts = meta.get("chain_acc_starts")
+    if starts is None:
+        starts = [acc_start] * int(num_chains)
+    return ([int(a) for a in starts], int(meta.get("fold_draws", 0)),
+            int(meta.get("elastic_lineage", 0)))
 
 
 def data_fingerprint(data) -> str:
@@ -440,6 +476,9 @@ def save_checkpoint(
     state_only: bool = False,
     acc_start: int = 0,
     keep_last: int = 1,
+    chain_acc_starts=None,
+    fold_draws: int = 0,
+    elastic_lineage: int = 0,
 ) -> None:
     """Atomically write chain state + config + data fingerprint.
 
@@ -456,12 +495,21 @@ def save_checkpoint(
     ``acc_start`` records the global iteration the CURRENT accumulators'
     window started at (0 for an uninterrupted run), so a full save after a
     light resume stays self-describing.
+
+    ``chain_acc_starts``/``fold_draws``/``elastic_lineage`` are the v7
+    elastic bookkeeping (None -> uniform starts at ``acc_start``): the
+    per-chain window starts after a mixed-age grow, the pooled draw count
+    a previous shrink folded in, and the birth-lineage counter.  Every
+    save also records the writing topology so a later resume can compare
+    capacity against what the FILE says rather than the live device
+    count.
     """
     acc_idx = [] if state_only else _acc_leaf_indices(carry)
     if state_only:
         carry = _slim(carry)
     carry = jax.device_get(carry)
     leaves, treedef = jax.tree.flatten(carry)
+    num_chains = int(cfg.run.num_chains)
     meta = {
         "version": _FORMAT_VERSION,
         "config": _config_to_json(cfg),
@@ -473,6 +521,12 @@ def save_checkpoint(
         "state_only": bool(state_only),
         "acc_start": int(acc_start),
         "acc_leaf_indices": acc_idx,
+        "chain_acc_starts": [int(a) for a in (
+            chain_acc_starts if chain_acc_starts is not None
+            else [acc_start] * num_chains)],
+        "fold_draws": int(fold_draws),
+        "elastic_lineage": int(elastic_lineage),
+        "topology": _run_topology(num_chains),
     }
     _atomic_savez(path, meta,
                   {f"leaf_{i}": np.asarray(l) for i, l in enumerate(leaves)},
@@ -488,7 +542,9 @@ def strip_checkpoint(src: str, dst: str) -> None:
     iteration."""
     with np.load(src) as z:
         meta = json.loads(bytes(z["__meta__"]).decode())
-        if meta["version"] != _FORMAT_VERSION:
+        # v6 strips fine (same payload layout as v7); v5 dense files
+        # refuse with the version message, not a missing-index error
+        if meta["version"] not in (_FORMAT_VERSION, 6):
             raise ValueError(
                 f"checkpoint format v{meta['version']} != v{_FORMAT_VERSION}")
         if meta.get("state_only"):
@@ -569,6 +625,164 @@ def load_checkpoint(path: str, carry_template: Any) -> Tuple[Any, dict]:
         if state_only:
             carry = _expand_zeros(carry, carry_template)
         return carry, meta
+
+
+def _with_chain_axis(template: Any, run_chains: int,
+                     donor_chains: int) -> Any:
+    """Rewrite a ``run_chains``-shaped carry template into the DONOR's
+    chain shape: every leaf carries a leading chain axis when the chain
+    count is > 1 (init_chain vmaps the whole carry, iteration included)
+    and none when it is 1, so the rewrite is a pure leading-axis edit -
+    no knowledge of individual fields needed."""
+    def rw(leaf):
+        shp = tuple(np.shape(leaf))
+        core = shp[1:] if run_chains > 1 else shp
+        new = ((donor_chains,) + core) if donor_chains > 1 else core
+        return jax.ShapeDtypeStruct(new, np.dtype(leaf.dtype))
+    return jax.tree.map(rw, template)
+
+
+def load_checkpoint_elastic(
+    path: str,
+    carry_template: Any,
+    num_chains: int,
+    *,
+    fresh_carry: Any = None,
+    paths: Optional[list] = None,
+) -> Tuple[Any, dict, dict]:
+    """Adopt a checkpoint written at a DIFFERENT chain count onto
+    ``num_chains`` chains - the elastic-resume core (ROADMAP 5(a)).
+
+    Shrinking C -> C' keeps the first C' donor chains' carries VERBATIM
+    (their next draws bitwise-continue the donors: per-iteration sweep
+    keys fold from the global iteration and per-chain init keys from the
+    global chain index, so a surviving chain's stream is position-
+    independent) and FOLDS the dropped chains' accumulated draws into
+    surviving chain 0's running-sum accumulators - exact sum arithmetic,
+    no resampling; the pooled posterior over all draws ever taken is
+    preserved through the elastic window divisor
+    (runtime.fetch.accumulator_window with the returned
+    ``chain_acc_starts``/``fold_draws``).
+
+    Growing C -> C' adopts all C donors verbatim and splices the birth
+    rows ``[C:]`` from ``fresh_carry`` - a REQUIRED concrete carry the
+    caller built via ``init_chain`` under a ``jax.random.fold_in`` of the
+    bumped ``elastic_lineage`` counter, so a birthed chain never replays
+    any donor's stream.  Birth rows start with ZERO accumulators and the
+    donor's iteration; their ``acc_start`` is the adoption iteration, so
+    the window bookkeeping stays integer-exact on mixed-age chains.
+
+    Returns ``(host carry pytree shaped for num_chains, meta, info)``
+    where ``info`` carries the elastic bookkeeping the resumed run must
+    thread into its saves and its fetch divisor: from/to chain counts,
+    kept/dropped/birthed, the new ``chain_acc_starts``/``fold_draws``,
+    the donor's ``elastic_lineage``, and the donor's recorded topology.
+
+    Typed refusals (ValueError) for donors whose dropped draws cannot be
+    folded: state-only (light) checkpoints carry no accumulators, and
+    store_draws carries per-chain draw buffers that are statically sized
+    by chain count.
+
+    ``paths`` (a complete ``.procK-of-N`` set) adopts a multi-process
+    donor: the set is assembled into full host arrays first
+    (:func:`load_checkpoint_resharded` - topology-independent by the
+    stored per-block offsets), then re-chained identically.
+    """
+    from dcfm_tpu.models.sampler import num_saved_draws
+    meta = read_checkpoint_meta(paths[0] if paths else path)
+    saved = _config_from_json(meta["config"])
+    donor_chains = int(saved.run.num_chains)
+    new_c = int(num_chains)
+    if meta.get("state_only"):
+        raise ValueError(
+            "elastic resume needs a FULL checkpoint: a state-only (light) "
+            "file carries no accumulators, so a dropped chain's draws "
+            "cannot be folded into the pooled posterior - resume it at "
+            f"num_chains={donor_chains} first, or start fresh")
+    if saved.run.store_draws:
+        raise ValueError(
+            "elastic resume refuses store_draws=True checkpoints: the "
+            "per-draw buffers are statically sized per chain and cannot "
+            "be re-chained - resume at the original chain count "
+            f"({donor_chains}) instead")
+    donor_template = _with_chain_axis(carry_template, new_c, donor_chains)
+    carry, meta = (load_checkpoint_resharded(paths, donor_template)
+                   if paths else load_checkpoint(path, donor_template))
+    starts, fold, lineage = elastic_meta(meta, donor_chains)
+    it = int(meta["iteration"])
+    burnin, thin = int(saved.run.burnin), int(saved.run.thin)
+
+    def window(a):
+        return (num_saved_draws(it, burnin, thin)
+                - num_saved_draws(int(a), burnin, thin))
+
+    if new_c < donor_chains:
+        # fold the dropped rows' raw sums into surviving chain 0 BEFORE
+        # slicing - exact accumulator arithmetic, nothing re-divided
+        folded = {}
+        for f in _ACC_FIELDS:
+            arr = getattr(carry, f, None)
+            if arr is None:
+                continue
+            a = np.array(np.asarray(arr), copy=True)
+            a[0] = a[0] + a[new_c:].sum(axis=0, dtype=a.dtype)
+            folded[f] = a
+        if folded:
+            carry = carry._replace(**folded)
+
+        def take(leaf):
+            a = np.asarray(leaf)[:new_c]
+            return a[0] if new_c == 1 else a
+
+        carry = jax.tree.map(take, carry)
+        new_fold = fold + sum(window(starts[c])
+                              for c in range(new_c, donor_chains))
+        new_starts = starts[:new_c]
+    elif new_c > donor_chains:
+        if fresh_carry is None:
+            raise ValueError(
+                f"growing {donor_chains} -> {new_c} chains requires "
+                "fresh_carry (re-lineaged init rows for the birthed "
+                "chains)")
+        fresh = jax.device_get(fresh_carry)
+
+        def splice(fresh_leaf, donor_leaf):
+            out = np.array(np.asarray(fresh_leaf), copy=True)
+            d = np.asarray(donor_leaf)
+            out[:donor_chains] = d[None] if donor_chains == 1 else d
+            return out
+
+        carry = jax.tree.map(splice, fresh, carry)
+        zeroed = {}
+        for f in _ACC_FIELDS:
+            arr = getattr(carry, f, None)
+            if arr is None:
+                continue
+            a = np.array(arr, copy=True)
+            a[donor_chains:] = 0
+            zeroed[f] = a
+        # birth rows tick the same global clock as the donors: one
+        # iteration leaf, donor's value everywhere
+        carry = carry._replace(
+            iteration=np.full_like(np.asarray(carry.iteration), it),
+            **zeroed)
+        new_fold = fold
+        new_starts = starts + [it] * (new_c - donor_chains)
+    else:
+        new_fold, new_starts = fold, starts
+
+    info = {
+        "from_chains": donor_chains,
+        "to_chains": new_c,
+        "kept": min(donor_chains, new_c),
+        "dropped": max(0, donor_chains - new_c),
+        "birthed": max(0, new_c - donor_chains),
+        "fold_draws": int(new_fold),
+        "chain_acc_starts": [int(a) for a in new_starts],
+        "elastic_lineage": int(lineage),
+        "from_topology": meta.get("topology"),
+    }
+    return carry, meta, info
 
 
 def proc_path(path: str, process_index: int, process_count: int) -> str:
@@ -772,6 +986,9 @@ def save_checkpoint_multiprocess(
     state_only: bool = False,
     acc_start: int = 0,
     keep_last: int = 1,
+    chain_acc_starts=None,
+    fold_draws: int = 0,
+    elastic_lineage: int = 0,
 ) -> None:
     """Multi-host checkpoint: process k atomically writes its own
     ``path.prock-of-N`` with exactly the shard data its devices own - no
@@ -815,6 +1032,12 @@ def save_checkpoint_multiprocess(
         "state_only": bool(state_only),
         "acc_start": int(acc_start),
         "acc_leaf_indices": [],
+        "chain_acc_starts": [int(a) for a in (
+            chain_acc_starts if chain_acc_starts is not None
+            else [acc_start] * int(cfg.run.num_chains))],
+        "fold_draws": int(fold_draws),
+        "elastic_lineage": int(elastic_lineage),
+        "topology": _run_topology(int(cfg.run.num_chains)),
     }
     _atomic_savez(proc_path(path, jax.process_index(), jax.process_count()),
                   meta, payload, keep_last=keep_last)
@@ -1117,9 +1340,15 @@ class AsyncCheckpointWriter:
 
 
 def checkpoint_compatible(
-    meta: dict, cfg: FitConfig, fingerprint: str
+    meta: dict, cfg: FitConfig, fingerprint: str, *,
+    ignore_chains: bool = False
 ) -> Optional[str]:
-    """None if resumable under ``cfg``, else a human-readable refusal."""
+    """None if resumable under ``cfg``, else a human-readable refusal.
+
+    ``ignore_chains=True`` skips the num_chains comparison - the elastic
+    resume path (runtime.resume) uses it to ask "is the ONLY mismatch the
+    chain count?" before adopting the donor elastically instead of
+    refusing."""
     saved = _config_from_json(meta["config"])
     if saved.model != cfg.model:
         return f"model config changed: {saved.model} != {cfg.model}"
@@ -1137,9 +1366,12 @@ def checkpoint_compatible(
     if saved.run.store_draws and saved.run.num_saved != cfg.run.num_saved:
         return ("mcmc length changed with store_draws=True (the draw "
                 "buffers are statically sized by num_saved)")
-    if saved.run.num_chains != cfg.run.num_chains:
-        return (f"num_chains changed: {saved.run.num_chains} != "
-                f"{cfg.run.num_chains} (the carry has a per-chain axis)")
+    if not ignore_chains and saved.run.num_chains != cfg.run.num_chains:
+        return (f"checkpoint has num_chains={saved.run.num_chains}, run "
+                f"configured {cfg.run.num_chains}; pass --elastic (or "
+                f"FitConfig.elastic=True) to adopt it on the new chain "
+                f"count, or --chains {saved.run.num_chains} to match the "
+                "checkpoint")
     if saved.run.store_draws != cfg.run.store_draws:
         return (f"store_draws changed: {saved.run.store_draws} != "
                 f"{cfg.run.store_draws} (the carry gains/loses the "

@@ -85,6 +85,9 @@ JAX_PLATFORMS=cpu python -m dcfm_tpu.analysis --trace \
 # delta_materialize kill point) and its storm test swaps a live
 # in-process server under 64 threads - a runaway child or a native
 # abort must fail one file with its signal named.
+# test_elastic.py rides the lane: its supervised shrink SIGKILLs a real
+# 4-chain child and relaunches it capped to 2 chains (the elastic
+# adoption window) - a runaway child must fail one file, not the suite.
 echo "== serve + chaos tests incl. crash-fuzz smoke (crash-isolated lane) =="
 for f in tests/test_serve_artifact.py tests/test_serve_engine.py \
          tests/test_serve_server.py tests/test_serve_fleet.py \
@@ -92,11 +95,22 @@ for f in tests/test_serve_artifact.py tests/test_serve_engine.py \
          tests/test_resilience.py tests/test_online.py \
          tests/test_runtime_stream.py tests/test_obs.py \
          tests/test_chains_mesh.py tests/test_sparse_ingest.py \
-         tests/test_precision.py tests/test_sse_gram.py; do
+         tests/test_precision.py tests/test_sse_gram.py \
+         tests/test_elastic.py; do
     JAX_PLATFORMS=cpu python -m dcfm_tpu.analysis.isolate "$f" \
         -- -q -m 'not slow' --continue-on-collection-errors \
         -p no:cacheprovider -p no:xdist -p no:randomly || exit 1
 done
+
+# Elastic kill-window fuzz smoke, beside test_resilience.py's 8-point
+# crash-fuzz: 4 seeded points SIGKILLing a supervised 4->2 chain shrink
+# inside the elastic_gate/elastic_fold/elastic_fold_post windows - every
+# point must end in a clean elastic resume (finite Sigma) or a typed
+# refusal, never a hang or a corrupt pool.  The full 20-point sweep is
+# the acceptance run: scripts/multihost_demo.py --elastic-fuzz 7 0 20.
+echo "== elastic kill-window fuzz smoke (4 points) =="
+JAX_PLATFORMS=cpu python scripts/multihost_demo.py --elastic-fuzz 7 0 4 \
+    || exit 1
 
 echo "== tier-1 tests (CPU) =="
 if [ "${CI_ISOLATED:-0}" = "1" ]; then

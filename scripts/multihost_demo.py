@@ -20,7 +20,10 @@ Further modes: --ck (elastic crash recovery), --ext (chain extension),
 pod supervision: a real SIGKILL of one host under `dcfm-tpu supervise
 --pod 2`, bit-identical recovery), --esig (sidecar unanimity refuses
 acc_start disagreement on per-host disks), --fuzz SEED N0 N1
-(randomized crash-point fuzz of the supervised pod, DCFM_FAULT_FUZZ).
+(randomized crash-point fuzz of the supervised pod, DCFM_FAULT_FUZZ),
+--elastic-fuzz SEED N0 N1 (seeded SIGKILL sweep over the elastic
+resume's adoption windows: 4-chain launch killed, relaunch adopts at 2
+chains, DCFM_FAULT_FUZZ=seed:index:elastic).
 """
 
 import json
@@ -778,6 +781,116 @@ def parent_fuzz(seed: int, n0: int, n1: int) -> int:
     return 0 if ok else 1
 
 
+def child_elastic() -> None:
+    """Elastic-fuzz child: a SINGLE-process checkpointing fit whose
+    chain count is keyed on the supervised launch number - launch 1
+    runs 4 chains, every relaunch runs 2 (the capacity-loss drill: the
+    relaunch's device budget only fits half the chains).  The resume
+    path of launch >= 2 therefore goes through the elastic adoption,
+    which is exactly the window the seeded fuzz
+    (DCFM_FAULT_FUZZ=seed:index:elastic, resilience.faults.
+    elastic_fuzz_spec) SIGKILLs inside."""
+    os.environ["XLA_FLAGS"] = (
+        "--xla_force_host_platform_device_count=8")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import dcfm_tpu.api as api
+    from dcfm_tpu import BackendConfig, FitConfig, ModelConfig, RunConfig
+    launch = int(os.environ.get("DCFM_FAULT_LAUNCH", "1"))
+    chains = 4 if launch == 1 else 2
+    rng = np.random.default_rng(SEED)
+    p = G * P_SHARD
+    Y = rng.standard_normal((N, p)).astype(np.float32)
+    ckpath = os.path.join(os.environ["MULTIHOST_DEMO_DIR"], "elastic.ck")
+    cfg = FitConfig(
+        model=ModelConfig(num_shards=G, factors_per_shard=K, rho=0.9),
+        # boundaries at 2,4,6,8 - the same grid elastic_fuzz_spec kills on
+        run=RunConfig(burnin=4, mcmc=4, thin=1, seed=SEED, chunk_size=2,
+                      num_chains=chains),
+        backend=BackendConfig(mesh_devices=0),
+        checkpoint_path=ckpath, resume="auto",
+        checkpoint_every_chunks=1, checkpoint_keep_last=2)
+    res = api.fit(Y, cfg)
+    np.save(os.path.join(os.environ["MULTIHOST_DEMO_DIR"],
+                         "sigma_elastic.npy"), res.Sigma)
+    print("CHILD_ELASTIC " + json.dumps({
+        "launch": launch, "chains": chains,
+        "elastic": res.elastic_resume is not None}), flush=True)
+
+
+def parent_elastic_fuzz(seed: int, n0: int, n1: int) -> int:
+    """Seeded fuzz sweep over the ELASTIC kill windows: each point runs
+    the launch-keyed child (4 chains -> killed -> relaunched at 2
+    chains) under supervise_command with
+    ``DCFM_FAULT_FUZZ=seed:index:elastic``; launch 2 is usually
+    SIGKILLed inside elastic_gate / elastic_fold / elastic_fold_post.
+    Every outcome must be a finished run with a FINITE Sigma (the fold
+    only reads the donor checkpoint, so no kill point can corrupt the
+    pooled accumulator) or a clean typed refusal - a hang (watchdog) or
+    a non-finite Sigma is a failure.  The flight recorder narrates each
+    point's adoptions (`dcfm-tpu events <ck>.obs`)."""
+    import numpy as np
+    from dcfm_tpu.resilience.supervisor import (
+        PodHangError, PoisonedRunError, RetriesExhaustedError,
+        supervise_command)
+    t0 = time.perf_counter()
+    base_env = _child_env()
+    watchdog = float(os.environ.get("MULTIHOST_FUZZ_WATCHDOG", "420"))
+    argv = [sys.executable, os.path.abspath(__file__), "--child-elastic"]
+
+    def run_point(fault_env):
+        with tempfile.TemporaryDirectory() as tmp:
+            env = dict(base_env)
+            env["MULTIHOST_DEMO_DIR"] = tmp
+            env.pop("DCFM_FAULT_PLAN", None)
+            env.pop("DCFM_FAULT_FUZZ", None)
+            env.update(fault_env)
+            ck = os.path.join(tmp, "elastic.ck")
+            try:
+                supervise_command(
+                    argv, checkpoint_path=ck, max_retries=4,
+                    poison_deaths=3, backoff_base=0.05,
+                    launch_timeout=watchdog, env=env,
+                    log=lambda m: None)
+            except (PoisonedRunError, RetriesExhaustedError) as e:
+                return "refused", type(e).__name__
+            except PodHangError as e:
+                return "fail", f"DEADLOCK (watchdog): {e}"
+            f = os.path.join(tmp, "sigma_elastic.npy")
+            if not os.path.exists(f):
+                return "fail", "child exited 0 without Sigma"
+            s = np.load(f)
+            if not np.isfinite(s).all():
+                return "fail", "non-finite pooled Sigma after adoption"
+            return "ok", None
+
+    outcomes: dict = {}
+    failures = []
+    for idx in range(n0, n1):
+        status, detail = run_point(
+            {"DCFM_FAULT_FUZZ": f"{seed}:{idx}:elastic"})
+        outcome = ("FAIL" if status == "fail"
+                   else f"refused:{detail}" if status == "refused"
+                   else "clean")
+        if status == "fail":
+            failures.append((idx, detail))
+        outcomes[outcome] = outcomes.get(outcome, 0) + 1
+        print("FUZZ_POINT "
+              + json.dumps({"index": idx, "outcome": outcome}),
+              flush=True)
+    ok = not failures
+    print(json.dumps({
+        "demo": "seeded fuzz over the elastic resume's kill windows",
+        "seed": seed, "points": n1 - n0,
+        "outcomes": outcomes,
+        "failures": failures,
+        "seconds": round(time.perf_counter() - t0, 1),
+        "ok": ok,
+    }))
+    return 0 if ok else 1
+
+
 def _esig_ckpath(process_id: int) -> str:
     """PER-HOST checkpoint directories: each process sees only its OWN
     files, so resume takes the local-set fallback (_local_set_source)
@@ -1045,9 +1158,15 @@ if __name__ == "__main__":
         sys.exit(parent_supervised())
     elif len(sys.argv) > 1 and sys.argv[1] == "--esig":
         sys.exit(parent_esig())
+    elif len(sys.argv) > 1 and sys.argv[1] == "--child-elastic":
+        child_elastic()
     elif len(sys.argv) > 1 and sys.argv[1] == "--fuzz":
         # --fuzz SEED N0 N1: run fuzz points [N0, N1)
         sys.exit(parent_fuzz(int(sys.argv[2]), int(sys.argv[3]),
                              int(sys.argv[4])))
+    elif len(sys.argv) > 1 and sys.argv[1] == "--elastic-fuzz":
+        # --elastic-fuzz SEED N0 N1: elastic kill-window fuzz points
+        sys.exit(parent_elastic_fuzz(int(sys.argv[2]), int(sys.argv[3]),
+                                     int(sys.argv[4])))
     else:
         sys.exit(parent())

@@ -1,0 +1,25 @@
+"""Known-bad: live topology constants feeding carry-shape/divisor
+arithmetic on the resume path (DCFM2001).  Elastic resume restarts
+these functions on a DIFFERENT capacity than the checkpoint's writer -
+every shape and divisor below silently goes wrong after a shrink or a
+grow, with no error raised."""
+
+import jax
+import numpy as np
+
+
+def resume_state(carry, meta):
+    # BAD: per-chain window starts sized from live capacity
+    starts = [0] * jax.device_count()
+    # BAD: slice bound from live topology - keeps the wrong chains
+    kept = carry[: jax.process_count()]
+    return starts, kept
+
+
+def checkpoint_window(total, meta):
+    # BAD: taint through a local - the divisor mis-divides pooled Sigma
+    n = jax.process_count()
+    inv_count = np.float32(1.0) / (total * n)
+    # BAD: len(jax.devices()) is the same live constant in a hat
+    per_dev = total // len(jax.devices())
+    return inv_count, per_dev

@@ -1,0 +1,29 @@
+"""Known-good twin of bad_topology.py: the resume path reads the
+checkpoint's RECORDED topology meta; live capacity is only recorded
+INTO meta (a dict literal), compared in a gate, or used to name a
+per-process file - never fed into carry shapes or window divisors."""
+
+import jax
+
+
+def _run_topology():
+    # recording live capacity INTO meta is the sanctioned direction
+    return {"num_devices": jax.device_count(),
+            "num_processes": jax.process_count()}
+
+
+def resume_state(carry, meta):
+    # shapes and divisors flow from the recorded meta, not live capacity
+    chains = int(meta["topology"]["num_chains"])
+    starts = [0] * chains
+    return starts, carry[:chains]
+
+
+def checkpoint_gate(meta):
+    # an equality gate on live capacity is a comparison, not arithmetic
+    return meta["topology"]["num_processes"] == jax.process_count()
+
+
+def checkpoint_shard_name(path):
+    # per-process file naming passes the count through, no arithmetic
+    return f"{path}.proc{jax.process_index()}-of-{jax.process_count()}"

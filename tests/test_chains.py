@@ -134,8 +134,9 @@ def test_chains_mesh_matches_vmap():
 
 
 def test_multichain_checkpoint_resume(tmp_path, monkeypatch):
-    """Chains survive checkpoint/resume bitwise, and a num_chains change is
-    refused."""
+    """Chains survive checkpoint/resume bitwise, and a num_chains change
+    is refused under the strict gate (elastic=False; the default "auto"
+    ADOPTS chain-count mismatches - tests/test_elastic.py)."""
     import dataclasses
 
     import dcfm_tpu.runtime.pipeline as pipeline
@@ -167,5 +168,5 @@ def test_multichain_checkpoint_resume(tmp_path, monkeypatch):
 
     with pytest.raises(ValueError, match="num_chains"):
         fit(Y, dataclasses.replace(
-            cfg_ck, resume=True,
+            cfg_ck, resume=True, elastic=False,
             run=dataclasses.replace(run, num_chains=3)))

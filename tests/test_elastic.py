@@ -1,0 +1,415 @@
+"""Elastic resume: a checkpoint taken at C chains restarts at any C'.
+
+The parity matrix from ROADMAP item 5(a): (4,4)->(2,2) shrink,
+(2,2)->(4,4) grow, (4,4)->(3,3) non-dividing mesh fallback, and
+(2,1)->(1,1) down to a single chain - pinning surviving-chain bitwise
+continuation, pooled-Sigma window invariance against uninterrupted
+oracles, v6->v7 meta migration, mixed-age R-hat/early-stop, the strict
+gate's refusal message, the events narration, and a real-SIGKILL
+supervised shrink.
+
+The invariance oracle is pure linear algebra on public results: chain
+streams depend only on the GLOBAL chain index and GLOBAL iteration
+(never on how many siblings run beside them), so the elastic run's
+pooled raw sum decomposes into sums recoverable from uninterrupted
+runs at other (C, T) corners.  f32 running sums make the comparison
+tolerance-based (~1e-7 relative per draw); the DIVISOR bookkeeping is
+asserted integer-exact separately (elastic_pooled_draws).
+"""
+
+import dataclasses
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from tests.conftest import make_synthetic
+
+from dcfm_tpu import FitConfig, ModelConfig, RunConfig, fit
+from dcfm_tpu.runtime.fetch import elastic_pooled_draws
+from dcfm_tpu.utils.checkpoint import (
+    checkpoint_compatible, elastic_meta, read_checkpoint_meta)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class Killed(RuntimeError):
+    pass
+
+
+def _cfg(num_chains=2, mcmc=32, **kw):
+    return FitConfig(
+        model=ModelConfig(num_shards=2, factors_per_shard=3, rho=0.8),
+        run=RunConfig(burnin=16, mcmc=mcmc, thin=2, seed=3, chunk_size=8,
+                      num_chains=num_chains),
+        **kw)
+
+
+class _SyncWriter:
+    """Synchronous checkpoint writer: saves happen exactly at submit, so
+    kill-at-save-N arithmetic is deterministic (see test_checkpoint)."""
+    last_save_seconds = None
+
+    def submit(self, save_fn, path, carry, cfg, **kw):
+        import jax
+        save_fn(path, jax.device_get(carry), cfg, **kw)
+
+    def poll_error(self):
+        return None
+
+    def busy(self):
+        return False
+
+    def wait(self):
+        pass
+
+
+def _make_donor(dirpath, data, chains, kill_at_save):
+    """A C-chain run SIGKILLed (simulated) right after save #N: the donor
+    checkpoint every elastic test adopts.  chunk 8 + cadence 1 puts save
+    #2 at iteration 16 (the burn-in boundary) and #4 at iteration 32."""
+    import dcfm_tpu.runtime.pipeline as pipeline
+
+    ck = os.path.join(dirpath, "donor.npz")
+    cfg = _cfg(num_chains=chains, checkpoint_path=ck,
+               checkpoint_every_chunks=1, checkpoint_keep_last=2)
+    mp = pytest.MonkeyPatch()
+    try:
+        mp.setattr(pipeline, "AsyncCheckpointWriter", _SyncWriter)
+        real_save = pipeline.save_checkpoint
+        calls = {"n": 0}
+
+        def killing_save(*args, **kwargs):
+            real_save(*args, **kwargs)
+            calls["n"] += 1
+            if calls["n"] == kill_at_save:
+                raise Killed("simulated crash")
+
+        mp.setattr(pipeline, "save_checkpoint", killing_save)
+        with pytest.raises(Killed):
+            fit(data, cfg)
+    finally:
+        mp.undo()
+    return ck
+
+
+def _resume(donor_ck, dirpath, data, chains, **run_kw):
+    """Adopt a COPY of the donor at a new chain count (the donor file
+    stays pristine for other corners of the matrix)."""
+    ck = os.path.join(dirpath, "ck.npz")
+    shutil.copy(donor_ck, ck)
+    run = dataclasses.replace(_cfg().run, num_chains=chains, **run_kw)
+    cfg = dataclasses.replace(
+        _cfg(), run=run, checkpoint_path=ck, checkpoint_every_chunks=1,
+        checkpoint_keep_last=2, resume=True)
+    return fit(data, cfg), ck
+
+
+@pytest.fixture(scope="module")
+def data():
+    Y, _ = make_synthetic(n=40, p=24, k_true=3, seed=7)
+    return Y
+
+
+@pytest.fixture(scope="module")
+def donor4_at32(tmp_path_factory, data):
+    return _make_donor(str(tmp_path_factory.mktemp("d4")), data, 4, 4)
+
+
+@pytest.fixture(scope="module")
+def donor4_at16(tmp_path_factory, data):
+    return _make_donor(str(tmp_path_factory.mktemp("d4b")), data, 4, 2)
+
+
+@pytest.fixture(scope="module")
+def donor2_at32(tmp_path_factory, data):
+    return _make_donor(str(tmp_path_factory.mktemp("d2")), data, 2, 4)
+
+
+@pytest.fixture(scope="module")
+def oracle2_48(data):
+    return fit(data, _cfg(num_chains=2))
+
+
+@pytest.fixture(scope="module")
+def oracle4_32(data):
+    return fit(data, _cfg(num_chains=4, mcmc=16))
+
+
+@pytest.fixture(scope="module")
+def oracle2_32(data):
+    return fit(data, _cfg(num_chains=2, mcmc=16))
+
+
+@pytest.fixture(scope="module")
+def shrink(tmp_path_factory, donor4_at32, data):
+    """The (4,4)->(2,2) corner: adopt the iteration-32 4-chain donor on
+    2 chains and run to completion."""
+    return _resume(donor4_at32, str(tmp_path_factory.mktemp("shrink")),
+                   data, 2)
+
+
+@pytest.fixture(scope="module")
+def grow(tmp_path_factory, donor2_at32, data):
+    """The (2,2)->(4,4) corner: 2 birthed chains join at iteration 32."""
+    return _resume(donor2_at32, str(tmp_path_factory.mktemp("grow")),
+                   data, 4)
+
+
+# ---------------------------------------------------------------------------
+# shrink: fold + window invariance
+# ---------------------------------------------------------------------------
+
+def test_shrink_adopts_and_reports(shrink):
+    res, _ = shrink
+    el = res.elastic_resume
+    assert el is not None
+    assert (el["from_chains"], el["to_chains"]) == (4, 2)
+    assert (el["kept"], el["dropped"], el["birthed"]) == (2, 2, 0)
+    # 4 chains x 8 post-burnin draws at iteration 32, 2 chains dropped
+    assert el["fold_draws"] == 16
+    assert list(el["chain_acc_starts"]) == [0, 0]
+    assert el["elastic_lineage"] >= 1
+    assert np.isfinite(res.Sigma).all()
+
+
+def test_shrink_pooled_sigma_matches_combined_oracle(
+        shrink, oracle2_48, oracle4_32, oracle2_32):
+    """Window invariance: the elastic run's pooled Sigma is the running
+    sum over EVERY draw ever taken divided by the exact total.  Chains
+    0,1 contribute their full (0,48] windows (recoverable from the
+    uninterrupted 2-chain run) and dropped chains 2,3 their (0,32]
+    windows (= the 4-chain-run sum minus the 2-chain-run sum at T=32)."""
+    res, _ = shrink
+    s01_48 = 32.0 * oracle2_48.sigma_blocks.astype(np.float64)
+    s0123_32 = 32.0 * oracle4_32.sigma_blocks.astype(np.float64)
+    s01_32 = 16.0 * oracle2_32.sigma_blocks.astype(np.float64)
+    oracle = (s01_48 + (s0123_32 - s01_32)) / 48.0
+    np.testing.assert_allclose(res.sigma_blocks, oracle,
+                               rtol=2e-4, atol=1e-5)
+    # the divisor itself is integer-exact: 2 x 16 kept + 16 folded
+    assert elastic_pooled_draws(48, 16, 2, (0, 0), 16) == 48
+
+
+def test_shrink_at_burnin_boundary_bitwise_matches_fresh_run(
+        donor4_at16, data, tmp_path, oracle2_48):
+    """Surviving-chain bitwise continuation: adopted at the burn-in
+    boundary (zero accumulated draws, nothing folded), the 2 surviving
+    chains must reproduce the uninterrupted 2-chain run BIT FOR BIT -
+    chain streams key off the global chain index and global iteration,
+    so chains 0,1 of a 4-chain run ARE the 2-chain run's chains."""
+    res, _ = _resume(donor4_at16, str(tmp_path), data, 2)
+    el = res.elastic_resume
+    assert el is not None and el["fold_draws"] == 0
+    np.testing.assert_array_equal(res.sigma_blocks, oracle2_48.sigma_blocks)
+    np.testing.assert_array_equal(res.Sigma, oracle2_48.Sigma)
+
+
+# ---------------------------------------------------------------------------
+# grow: births, mixed-age windows, diagnostics
+# ---------------------------------------------------------------------------
+
+def test_grow_births_fresh_chains_with_offset_windows(grow):
+    res, _ = grow
+    el = res.elastic_resume
+    assert el is not None
+    assert (el["from_chains"], el["to_chains"]) == (2, 4)
+    assert (el["kept"], el["dropped"], el["birthed"]) == (2, 0, 2)
+    assert el["fold_draws"] == 0
+    assert list(el["chain_acc_starts"]) == [0, 0, 32, 32]
+    assert el["elastic_lineage"] == 1
+    assert np.isfinite(res.Sigma).all()
+    # donors hold 16 draws each, births 8 each: integer-exact total
+    assert elastic_pooled_draws(48, 16, 2, (0, 0, 32, 32), 0) == 48
+
+
+def test_grow_mixed_age_diagnostics_finite(grow):
+    """R-hat/ESS on mixed-age chains: the per-chain acc_start offsets
+    must keep the diagnostics windows aligned - a NaN here means a
+    birthed chain's empty prefix leaked into the pooled statistics."""
+    res, _ = grow
+    assert res.diagnostics is not None
+    for name, val in res.diagnostics["rhat"].items():
+        assert np.isfinite(val), (name, val)
+    for name, val in res.diagnostics["ess"].items():
+        assert np.isfinite(val) and val > 0, (name, val)
+
+
+def test_grow_saves_v7_elastic_meta(grow):
+    res, ck = grow
+    meta = read_checkpoint_meta(ck)
+    assert meta["version"] == 7
+    assert list(meta["chain_acc_starts"]) == [0, 0, 32, 32]
+    assert meta["fold_draws"] == 0
+    assert meta["elastic_lineage"] == 1
+    assert meta["topology"]["num_chains"] == 4
+
+
+def test_early_stop_rhat_on_mixed_age_chains(donor2_at32, data, tmp_path):
+    """early_stop="rhat" decides at chunk boundaries where a birthed
+    chain may hold only a handful of draws - the decision must neither
+    crash nor divide by an empty window."""
+    res, _ = _resume(donor2_at32, str(tmp_path), data, 4,
+                     early_stop="rhat", rhat_threshold=5.0, ess_target=1.0)
+    assert res.elastic_resume is not None
+    assert np.isfinite(res.Sigma).all()
+    if res.rhat_trajectory is not None:
+        assert np.isfinite(res.rhat_trajectory).all()
+
+
+# ---------------------------------------------------------------------------
+# non-dividing grid + single chain
+# ---------------------------------------------------------------------------
+
+def test_shrink_to_non_dividing_grid_falls_back(donor4_at32, data,
+                                                tmp_path):
+    """(4,4)->(3,3): 3 chains do not divide the 8-device platform, so
+    the pack seam must choose the vmap fallback instead of refusing."""
+    from dcfm_tpu.parallel.mesh import legal_chain_grid
+    assert legal_chain_grid(4, 8, 2)
+    assert not legal_chain_grid(3, 8, 2)
+    res, _ = _resume(donor4_at32, str(tmp_path), data, 3)
+    el = res.elastic_resume
+    assert (el["kept"], el["dropped"], el["birthed"]) == (3, 1, 0)
+    assert el["fold_draws"] == 8          # one dropped chain's 8 draws
+    assert list(el["chain_acc_starts"]) == [0, 0, 0]
+    assert np.isfinite(res.Sigma).all()
+    assert elastic_pooled_draws(48, 16, 2, (0, 0, 0), 8) == 56
+
+
+def test_shrink_two_chains_to_one(donor2_at32, data, tmp_path):
+    """(2,1)->(1,1): the single-chain path has no chain axis to pool
+    over, so the elastic divisor is applied directly."""
+    res, _ = _resume(donor2_at32, str(tmp_path), data, 1)
+    el = res.elastic_resume
+    assert (el["from_chains"], el["to_chains"]) == (2, 1)
+    assert (el["kept"], el["dropped"]) == (1, 1)
+    assert el["fold_draws"] == 8
+    assert np.isfinite(res.Sigma).all()
+    assert elastic_pooled_draws(48, 16, 2, (0,), 8) == 24
+
+
+# ---------------------------------------------------------------------------
+# v6 -> v7 migration
+# ---------------------------------------------------------------------------
+
+def _rewrite_as_v6(src, dst):
+    """A byte-faithful v6 twin: same payload leaves (same CRCs), meta
+    stripped of every v7 elastic key."""
+    with np.load(src) as z:
+        arrays = {k: np.array(z[k]) for k in z.files}
+    meta = json.loads(bytes(arrays.pop("__meta__")).decode())
+    meta["version"] = 6
+    for key in ("chain_acc_starts", "fold_draws", "elastic_lineage",
+                "topology"):
+        meta.pop(key, None)
+    arrays["__meta__"] = np.frombuffer(
+        json.dumps(meta).encode(), dtype=np.uint8)
+    np.savez(dst, **arrays)
+
+
+def test_v6_checkpoint_migrates_losslessly(donor4_at32, shrink, data,
+                                           tmp_path):
+    """v6 carries no elastic meta; its defaults (uniform starts at
+    acc_start, nothing folded, lineage 0) are exactly what the donor's
+    v7 meta records - so an elastic adoption of the v6 twin must land
+    bit-for-bit on the v7 shrink result, and the first save after the
+    adoption re-records everything as v7."""
+    v6 = str(tmp_path / "ck.npz")
+    _rewrite_as_v6(donor4_at32, v6)
+    meta = read_checkpoint_meta(v6)
+    assert meta["version"] == 6
+    starts, fold, lineage = elastic_meta(meta, 4)
+    assert (starts, fold, lineage) == ([0, 0, 0, 0], 0, 0)
+
+    run = dataclasses.replace(_cfg().run, num_chains=2)
+    cfg = dataclasses.replace(
+        _cfg(), run=run, checkpoint_path=v6, checkpoint_every_chunks=1,
+        checkpoint_keep_last=2, resume=True)
+    res = fit(data, cfg)
+    np.testing.assert_array_equal(res.sigma_blocks,
+                                  shrink[0].sigma_blocks)
+    m2 = read_checkpoint_meta(v6)
+    assert m2["version"] == 7
+    assert list(m2["chain_acc_starts"]) == [0, 0]
+    assert m2["fold_draws"] == 16
+
+
+# ---------------------------------------------------------------------------
+# strict gate + narration
+# ---------------------------------------------------------------------------
+
+def test_strict_gate_names_the_fix(donor4_at32, data, tmp_path):
+    """elastic=False must refuse with the CONCRETE repair: which chain
+    counts disagree and both ways out."""
+    ck = str(tmp_path / "ck.npz")
+    shutil.copy(donor4_at32, ck)
+    run = dataclasses.replace(_cfg().run, num_chains=2)
+    cfg = dataclasses.replace(_cfg(), run=run, checkpoint_path=ck,
+                              resume=True, elastic=False)
+    with pytest.raises(ValueError,
+                       match="checkpoint has num_chains=4, run configured 2"):
+        fit(data, cfg)
+    meta = read_checkpoint_meta(ck)
+    reason = checkpoint_compatible(meta, cfg, meta["fingerprint"])
+    assert reason == (
+        "checkpoint has num_chains=4, run configured 2; pass --elastic "
+        "(or FitConfig.elastic=True) to adopt it on the new chain "
+        "count, or --chains 4 to match the checkpoint")
+
+
+def test_events_narrate_elastic_resume(shrink):
+    """Satellite of ROADMAP 5(a): `dcfm-tpu events` reports elastic
+    decisions beside the resume decisions."""
+    from dcfm_tpu.obs.cli import _print_summary, summarize
+    _, ck = shrink
+    s = summarize(ck + ".obs")
+    assert s["elastic_resumes"], s
+    e = s["elastic_resumes"][0]
+    assert e["decision"] == "elastic"
+    assert (e["from_chains"], e["to_chains"]) == (4, 2)
+    assert e["fold_draws"] == 16
+    out = []
+    _print_summary(s, out)
+    text = "\n".join(out)
+    assert "elastic resume" in text
+    assert "folded 16 draws into the pool" in text
+
+
+# ---------------------------------------------------------------------------
+# real SIGKILL under supervision
+# ---------------------------------------------------------------------------
+
+def test_supervised_sigkill_shrink_resumes_clean(tmp_path):
+    """The capacity-loss drill end to end: launch 1 runs 4 chains and is
+    SIGKILLed post-save; the relaunch only fits 2 chains (the demo child
+    keys its chain count on the supervised launch number) and must adopt
+    the 4-chain checkpoint elastically instead of dying strict."""
+    from dcfm_tpu.obs.cli import summarize
+    from dcfm_tpu.resilience.supervisor import supervise_command
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["JAX_COMPILATION_CACHE_DIR"] = os.path.join(REPO, ".jax_cache")
+    env["MULTIHOST_DEMO_DIR"] = str(tmp_path)
+    env.pop("DCFM_FAULT_FUZZ", None)
+    env["DCFM_FAULT_PLAN"] = json.dumps(
+        {"faults": [{"op": "kill", "at_iteration": 4,
+                     "when": "post_save"}]})
+    ck = str(tmp_path / "elastic.ck")
+    argv = [sys.executable,
+            os.path.join(REPO, "scripts", "multihost_demo.py"),
+            "--child-elastic"]
+    report = supervise_command(
+        argv, checkpoint_path=ck, max_retries=3, backoff_base=0.05,
+        poison_deaths=3, launch_timeout=300, env=env, log=lambda m: None)
+    assert report.launches == 2
+    assert report.deaths[0][0] == -9          # a real SIGKILL
+    sigma = np.load(tmp_path / "sigma_elastic.npy")
+    assert np.isfinite(sigma).all()
+    s = summarize(ck + ".obs")
+    assert any(e["decision"] == "elastic" for e in s["elastic_resumes"])

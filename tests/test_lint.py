@@ -400,6 +400,43 @@ def test_pointer_rule_exempts_the_cas_home():
                    for f in lint_source(src, "scripts/demo.py"))
 
 
+def test_bad_topology_fires_2001():
+    assert _rules_fired("bad_topology.py") == {"DCFM2001"}
+
+
+def test_bad_topology_flags_every_flow_shape():
+    findings = lint_file(os.path.join(FIXTURES, "bad_topology.py"))
+    msgs = [f.message for f in findings if f.rule == "DCFM2001"]
+    # direct BinOp use, a slice bound, taint through a local, and the
+    # len(jax.devices()) spelling
+    assert len(msgs) == 4
+    assert any("jax.device_count" in m for m in msgs)
+    assert any("jax.process_count" in m for m in msgs)
+    assert any("jax.devices" in m for m in msgs)
+
+
+def test_topology_rule_scopes_to_resume_path_functions():
+    """The hazard is function-scoped: mesh sizing legitimately reads
+    live capacity, and tests/scripts probe topology freely - only
+    resume/checkpoint-path arithmetic must flow from recorded meta."""
+    mesh = ("import jax\n"
+            "def mesh_rows(n_shards):\n"
+            "    return n_shards // jax.device_count()\n")
+    assert not any(f.rule == "DCFM2001"
+                   for f in lint_source(mesh,
+                                        "dcfm_tpu/parallel/mesh.py"))
+    bad = ("import jax\n"
+           "def resume_state(carry):\n"
+           "    return carry[: 2 * jax.device_count()]\n")
+    assert any(f.rule == "DCFM2001"
+               for f in lint_source(bad, "dcfm_tpu/runtime/resume.py"))
+    # library-only scope: tests and scripts stay free
+    assert not any(f.rule == "DCFM2001"
+                   for f in lint_source(bad, "test_mod.py"))
+    assert not any(f.rule == "DCFM2001"
+                   for f in lint_source(bad, "scripts/demo.py"))
+
+
 def test_bad_pragma_fires_002_for_dead_and_unknown():
     findings = lint_file(os.path.join(FIXTURES, "bad_pragma.py"))
     assert {f.rule for f in findings} == {"DCFM002"}
@@ -431,7 +468,7 @@ def test_every_rule_family_has_a_firing_fixture():
     "good_handler.py", "good_locks.py", "good_lifetime.py",
     "good_pragma.py", "good_poll.py", "good_chainaxis.py",
     "good_densequad.py", "good_precision.py", "good_partition.py",
-    "good_pointer.py"])
+    "good_pointer.py", "good_topology.py"])
 def test_good_fixture_is_clean(name):
     findings = lint_file(os.path.join(FIXTURES, name))
     assert findings == [], [str(f) for f in findings]

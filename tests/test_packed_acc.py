@@ -194,9 +194,10 @@ def test_dense_v5_checkpoint_migrates_and_resumes_exactly(tmp_path):
     """Acceptance pin: resuming a pre-change dense-carry (v5) checkpoint
     produces the same posterior mean as an uninterrupted packed run.
 
-    A real v6 checkpoint is rewritten in the v5 on-disk layout (dense
-    (g, g, P, P) accumulators, version=5) and resumed into a longer
-    schedule; the result must match the uninterrupted run bit-for-bit."""
+    A real current-format checkpoint is rewritten in the v5 on-disk
+    layout (dense (g, g, P, P) accumulators, version=5) and resumed into
+    a longer schedule; the result must match the uninterrupted run
+    bit-for-bit."""
     import json
 
     g = 4
@@ -208,11 +209,11 @@ def test_dense_v5_checkpoint_migrates_and_resumes_exactly(tmp_path):
     run_long = dataclasses.replace(run_short, mcmc=20)
     fit(Y, FitConfig(model=model, run=run_short, checkpoint_path=ck))
 
-    # rewrite the packed v6 file in the legacy dense v5 layout
+    # rewrite the packed v7 file in the legacy dense v5 layout
     with np.load(ck) as z:
         entries = {k: z[k] for k in z.files}
     meta = json.loads(bytes(entries["__meta__"]).decode())
-    assert meta["version"] == 6
+    assert meta["version"] == 7
     rows, cols = packed_pair_indices(g)
     n_pairs = num_upper_pairs(g)
     r, c = rows[:n_pairs], cols[:n_pairs]
@@ -230,6 +231,11 @@ def test_dense_v5_checkpoint_migrates_and_resumes_exactly(tmp_path):
     meta["version"] = 5
     # drop the config key v5 never had (RunConfig grew sweep_unroll in v6)
     meta["config"]["run"].pop("sweep_unroll", None)
+    # ...and the elastic bookkeeping v7 added (real v5 files carry none;
+    # the loader defaults them - utils/checkpoint.elastic_meta)
+    for k in ("chain_acc_starts", "fold_draws", "elastic_lineage",
+              "topology"):
+        meta.pop(k, None)
     # drop the integrity map too: real pre-CRC v5 files carry none, and
     # the v6 file's per-leaf CRCs describe the PACKED layout this rewrite
     # just replaced with dense panels (legacy files load unverified)
@@ -243,9 +249,10 @@ def test_dense_v5_checkpoint_migrates_and_resumes_exactly(tmp_path):
     uninterrupted = fit(Y, FitConfig(model=model, run=run_long))
     np.testing.assert_array_equal(resumed.Sigma, uninterrupted.Sigma)
     np.testing.assert_array_equal(resumed.Sigma_sd, uninterrupted.Sigma_sd)
-    # ...and the rewritten file is re-saved packed (v6) at the new end
+    # ...and the rewritten file is re-saved packed (current format) at
+    # the new end
     from dcfm_tpu.utils.checkpoint import read_checkpoint_meta
-    assert read_checkpoint_meta(ck)["version"] == 6
+    assert read_checkpoint_meta(ck)["version"] == 7
 
 
 def test_fetch_reads_packed_natively():
