@@ -355,6 +355,17 @@ TRACE_RULES = {r.id: r for r in [
          "hits jit's trace cache, the silent-retrace hazard ROADMAP "
          "item 4's adaptive-K bucketing must avoid; key on frozen "
          "config dataclasses, shapes, and mesh signatures only"),
+    Rule("DCFM1808", "collective-spans-hosts", "trace",
+         "a data-moving collective (psum/all_gather/pmax/...) inside a "
+         "sweep-body entry reduces over the 'hosts' mesh axis without "
+         "also spanning the 'shards' axis - the pod contract: the only "
+         "sanctioned cross-host collectives are the X update's psum and "
+         "the conquer's all_gather, both of which reduce over the FULL "
+         "(hosts, shards) pair axis; a hosts-only collective mixes "
+         "partial per-host state mid-sweep and breaks the bitwise "
+         "pod-vs-single-host equivalence.  axis_index over hosts (pair "
+         "offset derivation) is exempt: it reads coordinates, it moves "
+         "no data"),
 ]}
 
 

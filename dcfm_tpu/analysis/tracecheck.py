@@ -176,7 +176,7 @@ def check_entry(entry: TraceEntry,
             "entry has likely grown a concrete-value dependence")]
 
     findings = []
-    from dcfm_tpu.parallel.mesh import CHAIN_AXIS
+    from dcfm_tpu.parallel.mesh import CHAIN_AXIS, HOST_AXIS, SHARD_AXIS
 
     declared = frozenset(getattr(spec.mesh, "axis_names", ()) or ())
 
@@ -186,7 +186,8 @@ def check_entry(entry: TraceEntry,
         prim = eqn.primitive.name
         # (a) collective-axis safety
         if prim in _AXIS_PRIMS:
-            for ax in _eqn_axes(eqn):
+            axes = tuple(_eqn_axes(eqn))
+            for ax in axes:
                 if ax not in env:
                     findings.append(finding(
                         "DCFM1801",
@@ -203,6 +204,19 @@ def check_entry(entry: TraceEntry,
                         "contract); reduce over the shard axis only, "
                         "or move the cross-chain reduction to the "
                         "chunk-boundary host side"))
+                elif (entry.sweep_body and ax == HOST_AXIS
+                        and prim in _COMM_PRIMS
+                        and SHARD_AXIS not in axes):
+                    findings.append(finding(
+                        "DCFM1808",
+                        f"{prim} reduces over the {HOST_AXIS!r} mesh "
+                        "axis alone inside a sweep body - only the X "
+                        "update and the conquer may cross hosts, and "
+                        "both span the full "
+                        f"({HOST_AXIS!r}, {SHARD_AXIS!r}) pair axis; a "
+                        "hosts-only collective mixes partial per-host "
+                        "state and breaks the bitwise pod-vs-single-"
+                        "host equivalence"))
         # (b) dtype leaks
         if not bf16_mode:
             for dt in _eqn_dtypes(eqn):

@@ -45,7 +45,8 @@ from dcfm_tpu.models.sampler import (
     run_chunk, schedule_array)
 from dcfm_tpu.models.state import num_upper_pairs, packed_pair_indices
 from dcfm_tpu.parallel.mesh import (
-    legal_chain_grid, make_chain_mesh, make_mesh, shards_per_device)
+    legal_chain_grid, legal_pod_grid, make_chain_mesh, make_mesh,
+    make_pod_mesh, shards_per_device)
 from dcfm_tpu.parallel.multihost import place_sharded_global
 from dcfm_tpu.parallel.shard import (
     build_mesh_chain, place_sharded, place_sharded_streaming)
@@ -815,10 +816,24 @@ def _fit(Y: np.ndarray, cfg: FitConfig) -> FitResult:
             # from the GLOBAL chain index in both layouts, so the chains
             # themselves are identical; single-process only (the
             # multi-host mesh must span all processes' devices 1-D).
-            pack = legal_chain_grid(C, n_mesh, m.num_shards,
-                                    multiproc=multiproc)
-            mesh = (make_chain_mesh(C, n_mesh, devices) if pack
-                    else make_mesh(n_mesh, devices))
+            if multiproc:
+                # Host-sharded pod mesh (parallel.mesh.make_pod_mesh):
+                # the packed pair axis splits over (hosts, shards)
+                # jointly - each host owns a contiguous block of the
+                # padded pair map, sweep collectives stay on the shard
+                # columns, and only the X update / conquer span hosts.
+                # Chains pack onto the 3-axis variant when they divide
+                # the grid (legal_pod_grid); otherwise they stay an
+                # inner vmap axis, exactly like the 1-D fallback.
+                H = jax.process_count()
+                podc = C if (C > 1 and legal_pod_grid(
+                    C, H, n_mesh, m.num_shards)) else 1
+                mesh = make_pod_mesh(H, n_mesh, devices, num_chains=podc)
+            else:
+                pack = legal_chain_grid(C, n_mesh, m.num_shards,
+                                        multiproc=multiproc)
+                mesh = (make_chain_mesh(C, n_mesh, devices) if pack
+                        else make_mesh(n_mesh, devices))
             shards_per_device(m.num_shards, mesh)  # validates divisibility
             t_up = time.perf_counter()
             if pre.is_lazy:

@@ -72,6 +72,21 @@ def summarize(run_dir: str, events=None, torn: int = 0) -> dict:
                         "degraded": e.get("degraded"),
                         "posture": e.get("posture")}
                        for e in by.get("elastic_capacity", [])]
+    # HOST-elastic trail: the supervisor's capacity-probe degrades
+    # (resilience/supervisor, pod_degrade) and the resume's adoption of
+    # a foreign-host-count checkpoint set (runtime/resume, pod_elastic)
+    pod_degrades = [{"decision": e.get("decision"),
+                     "posture": e.get("posture"),
+                     "from_processes": e.get("from_processes"),
+                     "to_processes": e.get("to_processes")}
+                    for e in by.get("pod_degrade", [])]
+    pod_adoptions = [{"role": e.get("role"),
+                      "from_hosts": e.get("from_hosts"),
+                      "to_hosts": e.get("to_hosts"),
+                      "pod_adoptions": e.get("pod_adoptions"),
+                      "pair_panels": e.get("pair_panels"),
+                      "iteration": e.get("iteration")}
+                     for e in by.get("pod_elastic", [])]
     faults = [{k: v for k, v in e.items()
                if k in ("op", "when", "event_name", "at_iteration",
                         "iteration", "target", "path", "write", "role")}
@@ -174,6 +189,8 @@ def summarize(run_dir: str, events=None, torn: int = 0) -> dict:
         "resume_decisions": resumes,
         "elastic_resumes": elastics,
         "elastic_capacity_probes": capacity_probes,
+        "pod_degrades": pod_degrades,
+        "pod_adoptions": pod_adoptions,
         "sentinel_rewinds": rewinds,
         "early_stops": early_stops,
         "faults_injected": faults,
@@ -257,6 +274,22 @@ def _print_summary(s: dict, out: List[str]) -> None:
                 "capacity probe: topology changed "
                 f"{c['recorded_topology']} -> {c['current_topology']} "
                 f"(posture: {c['posture']})")
+    for d in s.get("pod_degrades", ()):
+        if d["decision"] == "degraded":
+            out.append(f"pod degraded {d['from_processes']} -> "
+                       f"{d['to_processes']} host(s): relaunching on the "
+                       "survivors")
+        else:
+            out.append(f"pod degrade REFUSED at {d['from_processes']} -> "
+                       f"{d['to_processes']} host(s) "
+                       f"(posture: {d['posture']})")
+    for a in s.get("pod_adoptions", ()):
+        panels = (f", re-partitioned {a['pair_panels']} pair panels"
+                  if (a.get("pair_panels") or 0) > 0 else "")
+        out.append(f"pod adopted [{a['role']}]: {a['from_hosts']} -> "
+                   f"{a['to_hosts']} host(s) at iteration "
+                   f"{a['iteration']}{panels} "
+                   f"(adoption #{a['pod_adoptions']})")
     for r in s["sentinel_rewinds"]:
         out.append(f"sentinel rewind: iteration {r['iteration']} -> "
                    f"{r['to_iteration']}")

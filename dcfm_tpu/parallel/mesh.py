@@ -28,6 +28,13 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 SHARD_AXIS = "shards"
 CHAIN_AXIS = "chains"
+# Third, host-level axis of the pod mesh (make_pod_mesh): the packed
+# (Q, P, P) pair axis splits over (hosts, shards) jointly, hosts-major,
+# so each host owns a contiguous block of the padded pair map and the
+# only collectives that cross a host boundary are the X update's psum
+# and the conquer's all_gather (both span the full (hosts, shards)
+# pair - the DCFM1808 contract).
+HOST_AXIS = "hosts"
 
 
 def make_mesh(num_devices: int = 0, devices=None) -> Mesh:
@@ -142,6 +149,71 @@ def make_chain_mesh(num_chains: int, num_devices: int = 0,
     return Mesh(grid, (CHAIN_AXIS, SHARD_AXIS))
 
 
+def make_pod_mesh(num_hosts: int, num_devices: int = 0, devices=None,
+                  *, num_chains: int = 1) -> Mesh:
+    """Pod mesh with an explicit host axis: (chains x) hosts x shards.
+
+    The host-sharded variant of :func:`make_chain_mesh` (ROADMAP item 2):
+    the packed pair axis splits over (hosts, shards) jointly, so the
+    (Q, P, P) accumulator that exceeds one host's HBM spreads across the
+    pod, while sweep-local collectives stay on the shard columns and only
+    the X update / conquer reductions span hosts.
+
+    Device grid: ``jax.devices()`` is process-major, so the hosts axis is
+    carved as the OUTER split of each chain's device block -
+    ``reshape(H, C, S).transpose(1, 0, 2)`` places host h's row on global
+    devices [h*C*S, (h+1)*C*S), i.e. exactly process h's devices when H
+    equals the process count.  With ``num_chains`` == 1 the chain axis is
+    omitted (2-D hosts x shards); C >= 2 yields the full 3-axis mesh.
+    """
+    if devices is None:
+        devices = jax.devices()
+    if num_devices:
+        if num_devices > len(devices):
+            raise ValueError(
+                f"requested {num_devices} devices, have {len(devices)}")
+        devices = devices[:num_devices]
+    n = len(devices)
+    if num_hosts < 2:
+        raise ValueError(
+            f"make_pod_mesh needs num_hosts >= 2, got {num_hosts} "
+            "(a single host is the plain shard / chain mesh)")
+    C = max(int(num_chains), 1)
+    if n % (num_hosts * C) != 0:
+        raise ValueError(
+            f"{num_hosts} hosts x {C} chains must divide the {n}-device "
+            "mesh evenly (each (chain, host) cell gets n/(H*C) devices)")
+    s = n // (num_hosts * C)
+    grid = np.array(devices).reshape(num_hosts, C, s)  # dcfm: ignore[DCFM701] - Device handles from jax.devices(), not a global array
+    if jax.process_count() > 1 and num_hosts != jax.process_count():
+        raise ValueError(
+            f"pod mesh with {num_hosts} host rows on a "
+            f"{jax.process_count()}-process run: the hosts axis must "
+            "align with process boundaries (one row per process)")
+    if C == 1:
+        return Mesh(grid.reshape(num_hosts, s), (HOST_AXIS, SHARD_AXIS))
+    return Mesh(grid.transpose(1, 0, 2),
+                (CHAIN_AXIS, HOST_AXIS, SHARD_AXIS))
+
+
+def legal_pod_grid(num_chains: int, num_hosts: int, num_devices: int,
+                   num_shards: int) -> bool:
+    """True when the host-sharded pod mesh is legal for this C x H x N
+    topology: H > 1 host rows, (H * C) dividing the N-device mesh evenly,
+    and the g shards dividing each chain's H * S device block.  The pod
+    twin of :func:`legal_chain_grid` - THE seam the multiproc mesh
+    decision (api.fit) and a host-elastic adoption's re-layout both go
+    through: a pod checkpoint taken on any H restarts on any H' for
+    which this predicate holds.
+    """
+    if num_hosts < 2 or num_chains < 1:
+        return False
+    if num_devices % (num_hosts * max(num_chains, 1)) != 0:
+        return False
+    per_chain = num_devices // max(num_chains, 1)
+    return num_shards % per_chain == 0
+
+
 def legal_chain_grid(num_chains: int, num_devices: int,
                      num_shards: int, *, multiproc: bool = False) -> bool:
     """True when a packed 2-D (chains x shards) mesh is legal for this
@@ -150,9 +222,10 @@ def legal_chain_grid(num_chains: int, num_devices: int,
     pack decision (api.fit) and an elastic resume's re-layout both go
     through - a checkpoint taken on any C x N grid restarts on any
     C' x N' for which this predicate holds (and falls back to the vmap
-    layout otherwise, which is always legal).  Multi-process runs keep
-    the 1-D global mesh: the multi-host mesh must span all processes'
-    devices on the shard axis.
+    layout otherwise, which is always legal).  Multi-process runs use
+    the host-sharded pod mesh instead (make_pod_mesh /
+    legal_pod_grid): the multi-host grid must align host rows with
+    process boundaries, which this single-host predicate never does.
     """
     return (num_chains > 1 and not multiproc
             and num_devices % num_chains == 0
@@ -162,6 +235,12 @@ def legal_chain_grid(num_chains: int, num_devices: int,
 def chain_rows(mesh: Mesh) -> int:
     """Size of the chain mesh axis (1 on a plain 1-D shard mesh)."""
     return mesh.shape.get(CHAIN_AXIS, 1) if CHAIN_AXIS in mesh.axis_names \
+        else 1
+
+
+def host_rows(mesh: Mesh) -> int:
+    """Size of the host mesh axis (1 on a host-free mesh)."""
+    return mesh.shape.get(HOST_AXIS, 1) if HOST_AXIS in mesh.axis_names \
         else 1
 
 
@@ -226,7 +305,8 @@ def match_partition_rules(rules, tree, *, scalar_spec=P()):
     return jax.tree_util.tree_map_with_path(spec_for, tree)
 
 
-def carry_partition_rules(*, packed: bool, num_chains: int):
+def carry_partition_rules(*, packed: bool, num_chains: int,
+                          hosted: bool = False):
     """THE chain-carry partition rule table (ROADMAP item 5: all
     partitioning logic collapses onto one name-keyed table).  The carry
     is shard-major by default; the named exceptions are the shared
@@ -238,14 +318,19 @@ def carry_partition_rules(*, packed: bool, num_chains: int):
     ``packed`` places the leading chain axis over the chain mesh rows
     (2-D chains x shards mesh); otherwise a multi-chain carry keeps an
     unsharded (vmap) leading axis, and a single-chain carry has none.
+    ``hosted`` (pod mesh, make_pod_mesh) splits every shard-major axis
+    over (hosts, shards) JOINTLY - hosts-major, so host h owns a
+    contiguous block of the padded pair map and a host-elastic resume
+    re-partitions by contiguous global offsets.
     """
     lead = ((CHAIN_AXIS,) if packed else (None,)) if num_chains > 1 else ()
+    pax = (HOST_AXIS, SHARD_AXIS) if hosted else SHARD_AXIS
     return [
         (r"\.state\.X$", P(*lead)),
         (r"\.draws\.X$", P(*lead)),
-        (r"\.draws\.", P(*lead, None, SHARD_AXIS)),
+        (r"\.draws\.", P(*lead, None, pax)),
         (r"\.iteration$", P(*lead)),
-        (r".", P(*lead, SHARD_AXIS)),
+        (r".", P(*lead, pax)),
     ]
 
 
@@ -269,8 +354,10 @@ def chain_diag_spec(packed: bool) -> P:
 def shard_sharding(mesh: Mesh) -> NamedSharding:
     """NamedSharding splitting a leading global-shard axis over the
     mesh - the one construction site for the data-placement sharding
-    (place_sharded / place_sharded_global / streaming upload)."""
-    return NamedSharding(mesh, P(SHARD_AXIS))
+    (place_sharded / place_sharded_global / streaming upload).  On a
+    pod mesh the leading axis splits over (hosts, shards) jointly, so
+    the streaming upload feeds each host only its contiguous slice."""
+    return NamedSharding(mesh, shard_spec(HOST_AXIS in mesh.axis_names))
 
 
 def replicated_sharding(mesh: Mesh) -> NamedSharding:
@@ -291,7 +378,7 @@ def named_shardings(mesh: Mesh, specs, tree):
 
 
 def shards_per_device(num_shards: int, mesh: Mesh) -> int:
-    d = mesh.shape[SHARD_AXIS]
+    d = mesh.shape[SHARD_AXIS] * host_rows(mesh)
     if num_shards % d != 0:
         raise ValueError(
             f"g={num_shards} shards must divide over {d} mesh devices; "
@@ -299,9 +386,10 @@ def shards_per_device(num_shards: int, mesh: Mesh) -> int:
     return num_shards // d
 
 
-def shard_spec() -> P:
-    """PartitionSpec for arrays with a leading global-shard axis."""
-    return P(SHARD_AXIS)
+def shard_spec(hosted: bool = False) -> P:
+    """PartitionSpec for arrays with a leading global-shard axis
+    (split over (hosts, shards) jointly on a pod mesh)."""
+    return P((HOST_AXIS, SHARD_AXIS)) if hosted else P(SHARD_AXIS)
 
 
 def replicated_spec() -> P:

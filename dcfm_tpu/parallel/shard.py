@@ -41,9 +41,9 @@ from dcfm_tpu.models.sampler import (
     ChainCarry, ChainStats, DrawBuffers, chain_keys, init_chain, run_chunk)
 from dcfm_tpu.models.state import num_padded_pairs, packed_pair_indices
 from dcfm_tpu.parallel.mesh import (
-    CHAIN_AXIS, SHARD_AXIS, carry_partition_rules, chain_diag_spec,
-    match_partition_rules, replicated_spec, shard_sharding, shard_spec,
-    shards_per_device)
+    CHAIN_AXIS, HOST_AXIS, SHARD_AXIS, carry_partition_rules,
+    chain_diag_spec, match_partition_rules, replicated_spec,
+    shard_sharding, shard_spec, shards_per_device)
 
 
 def _mesh_reduce(x: jax.Array) -> jax.Array:
@@ -111,6 +111,14 @@ def build_mesh_chain(
     gl = shards_per_device(g, mesh)
     C = num_chains
     n_dev = g // gl
+    # Host sharding (make_pod_mesh): the global shard / packed-pair axes
+    # split over (hosts, shards) jointly, hosts-major.  Every sweep-body
+    # collective below spans the FULL (hosts, shards) pair - the X
+    # update's psum and the conquer's all_gather are the only cross-host
+    # traffic, and a collective over the hosts axis alone is the
+    # DCFM1808 lint violation (partial per-host state would mix).
+    hosted = HOST_AXIS in mesh.axis_names
+    pax = (HOST_AXIS, SHARD_AXIS) if hosted else SHARD_AXIS
     # Chain packing: a 2-D mesh splits the C chains over its chain rows.
     packed = CHAIN_AXIS in mesh.axis_names
     c_rows = mesh.shape[CHAIN_AXIS] if packed else 1
@@ -125,10 +133,31 @@ def build_mesh_chain(
     q_local = num_padded_pairs(g) // n_dev
     pair_rows_all, pair_cols_all = packed_pair_indices(g)
 
-    sh = shard_spec()       # leading global-shard axis -> split over mesh
+    sh = shard_spec(hosted)  # leading global-shard axis -> split over mesh
     rep = replicated_spec()
 
     import jax.numpy as jnp  # noqa: F811
+
+    def _pair_device_index():
+        # this device's linear position along the (hosts, shards) pair
+        # split (hosts-major, matching make_pod_mesh's device grid and
+        # the P((HOST_AXIS, SHARD_AXIS)) specs) - or the plain shard
+        # index on a host-free mesh
+        if hosted:
+            return (lax.axis_index(HOST_AXIS) * mesh.shape[SHARD_AXIS]
+                    + lax.axis_index(SHARD_AXIS))
+        return lax.axis_index(SHARD_AXIS)
+
+    def _reduce(x):
+        # X-update reduction: sums over ALL g shards of this chain, so
+        # on a pod mesh it spans (hosts, shards) - one of the two
+        # sanctioned cross-host collectives (with _gather below)
+        return lax.psum(jnp.sum(x, axis=0), pax)
+
+    def _gather(x):
+        # conquer gather: (Gl, ...) local -> (G, ...) all shards in mesh
+        # order - the other sanctioned cross-host collective
+        return lax.all_gather(x, pax, tiled=True)
 
     def carry_specs() -> ChainCarry:
         # Rule-based partition specs, matched by LEAF NAME against the
@@ -137,7 +166,8 @@ def build_mesh_chain(
         # the placement policy; an unmatched new carry field fails
         # loudly there, it cannot silently replicate).
         template = jax.eval_shape(_global_carry, jax.random.key(0))
-        rules = carry_partition_rules(packed=packed, num_chains=C)
+        rules = carry_partition_rules(packed=packed, num_chains=C,
+                                      hosted=hosted)
         return match_partition_rules(rules, template)
 
     def _global_carry(key):
@@ -157,13 +187,13 @@ def build_mesh_chain(
         return init_chain(
             key, Y, cfg, prior,
             num_global_shards=g,
-            shard_offset=_shard_offset(gl),
+            shard_offset=_pair_device_index() * gl,
             num_stored_draws=num_stored_draws,
             num_local_pairs=q_local)
 
     def _local_pairs():
         # this device's contiguous slice of the packed-pair index map
-        off = lax.axis_index(SHARD_AXIS) * q_local
+        off = _pair_device_index() * q_local
         pr = lax.dynamic_slice(jnp.asarray(pair_rows_all), (off,),
                                (q_local,))
         pc = lax.dynamic_slice(jnp.asarray(pair_cols_all), (off,),
@@ -177,9 +207,9 @@ def build_mesh_chain(
             num_iters=num_iters,
             num_global_shards=g,
             pair_rows=pr, pair_cols=pc,
-            shard_offset=_shard_offset(gl),
-            reduce_fn=_mesh_reduce,
-            gather_fn=_mesh_gather,
+            shard_offset=_pair_device_index() * gl,
+            reduce_fn=_reduce,
+            gather_fn=_gather,
             unroll=unroll)
 
     def _row_keys(key):
@@ -206,16 +236,16 @@ def build_mesh_chain(
         # chain-packed mesh both reductions span only this chain row's
         # devices - the sweep never communicates across chains).
         stats = ChainStats(
-            tau_log_max=lax.pmax(stats.tau_log_max, SHARD_AXIS),
-            ps_min=lax.pmin(stats.ps_min, SHARD_AXIS),
-            ps_max=lax.pmax(stats.ps_max, SHARD_AXIS),
-            rank_min=lax.pmin(stats.rank_min, SHARD_AXIS),
-            rank_max=lax.pmax(stats.rank_max, SHARD_AXIS),
+            tau_log_max=lax.pmax(stats.tau_log_max, pax),
+            ps_min=lax.pmin(stats.ps_min, pax),
+            ps_max=lax.pmax(stats.ps_max, pax),
+            rank_min=lax.pmin(stats.rank_min, pax),
+            rank_max=lax.pmax(stats.rank_max, pax),
             # devices hold equal shard counts, so the mean of means is exact
-            rank_mean=lax.pmean(stats.rank_mean, SHARD_AXIS),
-            nonfinite_count=lax.psum(stats.nonfinite_count, SHARD_AXIS),
+            rank_mean=lax.pmean(stats.rank_mean, pax),
+            nonfinite_count=lax.psum(stats.nonfinite_count, pax),
             # each device counted its own packed-accumulator slice
-            acc_nonfinite=lax.psum(stats.acc_nonfinite, SHARD_AXIS))
+            acc_nonfinite=lax.psum(stats.acc_nonfinite, pax))
         return carry, stats, trace
 
     specs = carry_specs()
@@ -291,10 +321,11 @@ from dcfm_tpu.analysis.registry import (
     SkipEntry, TraceSpec, register_trace_entry)
 
 
-def _mesh_chunk_spec(mesh: Mesh, num_chains: int) -> TraceSpec:
+def _mesh_chunk_spec(mesh: Mesh, num_chains: int,
+                     num_shards: int = 4) -> TraceSpec:
     from dcfm_tpu.models.priors import make_prior
 
-    cfg = ModelConfig(num_shards=4, factors_per_shard=3, rho=0.8)
+    cfg = ModelConfig(num_shards=num_shards, factors_per_shard=3, rho=0.8)
     prior = make_prior(cfg)
     init_fn, chunk_fn, _specs = build_mesh_chain(
         mesh, cfg, prior, num_iters=2, num_chains=num_chains)
@@ -325,3 +356,18 @@ def _trace_packed_chunk() -> TraceSpec:
     if jax.device_count() < 4:
         raise SkipEntry("needs >= 4 devices for the chains x shards mesh")
     return _mesh_chunk_spec(make_chain_mesh(2, 4), 2)
+
+
+@register_trace_entry("parallel.pod_chunk", sweep_body=True,
+                      donate_argnum=2)
+def _trace_pod_chunk() -> TraceSpec:
+    # The host-sharded pod chunk at its representative 2-host mesh: the
+    # DCFM1808 gate walks this jaxpr to verify no data-moving collective
+    # spans the hosts axis without also spanning the shard columns (only
+    # the X update / conquer reductions cross hosts, and they span the
+    # full (hosts, shards) pair).
+    from dcfm_tpu.parallel.mesh import make_pod_mesh
+
+    if jax.device_count() < 8:
+        raise SkipEntry("needs >= 8 devices for the hosts x shards mesh")
+    return _mesh_chunk_spec(make_pod_mesh(2, 8), 1, num_shards=8)

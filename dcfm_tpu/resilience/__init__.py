@@ -17,7 +17,11 @@ first-class, *tested* subsystem:
   coordinated stop (survivors blocked in collectives are reaped, not
   left hung), the relaunch resumes from the newest *unanimously-held*
   CRC-clean checkpoint generation, and a deadlock is bounded by a
-  watchdog (typed :class:`PodHangError`).
+  watchdog (typed :class:`PodHangError`).  When the relaunch capacity
+  probe reports fewer surviving hosts the pod DEGRADES onto them -
+  the children host-elastically adopt the old ``.procK-of-N`` set -
+  instead of retrying at full size forever (vetoed by
+  ``--no-elastic``: typed :class:`PodCapacityError`).
 * :mod:`dcfm_tpu.resilience.faults` - a deterministic fault-injection
   harness driven by the ``DCFM_FAULT_PLAN`` environment variable
   (kill-at-iteration, kill-inside-a-named-resume-window, torn
@@ -43,8 +47,9 @@ from dcfm_tpu.resilience.faults import (
 from dcfm_tpu.resilience.sentinel import (
     ChainDivergedError, DivergenceSentinel)
 from dcfm_tpu.resilience.supervisor import (
-    PodHangError, PoisonedRunError, RetriesExhaustedError,
-    SuperviseReport, supervise, supervise_command, supervise_pod)
+    PodCapacityError, PodHangError, PoisonedRunError,
+    RetriesExhaustedError, SuperviseReport, supervise, supervise_command,
+    supervise_pod)
 
 __all__ = [
     "ChainDivergedError",
@@ -53,6 +58,7 @@ __all__ = [
     "fault_event",
     "fault_plan",
     "fuzz_spec",
+    "PodCapacityError",
     "PodHangError",
     "PoisonedRunError",
     "RetriesExhaustedError",

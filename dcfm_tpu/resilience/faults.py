@@ -132,6 +132,16 @@ FUZZ_EVENTS = ("resume_gate", "resume_gate_post", "sidecar_gate",
 # that stream.
 ELASTIC_EVENTS = ("elastic_gate", "elastic_fold", "elastic_fold_post")
 
+# Host-elastic (pod-degrade) events: the cooperative artifact export
+# (serve/artifact.write_artifact_cooperative) emits one before each of
+# its three barrier phases - a host killed there leaves its peers
+# blocked inside the sync, the state the pod supervisor's coordinated
+# stop must reap.  ``pod_fuzz_spec`` sweeps kills over these windows
+# plus the resume gates and plain boundaries;
+# DCFM_FAULT_FUZZ=seed:index:pod selects that stream.
+POD_EVENTS = ("coop_export_prepare", "coop_export_panels",
+              "coop_export_meta")
+
 
 class FaultPlanError(ValueError):
     """Malformed DCFM_FAULT_PLAN."""
@@ -190,12 +200,13 @@ class FaultPlan:
             fuzz = os.environ.get(FUZZ_ENV_VAR)
             if not fuzz:
                 return None
-            m = re.match(r"^(-?\d+):(\d+)(:elastic)?$", fuzz.strip())
+            m = re.match(r"^(-?\d+):(\d+)(:elastic|:pod)?$", fuzz.strip())
             if not m:
                 raise FaultPlanError(
-                    f"{FUZZ_ENV_VAR} must be 'seed:index[:elastic]', "
-                    f"got {fuzz!r}")
-            gen = elastic_fuzz_spec if m.group(3) else fuzz_spec
+                    f"{FUZZ_ENV_VAR} must be 'seed:index[:elastic|:pod]',"
+                    f" got {fuzz!r}")
+            gen = {":elastic": elastic_fuzz_spec,
+                   ":pod": pod_fuzz_spec}.get(m.group(3), fuzz_spec)
             return cls(gen(int(m.group(1)), int(m.group(2))))
         if raw.startswith("@"):
             with open(raw[1:], "r", encoding="utf-8") as f:
@@ -489,6 +500,47 @@ def elastic_fuzz_spec(seed: int, index: int, *,
         faults.append({"op": "kill_event",
                        "event": rng.choice(list(events)),
                        "at_occurrence": 1, "at_launch": 2})
+    return {"faults": faults}
+
+
+def pod_fuzz_spec(seed: int, index: int, *,
+                  boundaries=(2, 4, 6, 8),
+                  nproc: int = 2,
+                  events=POD_EVENTS) -> dict:
+    """The ``index``-th crash point of the HOST-ELASTIC fuzz stream
+    (``DCFM_FAULT_FUZZ=seed:index:pod``): one host of launch 1 is
+    killed - at a random checkpointing boundary, inside a random
+    multi-host resume-gate window, or inside one of the cooperative
+    artifact export's barrier phases (:data:`POD_EVENTS`) - and the
+    harness relaunches the pod DEGRADED to the survivors
+    (supervisor._pod_capacity), whose resume host-elastically adopts
+    the dead topology's ``.procK-of-N`` set.  The degraded launch must
+    finish with an intact pooled Sigma and a CRC-clean artifact:
+    boundary kills leave a resumable generation, export-window kills
+    happen after the chain completed (the relaunch re-runs a no-op
+    resume plus a fresh export over the invalidated meta), and resume-
+    gate kills leave the old generation untouched.  Kills are gated
+    ``at_launch: 1`` for :func:`fuzz_spec`'s reason: the death models
+    an environmental host loss, not a deterministic fault."""
+    rng = random.Random(f"dcfm-pod-fuzz:{int(seed)}:{int(index)}")
+    boundaries = tuple(int(b) for b in boundaries)
+    kind = rng.choice(["boundary_kill", "export_kill", "gate_kill"])
+    proc = rng.randrange(nproc)
+    if kind == "boundary_kill":
+        faults = [{"op": "kill", "at_iteration": rng.choice(boundaries),
+                   "when": rng.choice(["pre_save", "post_save"]),
+                   "process": proc, "at_launch": 1}]
+    elif kind == "export_kill":
+        faults = [{"op": "kill_event", "event": rng.choice(list(events)),
+                   "at_occurrence": 1, "process": proc, "at_launch": 1}]
+    else:
+        # only the resume-gate pair: the sidecar windows in FUZZ_EVENTS
+        # never open under the full checkpoint mode the pod harness
+        # runs, and a fault that cannot fire is a wasted fuzz point
+        faults = [{"op": "kill_event",
+                   "event": rng.choice(["resume_gate",
+                                        "resume_gate_post"]),
+                   "at_occurrence": 1, "process": proc, "at_launch": 1}]
     return {"faults": faults}
 
 

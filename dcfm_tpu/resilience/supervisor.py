@@ -53,8 +53,10 @@ light save re-runs the lost window - documented in README).
 from __future__ import annotations
 
 import dataclasses
+import inspect
 import json
 import os
+import random
 import re
 import subprocess
 import sys
@@ -99,6 +101,15 @@ class PodHangError(RuntimeError):
     different resume branch.  A hang is a BUG (the unanimity gates
     exist to make it impossible), so it is raised typed, never
     retried."""
+
+
+class PodCapacityError(RuntimeError):
+    """Surviving host capacity is below the configured pod size and
+    elastic degrade is vetoed (``--no-elastic`` /
+    ``DCFM_NO_ELASTIC=1``): relaunching at full N would just die again
+    on the missing hosts, and degrading was explicitly forbidden - so
+    the supervisor stops typed instead of burning the retry budget.
+    The message names both ways out."""
 
 
 @dataclasses.dataclass
@@ -255,6 +266,87 @@ def _capacity_probe(checkpoint_path: str, num_processes: int,
                else "resume elastically on surviving capacity"))
 
 
+def _pod_capacity(current: int) -> int:
+    """Surviving host capacity for the next launch, clamped to
+    ``[1, current]`` - a pod only ever DEGRADES mid-run (growing past
+    the configured N would need hosts the coordinator never
+    rendezvoused with).  The probe reads ``DCFM_POD_CAPACITY`` (an
+    integer) or the file named by ``DCFM_POD_CAPACITY_FILE`` (the
+    cluster-inventory seam: whatever tells this launcher how many hosts
+    still answer writes the number there - the demo's SIGKILL harness
+    does exactly that).  Absent, empty, or unreadable means "no news":
+    the current size stands."""
+    raw = os.environ.get("DCFM_POD_CAPACITY")
+    if not raw:
+        f = os.environ.get("DCFM_POD_CAPACITY_FILE")
+        if f:
+            try:
+                with open(f, encoding="utf-8") as fh:
+                    raw = fh.read().strip()
+            except OSError:
+                raw = None
+    if not raw:
+        return current
+    try:
+        cap = int(raw)
+    except ValueError:
+        return current
+    return max(1, min(cap, current))
+
+
+def _proc_families(path: str) -> dict:
+    """COMPLETE ``.procK-of-M`` slot families on disk, live or retained:
+    ``{M: [slot paths 0..M-1]}`` for every M whose full slot range has
+    at least one generation each (filename scan only - jax-free like
+    every parent-side probe).  A complete family is a resumable unit
+    whatever topology the next launch runs at
+    (checkpoint.load_checkpoint_resharded is count-agnostic), so the
+    integrity pre-pass must treat its slots TOGETHER - promote one
+    unanimously-held generation across the family - never per-slot
+    newest, which can mix generations the collective resume gate (or
+    the resharded load) would then refuse forever."""
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    out: dict = {}
+    if not os.path.isdir(d):
+        return out
+    base = re.escape(os.path.basename(path))
+    pat = re.compile(f"^{base}\\.proc(\\d+)-of-(\\d+)(\\.bak\\d+)?$")
+    found: dict = {}
+    for f in os.listdir(d):
+        m = pat.match(f)
+        if m:
+            found.setdefault(int(m.group(2)), set()).add(int(m.group(1)))
+    from dcfm_tpu.utils.checkpoint import proc_path
+    for count, idxs in sorted(found.items()):
+        if idxs == set(range(count)):
+            out[count] = [proc_path(path, i, count) for i in range(count)]
+    return out
+
+
+def _ensure_family(fam: list, report: SuperviseReport,
+                   log: Callable[[str], None]) -> int:
+    """Promote, into every live slot of one ``.procK-of-M`` family, the
+    newest generation held CRC-clean by ALL its slots; demote corrupt
+    generations along the way.  Returns the promoted iteration (-1 =
+    no unanimously-held generation; the family is left as-is - it may
+    still lose discovery to a better source, and the current-topology
+    pre-pass owns the orphan-on-no-unanimity rule)."""
+    gens = [_clean_generations(s, report, log) for s in fam]
+    it_star = _unanimous_iteration(gens)
+    if it_star >= 0:
+        for slot, g in zip(fam, gens):
+            src = g[it_star]
+            if src != slot:
+                _promote(src, slot)
+                log(f"promoted retained checkpoint {src} -> {slot} "
+                    f"(iteration {it_star}, unanimous over "
+                    f"{len(fam)} slots)")
+                record("checkpoint_promote", src=os.path.basename(src),
+                       slot=os.path.basename(slot), iteration=it_star,
+                       unanimous=True)
+    return it_star
+
+
 def _unanimous_iteration(per_slot_holdings) -> int:
     """THE one encoding of the unanimously-held-generation rule: the
     newest iteration present in EVERY slot's holdings (any iterable of
@@ -393,11 +485,21 @@ def _ensure_good_checkpoint(path: str, report: SuperviseReport,
     every per-process ``.procK-of-N`` slot (multi-host children), walk
     the retention chain newest-first, demote every CRC-corrupt file to
     ``<file>.corrupt``, and promote the first verified generation so
-    the child's resume sees only clean bytes.  Returns the resulting
-    chain progress (:func:`_progress_iteration`), or -1 when no
-    checkpoint exists yet (first launch / nothing survived)."""
+    the child's resume sees only clean bytes.  Slots that form a
+    COMPLETE ``.procK-of-M`` family (a degraded relaunch resuming a
+    pod's set on fewer hosts - host-elastic resume) are promoted as a
+    unit to their newest unanimously-held generation instead of
+    per-slot newest, which could mix generations the resharded load
+    would refuse.  Returns the resulting chain progress
+    (:func:`_progress_iteration`), or -1 when no checkpoint exists yet
+    (first launch / nothing survived)."""
+    families = _proc_families(path)
+    in_family = {s for fam in families.values() for s in fam}
     for slot in _checkpoint_slots(path):
-        _ensure_slot(slot, report, log)
+        if slot not in in_family:
+            _ensure_slot(slot, report, log)
+    for fam in families.values():
+        _ensure_family(fam, report, log)
     return _progress_iteration(path)
 
 
@@ -424,17 +526,26 @@ def _ensure_unanimous_checkpoint(path: str, num_processes: int,
     from dcfm_tpu.utils.checkpoint import proc_path, scan_generations
     slots = [proc_path(path, i, num_processes)
              for i in range(num_processes)]
-    # Slots OUTSIDE the current-N set keep the single-slot treatment:
-    # the plain path (an earlier single-process run of the same chain)
-    # and any stale ``.procK-of-M`` set from a different process count
-    # - discovery's most-progress rule can still select those for a
-    # topology-flexible resume, so a corrupt one must be demoted here
-    # exactly as the single-host pre-pass would, or it wins discovery
-    # and fails the load on every relaunch.
+    # Slots OUTSIDE the current-N set still get the integrity walk:
+    # discovery's most-progress rule can select the plain path (an
+    # earlier single-process run of the same chain) or a ``.procK-of-M``
+    # set from a different host count (host-elastic resume after a
+    # degrade), so a corrupt generation there must be demoted here or
+    # it wins discovery and fails the load on every relaunch.  Complete
+    # other-count families are promoted as a UNIT to their own
+    # unanimous generation (per-slot newest could mix generations the
+    # resharded load refuses); only the current-N family below carries
+    # the orphan-on-no-unanimity rule - other counts are history, not
+    # the state this launch must be able to write.
     current = set(slots)
+    families = _proc_families(path)
+    families.pop(num_processes, None)
+    in_family = {s for fam in families.values() for s in fam}
     for slot in _checkpoint_slots(path):
-        if slot not in current:
+        if slot not in current and slot not in in_family:
             _ensure_slot(slot, report, log)
+    for fam in families.values():
+        _ensure_family(fam, report, log)
     gens = [_clean_generations(s, report, log) for s in slots]
     it_star = _unanimous_iteration(gens)
     if it_star >= 0:
@@ -620,24 +731,57 @@ def _supervision_loop(
     t0 = time.perf_counter()
     prev_death_iter: Optional[int] = None
     same_iter_deaths = 0
+    # the pod size is MUTABLE state of the loop: a relaunch pre-pass
+    # that finds fewer surviving hosts (_pod_capacity) degrades the pod
+    # and every later attempt runs at the reduced size
+    n_procs = num_processes
+    try:
+        spawn_takes_n = len(inspect.signature(spawn).parameters) >= 2
+    except (TypeError, ValueError):  # builtins / odd callables: legacy arity
+        spawn_takes_n = False
 
     def _pre_pass():
-        if num_processes > 1:
+        if n_procs > 1:
             return _ensure_unanimous_checkpoint(
-                checkpoint_path, num_processes, report, log)
+                checkpoint_path, n_procs, report, log)
         return _ensure_good_checkpoint(checkpoint_path, report, log)
 
     while True:
+        if num_processes > 1:
+            cap = _pod_capacity(n_procs)
+            if cap < n_procs:
+                if os.environ.get("DCFM_NO_ELASTIC") == "1":
+                    rec.emit("pod_degrade", decision="refused",
+                             posture="disabled", from_processes=n_procs,
+                             to_processes=cap)
+                    rec.flush(fsync=True)
+                    raise PodCapacityError(
+                        f"surviving capacity is {cap} host(s) but the "
+                        f"pod is configured for {n_procs} and elastic "
+                        "degrade is vetoed (--no-elastic / "
+                        "DCFM_NO_ELASTIC=1); drop the veto to relaunch "
+                        "degraded on the survivors, or restore "
+                        f"{n_procs} host(s) and relaunch"
+                        + _postmortem(obs_dir,
+                                      report.launches or None))
+                rec.emit("pod_degrade", decision="degraded",
+                         posture="elastic", from_processes=n_procs,
+                         to_processes=cap)
+                rec.flush(fsync=True)
+                log(f"pod degraded {n_procs} -> {cap} host(s); "
+                    "relaunching on the survivors")
+                n_procs = cap
         it_before = _pre_pass()
-        _capacity_probe(checkpoint_path, num_processes, rec, log)
+        _capacity_probe(checkpoint_path, n_procs, rec, log)
         report.launches += 1
         rec.emit("supervisor_launch", attempt=report.launches,
                  checkpoint_iteration=it_before,
-                 num_processes=num_processes)
+                 num_processes=n_procs)
         rec.flush(fsync=True)
         log(f"launch #{report.launches} (checkpoint at iteration "
             f"{it_before})")
-        procs = spawn(report.launches)
+        procs = (spawn(report.launches, n_procs) if spawn_takes_n
+                 else spawn(report.launches))
         # the watchdog's liveness probe: cheap meta-only reads (no CRC
         # scan - that is the relaunch pre-pass's job), so polling it at
         # the coarse _await_pod cadence costs nothing
@@ -645,7 +789,7 @@ def _supervision_loop(
             rc = _await_pod(
                 procs, launch_timeout, grace, log,
                 progress_fn=lambda: _watchdog_progress(checkpoint_path,
-                                                       num_processes))
+                                                       n_procs))
         except PodHangError as e:
             report.elapsed_s = time.perf_counter() - t0
             rec.emit("supervisor_hang", launch=report.launches,
@@ -668,8 +812,8 @@ def _supervision_loop(
             log(f"child finished after {report.launches} launch(es), "
                 f"{report.corrupt_fallbacks} corrupt fallback(s)")
             return report
-        it_died = (_pod_progress(checkpoint_path, num_processes)
-                   if num_processes > 1
+        it_died = (_pod_progress(checkpoint_path, n_procs)
+                   if n_procs > 1
                    else _progress_iteration(checkpoint_path))
         report.deaths.append((rc, it_died))
         rec.emit("supervisor_death", exit=rc, iteration=it_died,
@@ -712,10 +856,18 @@ def _supervision_loop(
                 f"child died {retries} times (retry budget {max_retries}); "
                 f"last exit {rc} at iteration {it_died}"
                 + _postmortem(obs_dir, report.launches))
-        delay = min(backoff_max, backoff_base * (2.0 ** (retries - 1)))
-        rec.emit("supervisor_backoff", seconds=delay,
-                 next_attempt=report.launches + 1)
-        log(f"backing off {delay:.2f}s before relaunch")
+        # FULL jitter under the exponential cap (not a jittered offset):
+        # a pod's worth of supervisors relaunching after one fabric
+        # event would otherwise thunder onto the coordinator in
+        # lockstep - uniform over [0, cap] decorrelates them while
+        # keeping the same worst-case wait.  The drawn delay is
+        # recorded beside its cap so a postmortem can tell schedule
+        # from luck.
+        cap = min(backoff_max, backoff_base * (2.0 ** (retries - 1)))
+        delay = random.uniform(0.0, cap)
+        rec.emit("supervisor_backoff", seconds=round(delay, 4),
+                 cap=round(cap, 4), next_attempt=report.launches + 1)
+        log(f"backing off {delay:.2f}s (cap {cap:.2f}s) before relaunch")
         time.sleep(delay)
 
 
@@ -804,6 +956,17 @@ def supervise_pod(
     avoids racing the dead coordinator's socket.  The children must
     checkpoint to ``checkpoint_path`` (per-process ``.procK-of-N``
     files) and resume from it when relaunched.
+
+    HOST-ELASTIC degrade: a ``spawn(attempt, n)`` callable (two
+    parameters) is handed the CURRENT pod size and must start ``n``
+    processes - when the relaunch capacity probe (``DCFM_POD_CAPACITY``
+    / ``DCFM_POD_CAPACITY_FILE``, :func:`_pod_capacity`) reports fewer
+    surviving hosts, the loop degrades the pod to the survivors (a
+    ``pod_degrade`` event; the children adopt the old set via the
+    host-elastic resume) instead of retrying at full N forever.  With
+    ``DCFM_NO_ELASTIC=1`` the degrade is refused with a typed
+    :class:`PodCapacityError` naming both ways out.  One-parameter
+    ``spawn(attempt)`` callables keep the fixed-size contract.
 
     On any process death the survivors are reaped (they are blocked
     inside collectives a dead peer can never join - see
@@ -934,14 +1097,18 @@ def run_supervised_cli(child_argv: list, *, checkpoint: str,
         os.environ["DCFM_NO_ELASTIC"] = "1"
     try:
         if pod > 1:
-            def spawn(attempt: int) -> list:
+            def spawn(attempt: int, n: int) -> list:
+                # two-parameter protocol: n is the CURRENT pod size,
+                # which the capacity probe may have degraded below the
+                # configured --pod N (the children see the reduced
+                # count and host-elastically adopt the old set)
                 procs = []
-                for i in range(pod):
+                for i in range(n):
                     env = dict(os.environ)
                     env.pop("DCFM_OBS_ROLE", None)  # children ARE launches
                     env["DCFM_COORDINATOR"] = (
                         f"127.0.0.1:{port_base + attempt}")
-                    env["DCFM_NUM_PROCESSES"] = str(pod)
+                    env["DCFM_NUM_PROCESSES"] = str(n)
                     env["DCFM_PROCESS_ID"] = str(i)
                     env["DCFM_FAULT_PROCESS"] = str(i)
                     env["DCFM_FAULT_LAUNCH"] = str(attempt)
@@ -959,7 +1126,8 @@ def run_supervised_cli(child_argv: list, *, checkpoint: str,
                 backoff_base=backoff_base, backoff_max=backoff_max,
                 poison_deaths=poison_deaths,
                 launch_timeout=launch_timeout)
-    except (PoisonedRunError, RetriesExhaustedError, PodHangError) as e:
+    except (PoisonedRunError, RetriesExhaustedError, PodHangError,
+            PodCapacityError) as e:
         print(json.dumps({  # dcfm: ignore[DCFM901] - the CLI's documented stderr JSON protocol
             "error": type(e).__name__, "message": str(e),
             "checkpoint": getattr(e, "checkpoint_path", None),

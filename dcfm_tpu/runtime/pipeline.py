@@ -867,6 +867,11 @@ def run_chain(*, cfg, model, run, sched, phase: dict, multiproc: bool,
                             chain_acc_starts=list(
                                 rctx.elastic.chain_acc_starts),
                             fold_draws=rctx.elastic.fold_draws)
+                if rctx.pod is not None:
+                    # host-adoption counter: meta-only, rides every
+                    # save (like the lineage) so a further topology
+                    # change extends the count instead of restarting it
+                    ek["pod_adoptions"] = rctx.pod["pod_adoptions"]
                 try:
                     writer.submit(save_fn, target, carry, cfg,
                                   fingerprint=fingerprint,

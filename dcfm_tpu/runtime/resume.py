@@ -38,8 +38,8 @@ from dcfm_tpu.utils.checkpoint import (
     _verify_crc, checkpoint_compatible, config_from_checkpoint_meta,
     discover_checkpoint, elastic_meta, load_checkpoint,
     load_checkpoint_elastic, load_checkpoint_multiprocess,
-    load_checkpoint_resharded, proc_path, read_checkpoint_meta,
-    retained_checkpoints)
+    load_checkpoint_resharded, pod_meta, proc_path,
+    read_checkpoint_meta, retained_checkpoints)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -72,13 +72,21 @@ class ResumeContext:
     that contract), and any elastic bookkeeping - a fresh adoption, or
     the carried-over state of a v7 checkpoint that was itself saved
     after one - is written here for the pipeline to read after the
-    call.  None means the uniform divisor path."""
+    call.  None means the uniform divisor path.
+
+    ``pod`` is the host-elastic OUT field (checkpoint meta v8): set to
+    ``{"from_hosts", "to_hosts", "pod_adoptions"}`` when the resumed
+    source was written on a different host count (a fresh adoption,
+    narrated as a ``pod_elastic`` event) or already carries a non-zero
+    adoption count that subsequent saves must keep threading.  None
+    means the chain has never crossed a host topology change."""
 
     cfg: FitConfig
     fingerprint: Optional[str]
     multiproc: bool
     k_init: Any
     elastic: Optional[ElasticResume] = None
+    pod: Optional[dict] = None
 
 
 def _elastic_allowed(cfg: FitConfig) -> bool:
@@ -118,6 +126,50 @@ def _elastic_carryover(meta: dict,
         elastic_lineage=int(lineage),
         from_topology=meta.get("topology"),
         to_topology=_run_topology_now(cfg))
+
+
+def _pod_carryover(ctx: ResumeContext, meta: dict) -> None:
+    """Thread the v8 host-elastic bookkeeping for a source being resumed
+    on the CURRENT host count, narrating a host-count change as a
+    ``pod_elastic`` event ("pod degraded H -> H', re-partitioned the Q
+    pair panels").  The adoption counter never rewinds within a lineage:
+    a same-topology resume keeps the donor's count, a topology-crossing
+    one bumps it."""
+    from dcfm_tpu.models.state import num_padded_pairs
+    now = jax.process_count()
+    from_hosts, adoptions = pod_meta(meta)
+    if from_hosts != now:
+        adoptions += 1
+        try:
+            g = int(config_from_checkpoint_meta(meta).model.num_shards)
+            pairs = int(num_padded_pairs(g))
+        except Exception:  # dcfm: ignore[DCFM601] - narration only; the adoption itself needs no pair count
+            pairs = -1
+        record("pod_elastic", decision="adopted",
+               from_hosts=from_hosts, to_hosts=now,
+               pod_adoptions=adoptions, pair_panels=pairs,
+               iteration=int(meta.get("iteration", -1)))
+    ctx.pod = ({"from_hosts": from_hosts, "to_hosts": now,
+                "pod_adoptions": adoptions}
+               if (from_hosts != now or adoptions) else None)
+
+
+def _pod_refusal(meta: dict, cfg: FitConfig) -> Optional[str]:
+    """Strict host-topology refusal: why this checkpoint's writer host
+    count cannot be adopted on the current one, or None (topology
+    matches, or elastic adoption is allowed).  Mirrors the chains
+    refusal: the message names the fix."""
+    if _elastic_allowed(cfg):
+        return None
+    from_hosts, _ = pod_meta(meta)
+    now = jax.process_count()
+    if from_hosts == now:
+        return None
+    return (f"checkpoint was written by a {from_hosts}-host pod, run "
+            f"has {now} host(s) and elastic adoption is vetoed; drop "
+            "--no-elastic (DCFM_NO_ELASTIC=1) to re-partition the pair "
+            f"panels onto the surviving hosts, or relaunch with --pod "
+            f"{from_hosts} to match the checkpoint")
 
 
 def sidecar_esig(elig) -> np.ndarray:
@@ -214,6 +266,7 @@ def _try_full_sidecar(ctx: ResumeContext, template, light_kept: int):
             carry, smeta = load_checkpoint_resharded(source[1][1],
                                                      template)
         ctx.elastic = _elastic_carryover(smeta, ctx.cfg)
+        _pod_carryover(ctx, smeta)
         return carry, int(smeta["iteration"]), s_acc0
     except Exception:  # dcfm: ignore[DCFM601] - sidecar load is best-effort; caller falls back to light resume
         return None
@@ -230,9 +283,9 @@ def _warm_incompatible(meta: dict, cfg: FitConfig) -> Optional[str]:
     and the same model up to ``num_shards`` (the one model field that
     grows when a new feature shard arrives - K, prior family, and the
     adapt schedule shape the state pytree itself)."""
-    if int(meta["version"]) not in (6, 7):
+    if int(meta["version"]) not in (6, 7, 8):
         return (f"donor checkpoint is format v{meta['version']}, "
-                "warm start requires v6/v7")
+                "warm start requires v6/v7/v8")
     old = config_from_checkpoint_meta(meta)
     if old.run.num_chains != cfg.run.num_chains and (
             old.run.num_chains == 1 or cfg.run.num_chains == 1):
@@ -416,6 +469,7 @@ def _try_elastic(ctx: ResumeContext, init_fn, Yd, *, kind, found,
            to_topology=_run_topology_now(cfg))
     record("resume_decision", decision="elastic", iteration=it,
            acc_start=acc0)
+    _pod_carryover(ctx, meta)
     return carry, it, acc0
 
 
@@ -432,6 +486,7 @@ def resume_state(ctx: ResumeContext, init_fn, Yd):
     cfg, run = ctx.cfg, ctx.cfg.run
     auto = cfg.resume == "auto"
     ctx.elastic = None
+    ctx.pod = None
     source = None
     if cfg.resume:
         # One discovery picks the most-progressed source among the
@@ -458,6 +513,10 @@ def resume_state(ctx: ResumeContext, init_fn, Yd):
             meta = read_checkpoint_meta(
                 cfg.checkpoint_path if kind == "plain" else found[1][0])
             reason = checkpoint_compatible(meta, cfg, ctx.fingerprint)
+            if reason is None:
+                # host-topology veto (--no-elastic): a checkpoint from a
+                # different host count may only be adopted elastically
+                reason = _pod_refusal(meta, cfg)
         except Exception:
             if not auto:
                 raise
@@ -528,12 +587,14 @@ def resume_state(ctx: ResumeContext, init_fn, Yd):
                             elastic_lineage=lin,
                             from_topology=meta.get("topology"),
                             to_topology=_run_topology_now(cfg))
+                    _pod_carryover(ctx, meta)
                     return carry, it, it
                 acc0 = int(meta.get("acc_start", 0))
                 # a v7 file saved after an elastic resume carries
                 # non-uniform window starts / a folded draw count that
                 # the divisor must keep honoring on a SAME-count resume
                 ctx.elastic = _elastic_carryover(meta, cfg)
+                _pod_carryover(ctx, meta)
                 record("resume_decision", decision="resume", kind=kind,
                        iteration=it, acc_start=acc0)
                 return carry, it, acc0
@@ -578,6 +639,7 @@ def resume_state_multiproc(ctx: ResumeContext, init_fn, Yd):
     # single-process elastic resume still resumes here at its own chain
     # count, with the carried-over divisor bookkeeping below.
     ctx.elastic = None
+    ctx.pod = None
     carry0 = init_fn(ctx.k_init, Yd)
     loaded, failure = None, None
     template = None
@@ -618,6 +680,12 @@ def resume_state_multiproc(ctx: ResumeContext, init_fn, Yd):
             try:
                 meta = read_checkpoint_meta(meta_path)
                 reason = checkpoint_compatible(meta, cfg, ctx.fingerprint)
+                if reason is None:
+                    # host-topology veto (--no-elastic): deterministic
+                    # from meta + env, so every process resolves the
+                    # same refusal and the collective gate below still
+                    # sees unanimous loaded=None
+                    reason = _pod_refusal(meta, cfg)
                 if reason is not None:
                     failure = f"refusing to resume: {reason}"
                 else:
@@ -721,6 +789,7 @@ def resume_state_multiproc(ctx: ResumeContext, init_fn, Yd):
                                    if isinstance(a, jax.Array)
                                    else None), loaded[0])
                     ctx.elastic = _elastic_carryover(smeta2, cfg)
+                    _pod_carryover(ctx, smeta2)
                     record("resume_decision", decision="sidecar",
                            agree=True,
                            iteration=int(smeta2["iteration"]),
@@ -733,6 +802,7 @@ def resume_state_multiproc(ctx: ResumeContext, init_fn, Yd):
                                    if isinstance(a, jax.Array)
                                    else None), s_carry)
             if window > 0:
+                _pod_carryover(ctx, meta)
                 record("resume_decision", decision="light", agree=True,
                        iteration=my_iter, acc_start=my_iter)
                 return loaded[0], my_iter, my_iter
@@ -750,6 +820,7 @@ def resume_state_multiproc(ctx: ResumeContext, init_fn, Yd):
                     "checkpoint_full_every so a .full sidecar exists")
         else:
             ctx.elastic = _elastic_carryover(meta, cfg)
+            _pod_carryover(ctx, meta)
             record("resume_decision", decision="resume", agree=True,
                    kind=("plain" if kind_code == 0 else "set"),
                    iteration=my_iter,
@@ -804,11 +875,13 @@ def rewind_source(ctx: ResumeContext, template):
                 # uniform window, so any earlier elastic bookkeeping
                 # clears with the accumulators
                 ctx.elastic = None
+                _pod_carryover(ctx, r_meta)
                 return c, r_it, r_it
             # the chosen generation's OWN elastic state, always: a
             # rewind past the elastic adoption must also rewind the
             # divisor bookkeeping (a pre-elastic generation clears it)
             ctx.elastic = _elastic_carryover(r_meta, cfg)
+            _pod_carryover(ctx, r_meta)
             return c, r_it, int(r_meta.get("acc_start", 0))
         except Exception:  # dcfm: ignore[DCFM601] - walk the retention chain: next generation is the handling
             continue    # corrupt/unreadable generation: try the next

@@ -55,7 +55,7 @@ from typing import Optional
 import numpy as np
 
 from dcfm_tpu.obs.recorder import record
-from dcfm_tpu.resilience.faults import fault_plan
+from dcfm_tpu.resilience.faults import fault_event, fault_plan
 from dcfm_tpu.utils.preprocess import PreprocessResult
 
 ARTIFACT_FORMAT = "dcfm-posterior-artifact"
@@ -627,6 +627,11 @@ def write_artifact_cooperative(
                 os.unlink(fp)
             with open(fp, "wb") as f:
                 f.truncate(n_pairs * P * P)
+    # crash seams (resilience/faults.py kill_event) BEFORE each barrier:
+    # a host killed here leaves its peers blocked inside the sync - the
+    # exact state the pod supervisor's coordinated stop must reap, and
+    # what the host-elastic fuzz stream (pod_fuzz_spec) sweeps
+    fault_event("coop_export_prepare")
     barrier("dcfm-coop-artifact-prepare")
     lo, hi = cooperative_pair_slice(n_pairs, process_index, process_count)
     for name, panels in ((MEAN_PANELS_FILE, mean_q8),
@@ -637,6 +642,7 @@ def write_artifact_cooperative(
             mm[lo:hi] = np.asarray(panels)[lo:hi]
             mm.flush()
             del mm
+    fault_event("coop_export_panels")
     barrier("dcfm-coop-artifact-panels")
     if process_index == 0:
         crc = {}
@@ -664,6 +670,7 @@ def write_artifact_cooperative(
         record("artifact_write", path=os.path.basename(path),
                source="cooperative", fingerprint=meta["fingerprint"],
                processes=process_count)
+    fault_event("coop_export_meta")
     barrier("dcfm-coop-artifact-meta")
     return PosteriorArtifact.open(path)
 

@@ -43,6 +43,7 @@ from __future__ import annotations
 
 import json
 import os
+import random
 import signal
 import socket
 import subprocess
@@ -170,11 +171,20 @@ class FleetSupervisor:
                 f"in a row (last exit {exit_code}): deterministic "
                 f"breakage, not environmental - see "
                 f"{os.path.join(self.run_dir, f'worker-{w.index}.log')}")
-        # exponential backoff on INSTANT deaths only; a worker that
-        # served for a while earned an immediate respawn
-        delay = (self.backoff_base * (2 ** (w.instant_deaths - 1))
-                 if instant else 0.0)
-        w.respawn_at = now + min(delay, 30.0)
+        # exponential backoff on INSTANT deaths only (a worker that
+        # served for a while earned an immediate respawn), with FULL
+        # jitter under the cap like the fit supervisor's relaunch
+        # backoff: N workers killed by one environmental event must not
+        # respawn in lockstep onto the same cold page cache
+        cap = (min(self.backoff_base * (2 ** (w.instant_deaths - 1)),
+                   30.0)
+               if instant else 0.0)
+        delay = random.uniform(0.0, cap) if cap else 0.0
+        if cap:
+            record("supervisor_backoff", worker=w.index,
+                   seconds=round(delay, 4), cap=round(cap, 4),
+                   next_attempt=w.launch + 1)
+        w.respawn_at = now + delay
 
     # -- status + readiness -------------------------------------------
     def write_status(self) -> None:

@@ -84,9 +84,18 @@ class CheckpointCorruptError(ValueError):
 # never replays a stream), and the writing ``topology``.  v6 files
 # migrate losslessly: uniform starts ([acc_start] * num_chains),
 # fold_draws 0, lineage 0 (elastic_meta).
-_FORMAT_VERSION = 7
+# v8: host-elastic bookkeeping in the META only (payload byte-identical
+# to v6/v7): ``pod_hosts`` - the host (process) count the file's writer
+# ran on, first-class because the host-elastic resume gate compares and
+# narrates it - and ``pod_adoptions``, the number of host-topology
+# adoptions in the chain's lineage (bumped every time a resume crossed
+# a host-count change, so the flight recorder can tell a pod that
+# degraded twice from one that never moved).  v7 and older files
+# migrate losslessly: pod_hosts from the recorded topology (1 when the
+# file predates v7's topology field), pod_adoptions 0 (pod_meta).
+_FORMAT_VERSION = 8
 _LEGACY_DENSE_VERSION = 5
-_LOADABLE_VERSIONS = (_FORMAT_VERSION, 6, _LEGACY_DENSE_VERSION)
+_LOADABLE_VERSIONS = (_FORMAT_VERSION, 7, 6, _LEGACY_DENSE_VERSION)
 
 
 # ChainCarry fields a state-only ("light") save drops.  The accumulators
@@ -211,6 +220,18 @@ def elastic_meta(meta: dict, num_chains: int) -> Tuple[list, int, int]:
         starts = [acc_start] * int(num_chains)
     return ([int(a) for a in starts], int(meta.get("fold_draws", 0)),
             int(meta.get("elastic_lineage", 0)))
+
+
+def pod_meta(meta: dict) -> Tuple[int, int]:
+    """``(pod_hosts, pod_adoptions)`` for a loadable checkpoint's meta -
+    the v8 host-elastic bookkeeping, with the lossless pre-v8 defaults:
+    ``pod_hosts`` falls back to the v7 recorded topology's process count
+    (1 for files that predate the topology field), ``pod_adoptions`` to
+    0 (no host-topology change has ever been crossed)."""
+    hosts = meta.get("pod_hosts")
+    if hosts is None:
+        hosts = (meta.get("topology") or {}).get("num_processes", 1)
+    return int(hosts), int(meta.get("pod_adoptions", 0))
 
 
 def data_fingerprint(data) -> str:
@@ -479,6 +500,7 @@ def save_checkpoint(
     chain_acc_starts=None,
     fold_draws: int = 0,
     elastic_lineage: int = 0,
+    pod_adoptions: int = 0,
 ) -> None:
     """Atomically write chain state + config + data fingerprint.
 
@@ -510,6 +532,7 @@ def save_checkpoint(
     carry = jax.device_get(carry)
     leaves, treedef = jax.tree.flatten(carry)
     num_chains = int(cfg.run.num_chains)
+    topology = _run_topology(num_chains)
     meta = {
         "version": _FORMAT_VERSION,
         "config": _config_to_json(cfg),
@@ -526,7 +549,9 @@ def save_checkpoint(
             else [acc_start] * num_chains)],
         "fold_draws": int(fold_draws),
         "elastic_lineage": int(elastic_lineage),
-        "topology": _run_topology(num_chains),
+        "pod_hosts": int(topology["num_processes"]),
+        "pod_adoptions": int(pod_adoptions),
+        "topology": topology,
     }
     _atomic_savez(path, meta,
                   {f"leaf_{i}": np.asarray(l) for i, l in enumerate(leaves)},
@@ -542,9 +567,9 @@ def strip_checkpoint(src: str, dst: str) -> None:
     iteration."""
     with np.load(src) as z:
         meta = json.loads(bytes(z["__meta__"]).decode())
-        # v6 strips fine (same payload layout as v7); v5 dense files
+        # v6/v7 strip fine (same payload layout as v8); v5 dense files
         # refuse with the version message, not a missing-index error
-        if meta["version"] not in (_FORMAT_VERSION, 6):
+        if meta["version"] not in (_FORMAT_VERSION, 7, 6):
             raise ValueError(
                 f"checkpoint format v{meta['version']} != v{_FORMAT_VERSION}")
         if meta.get("state_only"):
@@ -989,6 +1014,7 @@ def save_checkpoint_multiprocess(
     chain_acc_starts=None,
     fold_draws: int = 0,
     elastic_lineage: int = 0,
+    pod_adoptions: int = 0,
 ) -> None:
     """Multi-host checkpoint: process k atomically writes its own
     ``path.prock-of-N`` with exactly the shard data its devices own - no
@@ -1037,6 +1063,8 @@ def save_checkpoint_multiprocess(
             else [acc_start] * int(cfg.run.num_chains))],
         "fold_draws": int(fold_draws),
         "elastic_lineage": int(elastic_lineage),
+        "pod_hosts": jax.process_count(),
+        "pod_adoptions": int(pod_adoptions),
         "topology": _run_topology(int(cfg.run.num_chains)),
     }
     _atomic_savez(proc_path(path, jax.process_index(), jax.process_count()),

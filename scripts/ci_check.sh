@@ -112,6 +112,17 @@ echo "== elastic kill-window fuzz smoke (4 points) =="
 JAX_PLATFORMS=cpu python scripts/multihost_demo.py --elastic-fuzz 7 0 4 \
     || exit 1
 
+# Host-elastic fuzz smoke: 4 seeded host-loss points through the real
+# 2-process pod (DCFM_FAULT_FUZZ=seed:index:pod) - one host SIGKILLed at
+# a boundary / resume gate / cooperative-export barrier, the supervisor
+# degrades the relaunch to the single survivor, which must adopt the
+# -of-2 set and finish with a Sigma matching the pod reference plus a
+# CRC-clean artifact (or refuse typed) - never hang or skew.  The full
+# 16-point sweep is slow-marked in test_multihost.py.
+echo "== host-elastic pod-loss fuzz smoke (4 points) =="
+JAX_PLATFORMS=cpu python scripts/multihost_demo.py --pod-fuzz 7 0 4 \
+    || exit 1
+
 echo "== tier-1 tests (CPU) =="
 if [ "${CI_ISOLATED:-0}" = "1" ]; then
     # fallback lane: a native abort fails one file, not the whole run.

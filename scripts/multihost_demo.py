@@ -23,7 +23,12 @@ acc_start disagreement on per-host disks), --fuzz SEED N0 N1
 (randomized crash-point fuzz of the supervised pod, DCFM_FAULT_FUZZ),
 --elastic-fuzz SEED N0 N1 (seeded SIGKILL sweep over the elastic
 resume's adoption windows: 4-chain launch killed, relaunch adopts at 2
-chains, DCFM_FAULT_FUZZ=seed:index:elastic).
+chains, DCFM_FAULT_FUZZ=seed:index:elastic), --pod-elastic (HOST-elastic
+degrade acceptance: real SIGKILL of one pod host, the capacity probe
+degrades the relaunch to the single survivor which adopts the -of-2
+checkpoint set; --no-elastic refuses typed), --pod-fuzz SEED N0 N1
+(seeded host-loss sweep over the pod's kill windows,
+DCFM_FAULT_FUZZ=seed:index:pod).
 """
 
 import json
@@ -781,6 +786,306 @@ def parent_fuzz(seed: int, n0: int, n1: int) -> int:
     return 0 if ok else 1
 
 
+def child_pod(process_id: int) -> None:
+    """Host-elastic pod child: like child_sup but the process count comes
+    from DCFM_POD_NPROC (the supervisor's capacity-degraded relaunch runs
+    FEWER hosts over the same 8 global devices), checkpoints are FULL
+    (every boundary resumable without draw loss), and the run ends in the
+    cooperative artifact export whose barrier phases are the pod fuzz's
+    kill windows.  At n=1 the child is plain single-process: no
+    rendezvous with a dead pod, and the resume host-elastically adopts
+    the ``.procK-of-2`` set through the resharded path."""
+    n = int(os.environ.get("DCFM_POD_NPROC", str(NPROC)))
+    devs = (NPROC * DEVS_PER_PROC) // max(n, 1)
+    os.environ["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={devs}")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    if n > 1:
+        from dcfm_tpu.parallel import multihost
+        port = int(os.environ["MULTIHOST_DEMO_PORT"])
+        multihost.initialize(f"127.0.0.1:{port}", n, process_id)
+
+    import numpy as np
+    import dcfm_tpu.api as api
+    from dcfm_tpu import BackendConfig, FitConfig, ModelConfig, RunConfig
+    rng = np.random.default_rng(SEED)
+    p = G * P_SHARD
+    Y = rng.standard_normal((N, p)).astype(np.float32)
+    model = ModelConfig(num_shards=G, factors_per_shard=K, rho=0.9)
+    run = RunConfig(burnin=4, mcmc=4, thin=1, seed=SEED, chunk_size=2)
+    out = os.environ["MULTIHOST_DEMO_DIR"]
+    cfg = FitConfig(model=model, run=run,
+                    backend=BackendConfig(mesh_devices=0 if n > 1
+                                          else devs),
+                    checkpoint_path=os.path.join(out, "pod.ck"),
+                    resume="auto", checkpoint_every_chunks=1,
+                    checkpoint_keep_last=2)
+    res = api.fit(Y, cfg)
+    np.save(os.path.join(out, f"sigma_pod_{n}_{process_id}.npy"),
+            res.Sigma)
+
+    from dcfm_tpu.serve.artifact import export_fit_result_cooperative
+    barrier = None
+    if n > 1:
+        from jax.experimental import multihost_utils
+
+        def barrier(tag):
+            multihost_utils.sync_global_devices(tag)
+
+    export_fit_result_cooperative(
+        res, os.path.join(out, "pod_artifact"),
+        process_index=process_id, process_count=n, barrier=barrier)
+    print("CHILD_POD " + json.dumps({"pid": process_id, "hosts": n}),
+          flush=True)
+
+
+def _verify_artifact(path: str):
+    """Open the cooperative artifact and recompute EVERY panel CRC
+    against meta.json - the demo's "CRC-verified" claim is this check,
+    not just a successful open.  Returns None or a failure string."""
+    from dcfm_tpu.serve.artifact import PosteriorArtifact, panel_crc32
+    import numpy as np
+    try:
+        art = PosteriorArtifact.open(path)
+    except Exception as e:
+        return f"artifact unreadable: {e}"
+    if "mean" not in art.panel_crc:
+        return "artifact has no panel CRCs"
+    for i in range(art.n_pairs):
+        if panel_crc32(np.asarray(art.mean_panels[i])) != int(
+                art.panel_crc["mean"][i]):
+            return f"panel {i} CRC mismatch"
+    return None
+
+
+def _obs_mentions(obs_dir: str, name: str) -> bool:
+    """True when any flight-recorder file in obs_dir narrates ``name``."""
+    try:
+        for root, _, files in os.walk(obs_dir):
+            for fn in files:
+                if not fn.endswith(".jsonl"):
+                    continue
+                with open(os.path.join(root, fn)) as f:
+                    if any(f'"{name}"' in line for line in f):
+                        return True
+    except OSError:
+        pass
+    return False
+
+
+def _run_pod_point(tag, fault_env, port_base, *, degrade,
+                   no_elastic=False):
+    """One supervised host-elastic pod run.
+
+    -> ("ok", info) | ("refused", (name, message)) | ("fail", why).
+    ``degrade=True`` arms the capacity file the supervisor's relaunch
+    pre-pass probes: launch 1 runs the full 2-host pod, and once the
+    injected SIGKILL lands the probe reports 1 surviving host, so every
+    relaunch is the DEGRADED single survivor adopting the ``-of-2`` set.
+    ``no_elastic=True`` sets the veto: the supervisor must refuse typed
+    (PodCapacityError) instead of degrading."""
+    import numpy as np
+    from dcfm_tpu.resilience.supervisor import (
+        PodCapacityError, PodHangError, PoisonedRunError,
+        RetriesExhaustedError, supervise_pod)
+    base_env = _child_env()
+    watchdog = float(os.environ.get("MULTIHOST_FUZZ_WATCHDOG", "420"))
+    with tempfile.TemporaryDirectory() as tmp:
+        env = dict(base_env)
+        env["MULTIHOST_DEMO_DIR"] = tmp
+        env.pop("DCFM_FAULT_PLAN", None)
+        env.pop("DCFM_FAULT_FUZZ", None)
+        env.update(fault_env)
+        logdir = os.path.join(tmp, "logs")
+        os.makedirs(logdir, exist_ok=True)
+        capf = os.path.join(tmp, "capacity")
+        report = {"launches": 0}
+
+        def spawn(attempt: int, n: int) -> list:
+            report["launches"] = attempt
+            if degrade and attempt == 1:
+                # the cluster manager marking the to-be-killed host
+                # lost: written at launch so the post-death capacity
+                # probe (supervisor._pod_capacity) sees 1 survivor
+                with open(capf, "w") as f:
+                    f.write("1")
+            procs = []
+            for i in range(n):
+                e = dict(env)
+                e["MULTIHOST_DEMO_PORT"] = str(port_base + attempt)
+                e["DCFM_POD_NPROC"] = str(n)
+                e["DCFM_FAULT_PROCESS"] = str(i)
+                e["DCFM_FAULT_LAUNCH"] = str(attempt)
+                for k in ("DCFM_OBS_DIR", "DCFM_RUN_ID"):
+                    if k in os.environ:
+                        e[k] = os.environ[k]
+                logf = open(os.path.join(
+                    logdir, f"{tag}_a{attempt}_p{i}.log"), "w")
+                procs.append(subprocess.Popen(
+                    [sys.executable, os.path.abspath(__file__),
+                     "--child-pod", str(i)],
+                    env=e, cwd=_REPO, stdout=logf,
+                    stderr=subprocess.STDOUT))
+                logf.close()
+            return procs
+
+        ck = os.path.join(tmp, "pod.ck")
+        os.environ["DCFM_POD_CAPACITY_FILE"] = capf
+        if no_elastic:
+            os.environ["DCFM_NO_ELASTIC"] = "1"
+        try:
+            supervise_pod(
+                spawn, checkpoint_path=ck, num_processes=NPROC,
+                max_retries=4, poison_deaths=3, backoff_base=0.05,
+                launch_timeout=watchdog, grace=5.0,
+                log=lambda m: None)
+        except PodCapacityError as e:
+            return "refused", (type(e).__name__, str(e))
+        except (PoisonedRunError, RetriesExhaustedError) as e:
+            return "refused", (type(e).__name__, str(e))
+        except PodHangError as e:
+            return "fail", f"DEADLOCK (watchdog): {e}"
+        finally:
+            os.environ.pop("DCFM_POD_CAPACITY_FILE", None)
+            if no_elastic:
+                os.environ.pop("DCFM_NO_ELASTIC", None)
+
+        one = os.path.join(tmp, "sigma_pod_1_0.npy")
+        if os.path.exists(one):
+            hosts, sigma = 1, np.load(one)
+        else:
+            sigmas = []
+            for i in range(NPROC):
+                f = os.path.join(tmp, f"sigma_pod_{NPROC}_{i}.npy")
+                if not os.path.exists(f):
+                    return "fail", f"process {i} exited 0 without Sigma"
+                sigmas.append(np.load(f))
+            if not np.array_equal(sigmas[0], sigmas[1]):
+                return "fail", "cross-host Sigma skew"
+            hosts, sigma = NPROC, sigmas[0]
+        bad = _verify_artifact(os.path.join(tmp, "pod_artifact"))
+        if bad is not None:
+            return "fail", bad
+        obs = ck + ".obs"
+        return "ok", {"sigma": sigma, "hosts": hosts,
+                      "launches": report["launches"],
+                      "degraded_event": _obs_mentions(obs, "pod_degrade"),
+                      "elastic_event": _obs_mentions(obs, "pod_elastic")}
+
+
+def parent_pod_elastic() -> int:
+    """Host-elastic pod acceptance demo: a REAL SIGKILL of one host of
+    the 2-process pod mid-run.  The supervisor's coordinated stop reaps
+    the survivor, the capacity probe reports 1 surviving host, and the
+    relaunch DEGRADES the pod: the single survivor host-elastically
+    adopts the ``.procK-of-2`` checkpoint set (re-partitioning the pair
+    panels onto its 8 devices), finishes the chain, and writes the
+    CRC-verified cooperative artifact.  Pooled Sigma must match the
+    uninterrupted pod run (cross-topology tolerance: Gloo's cross-host
+    reduction order differs from the single-host one).  A second run
+    under ``--no-elastic`` (DCFM_NO_ELASTIC=1) must refuse with a typed
+    PodCapacityError whose message names the fix."""
+    import numpy as np
+    t0 = time.perf_counter()
+    kill = {"DCFM_FAULT_PLAN": json.dumps({"faults": [
+        {"op": "kill", "at_iteration": 4, "when": "post_save",
+         "process": 1, "at_launch": 1}]})}
+
+    status, ref = _run_pod_point("ref", {}, PORT + 2000, degrade=False)
+    if status != "ok" or ref["hosts"] != NPROC:
+        print(f"pod reference run failed: {status} {ref}",
+              file=sys.stderr)
+        return 1
+
+    status, deg = _run_pod_point("deg", kill, PORT + 2100, degrade=True)
+    checks = {}
+    if status != "ok":
+        print(f"degraded run failed: {status} {deg}", file=sys.stderr)
+        return 1
+    checks["relaunch_happened"] = deg["launches"] >= 2
+    checks["degraded_to_one_host"] = deg["hosts"] == 1
+    checks["pod_degrade_narrated"] = deg["degraded_event"]
+    checks["pod_elastic_narrated"] = deg["elastic_event"]
+    checks["sigma_matches_pod_oracle"] = bool(np.allclose(
+        deg["sigma"], ref["sigma"], rtol=1e-4, atol=1e-5))
+    checks["artifact_crc_verified"] = True   # _run_pod_point gates on it
+
+    status, veto = _run_pod_point("veto", kill, PORT + 2200,
+                                  degrade=True, no_elastic=True)
+    checks["no_elastic_refuses_typed"] = (
+        status == "refused" and veto[0] == "PodCapacityError")
+    checks["refusal_names_fix"] = (
+        status == "refused" and "--no-elastic" in veto[1])
+
+    ok = all(checks.values())
+    print(json.dumps({
+        "demo": "host-elastic pod degrade (real SIGKILL, capacity probe)",
+        "checks": checks,
+        "launches": deg["launches"],
+        "refusal": veto[1][:160] if status == "refused" else None,
+        "seconds": round(time.perf_counter() - t0, 1),
+        "ok": ok,
+    }))
+    return 0 if ok else 1
+
+
+def parent_pod_fuzz(seed: int, n0: int, n1: int) -> int:
+    """Randomized host-loss fuzz of the HOST-ELASTIC pod: each index in
+    [n0, n1) expands the seeded pod crash point (faults.pod_fuzz_spec
+    via ``DCFM_FAULT_FUZZ=seed:index:pod``) - one host killed at a
+    checkpoint boundary, inside the multi-host resume gate, or inside a
+    cooperative-export barrier phase - and the supervisor must relaunch
+    DEGRADED onto the single survivor.  Every outcome must be a clean
+    degraded finish (finite Sigma matching the fault-free pod reference
+    within cross-topology tolerance, CRC-verified artifact) or a clean
+    typed refusal.  A hang is bounded by the watchdog and is a FAILURE,
+    as is skew, divergence, or a torn artifact."""
+    import numpy as np
+    t0 = time.perf_counter()
+    status, ref = _run_pod_point("ref", {}, PORT + 2000, degrade=False)
+    if status != "ok" or ref["hosts"] != NPROC:
+        print(f"pod fuzz reference run failed: {status}", file=sys.stderr)
+        return 1
+    outcomes: dict = {}
+    failures = []
+    for idx in range(n0, n1):
+        port_base = PORT + 2300 + (idx % 300) * 8
+        status, detail = _run_pod_point(
+            f"pt{idx}", {"DCFM_FAULT_FUZZ": f"{seed}:{idx}:pod"},
+            port_base, degrade=True)
+        if status == "fail":
+            failures.append((idx, detail))
+            outcome = "FAIL"
+        elif status == "refused":
+            outcome = f"refused:{detail[0]}"
+        elif not np.isfinite(detail["sigma"]).all():
+            failures.append((idx, "non-finite Sigma"))
+            outcome = "FAIL"
+        elif not np.allclose(detail["sigma"], ref["sigma"],
+                             rtol=1e-4, atol=1e-5):
+            failures.append((idx, "Sigma diverged from pod reference "
+                             f"(max {np.abs(detail['sigma'] - ref['sigma']).max()})"))
+            outcome = "FAIL"
+        else:
+            outcome = ("clean:degraded" if detail["hosts"] == 1
+                       else "clean:fullpod")
+        outcomes[outcome] = outcomes.get(outcome, 0) + 1
+        print("POD_FUZZ_POINT "
+              f"{json.dumps({'index': idx, 'outcome': outcome})}",
+              flush=True)
+    ok = not failures
+    print(json.dumps({
+        "demo": "randomized host-loss fuzz of the host-elastic pod",
+        "seed": seed, "points": n1 - n0,
+        "outcomes": outcomes,
+        "failures": failures,
+        "seconds": round(time.perf_counter() - t0, 1),
+        "ok": ok,
+    }))
+    return 0 if ok else 1
+
+
 def child_elastic() -> None:
     """Elastic-fuzz child: a SINGLE-process checkpointing fit whose
     chain count is keyed on the supervised launch number - launch 1
@@ -1136,6 +1441,8 @@ if __name__ == "__main__":
         child_resh_resume(int(sys.argv[2]))
     elif len(sys.argv) > 2 and sys.argv[1] == "--child-sup":
         child_sup(int(sys.argv[2]))
+    elif len(sys.argv) > 2 and sys.argv[1] == "--child-pod":
+        child_pod(int(sys.argv[2]))
     elif len(sys.argv) > 2 and sys.argv[1] == "--child-esig":
         child_esig(int(sys.argv[2]))
     elif len(sys.argv) > 2 and sys.argv[1] == "--child-esig-resume":
@@ -1168,5 +1475,11 @@ if __name__ == "__main__":
         # --elastic-fuzz SEED N0 N1: elastic kill-window fuzz points
         sys.exit(parent_elastic_fuzz(int(sys.argv[2]), int(sys.argv[3]),
                                      int(sys.argv[4])))
+    elif len(sys.argv) > 1 and sys.argv[1] == "--pod-elastic":
+        sys.exit(parent_pod_elastic())
+    elif len(sys.argv) > 1 and sys.argv[1] == "--pod-fuzz":
+        # --pod-fuzz SEED N0 N1: host-loss fuzz of the elastic pod
+        sys.exit(parent_pod_fuzz(int(sys.argv[2]), int(sys.argv[3]),
+                                 int(sys.argv[4])))
     else:
         sys.exit(parent())

@@ -28,6 +28,10 @@ Run:  python scripts/pod_scale_demo.py          (~4-8 min on 8 virtual CPUs)
           python scripts/pod_scale_demo.py      (full run + rel-err, ~7 min)
       PODDEMO_SPARSE=1 PODDEMO_P=500000 \\
           python scripts/pod_scale_demo.py      (scale-out ingest lane, ~2 min)
+      PODDEMO_PODSCALE=1 python scripts/pod_scale_demo.py
+          (PODSCALE acceptance: p=1e6 sparse ingest -> HOST-SHARDED fit
+           across a real 2-process pod -> CRC-verified cooperative
+           artifact, per-host peak RSS in one JSON line; ~5-10 min)
 
 Sparse lane (PODDEMO_SPARSE=1): PODDEMO_P is reinterpreted as the TOTAL
 feature count p (default 500,000), not the shard width.  A synthetic
@@ -406,7 +410,191 @@ def run_sparse_demo(p_total=500_000, n=64, density=0.01, n_devices=8,
     return out
 
 
+def _podscale_child(process_id: int) -> None:
+    """One host of the 2-process PODSCALE pod (spawned by
+    run_podscale_demo): full-width sparse ingest, host-sliced streaming
+    placement on the pod mesh, a host-sharded fit of the pod slice
+    through api.fit, and the cooperative artifact export - reporting
+    THIS host's peak RSS so the parent can bound both."""
+    import json
+    import resource
+
+    from dcfm_tpu.parallel import multihost
+
+    nproc = int(os.environ.get("PODSCALE_NPROC", "2"))
+    port = int(os.environ["PODSCALE_PORT"])
+    multihost.initialize(f"127.0.0.1:{port}", nproc, process_id)
+    assert jax.process_count() == nproc
+
+    from dcfm_tpu.api import fit
+    from dcfm_tpu.config import (
+        BackendConfig, FitConfig, ModelConfig, RunConfig)
+    from dcfm_tpu.parallel.mesh import make_pod_mesh
+    from dcfm_tpu.parallel.shard import place_sharded_streaming
+    from dcfm_tpu.serve.promote import verify_candidate
+    from dcfm_tpu.utils.preprocess import preprocess
+
+    p_total = int(os.environ.get("PODSCALE_P", 1_000_000))
+    n = int(os.environ.get("PODSCALE_N", 64))
+    density = float(os.environ.get("PODSCALE_DENSITY", 0.002))
+    fit_shards = int(os.environ.get("PODSCALE_FIT_SHARDS", 64))
+    iters = int(os.environ.get("PODSCALE_ITERS", 3))
+    seed = 0
+    n_devices = jax.device_count()
+
+    rng = np.random.default_rng(seed)
+    t0 = time.perf_counter()
+    sp = _synth_sparse_csc(n, p_total, density, rng)
+    t_build = time.perf_counter() - t0
+    nnz = int(sp.indptr[-1])
+    stored_mb = (sp.data.nbytes + sp.indices.nbytes
+                 + sp.indptr.nbytes) / 1e6
+
+    g_full = -(-p_total // 196)
+    g_full += (-g_full) % n_devices
+    t0 = time.perf_counter()
+    pre = preprocess(sp, g_full, seed=seed)
+    t_ingest = time.perf_counter() - t0
+    assert pre.is_lazy, "sparse input must take the streaming path"
+
+    # Full-width placement on the POD mesh: place_sharded_streaming
+    # materializes ONLY this host's shard slice (the L1 contract) - the
+    # full (g, n, P) dense block never exists on any single host.
+    mesh = make_pod_mesh(nproc, 0)
+    t0 = time.perf_counter()
+    Yd = place_sharded_streaming(pre.data, mesh)
+    jax.block_until_ready(Yd)
+    t_place = time.perf_counter() - t0
+    placed_shape = tuple(int(d) for d in Yd.shape)
+    del Yd
+
+    # Host-sharded pod-slice fit through the public API (mesh_devices=0
+    # in a multi-process run -> api.fit builds the pod mesh itself).
+    P_full = int(pre.data.shape[2])
+    fit_p = fit_shards * P_full
+    sp_fit = _csc_column_slice(sp, 0, fit_p)
+    cfg = FitConfig(
+        model=ModelConfig(num_shards=fit_shards, factors_per_shard=2,
+                          rho=0.9, combine_chunks=16),
+        run=RunConfig(burnin=max(iters - 1, 0), mcmc=1, thin=1,
+                      seed=seed),
+        backend=BackendConfig(mesh_devices=0))
+    t0 = time.perf_counter()
+    res = fit(sp_fit, cfg)
+    t_fit = time.perf_counter() - t0
+    assert res.Sigma is None, "lazy fit must not materialize dense Sigma"
+
+    from dcfm_tpu.serve.artifact import export_fit_result_cooperative
+    from jax.experimental import multihost_utils
+
+    def barrier(tag):
+        multihost_utils.sync_global_devices(tag)
+
+    art_dir = os.path.join(os.environ["PODSCALE_DIR"], "artifact")
+    t0 = time.perf_counter()
+    export_fit_result_cooperative(
+        res, art_dir, process_index=process_id, process_count=nproc,
+        barrier=barrier)
+    t_export = time.perf_counter() - t0
+    verified = None
+    if process_id == 0:
+        art = verify_candidate(art_dir)     # full CRC sweep
+        assert art.meta["p_original"] == fit_p
+        verified = True
+
+    peak_rss_mb = resource.getrusage(
+        resource.RUSAGE_SELF).ru_maxrss / 1024
+    print("PODSCALE_CHILD " + json.dumps(dict(
+        host=process_id, hosts=nproc, ingest_p=p_total,
+        p_used=pre.p_used, g_full=g_full, n=n, nnz=nnz,
+        stored_mb=round(stored_mb, 2), build_s=round(t_build, 3),
+        ingest_s=round(t_ingest, 3), place_s=round(t_place, 3),
+        placed_shape=list(placed_shape), fit_p=fit_p,
+        fit_shards=fit_shards, iters=iters, fit_s=round(t_fit, 3),
+        export_s=round(t_export, 3), artifact_verified=verified,
+        peak_rss_mb=round(peak_rss_mb, 1))), flush=True)
+
+
+def run_podscale_demo(verbose=True):
+    """PODSCALE acceptance (ROADMAP item 2): sparse ingest -> HOST-SHARDED
+    fit -> CRC-verified cooperative artifact at p=1e6 across a real
+    2-process pod, with BOTH hosts' peak RSS in the one honest JSON line.
+    Each host ingests the full-width sparse matrix (O(nnz), ~MBs), but
+    the dense placed data and the quadratic fit state exist only as
+    per-host slices of the pod mesh."""
+    import json
+    import subprocess
+    import tempfile
+
+    nproc = int(os.environ.get("PODSCALE_NPROC", "2"))
+    port = int(os.environ.get("PODSCALE_PORT", 29917))
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = os.pathsep.join(
+        [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+        + [p for p in env.get("PYTHONPATH", "").split(os.pathsep) if p])
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        ".jax_cache"))
+    env["PODSCALE_PORT"] = str(port)
+    with tempfile.TemporaryDirectory() as tmp:
+        env["PODSCALE_DIR"] = tmp
+        procs = [subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__),
+             "--podscale-child", str(i)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True) for i in range(nproc)]
+        childs = {}
+        try:
+            for i, proc in enumerate(procs):
+                out, _ = proc.communicate(timeout=3600)
+                if proc.returncode != 0:
+                    print(f"podscale child {i} rc={proc.returncode}\n"
+                          f"{out[-3000:]}", file=sys.stderr)
+                    return 1
+                for line in out.splitlines():
+                    if line.startswith("PODSCALE_CHILD "):
+                        childs[i] = json.loads(line[15:])
+        finally:
+            for proc in procs:
+                if proc.poll() is None:
+                    proc.kill()
+    if len(childs) != nproc:
+        print("podscale children produced no reports", file=sys.stderr)
+        return 1
+    h0 = childs[0]
+    out = dict(
+        mode="podscale", hosts=nproc,
+        ingest_p=h0["ingest_p"], p_used=h0["p_used"],
+        g_full=h0["g_full"], n=h0["n"], nnz=h0["nnz"],
+        stored_mb=h0["stored_mb"], ingest_s=h0["ingest_s"],
+        place_s=h0["place_s"], placed_shape=h0["placed_shape"],
+        fit_p=h0["fit_p"], fit_shards=h0["fit_shards"],
+        iters=h0["iters"], fit_s=h0["fit_s"],
+        export_s=h0["export_s"],
+        artifact_verified=bool(h0["artifact_verified"]),
+        per_host_peak_rss_mb=[childs[i]["peak_rss_mb"]
+                              for i in range(nproc)])
+    ok = out["artifact_verified"] and out["ingest_p"] >= 1_000_000
+    if verbose:
+        print("PODSCALE " + json.dumps(out))
+        print(f"ingested p={out['ingest_p']:,} on each of {nproc} hosts, "
+              f"placed {out['placed_shape']} host-sliced on the pod "
+              f"mesh, host-sharded fit of the {out['fit_shards']}-shard "
+              f"pod slice ({out['fit_s']:.1f}s), cooperative artifact "
+              f"CRC-verified={out['artifact_verified']}; per-host peak "
+              f"RSS {out['per_host_peak_rss_mb']} MB")
+        print("OK" if ok else "FAILED")
+    return 0 if ok else 1
+
+
 if __name__ == "__main__":
+    if len(sys.argv) > 2 and sys.argv[1] == "--podscale-child":
+        _podscale_child(int(sys.argv[2]))
+        sys.exit(0)
+    if bool(int(os.environ.get("PODDEMO_PODSCALE", "0"))):
+        sys.exit(run_podscale_demo())
     if bool(int(os.environ.get("PODDEMO_SPARSE", "0"))):
         run_sparse_demo(
             p_total=int(os.environ.get("PODDEMO_P", 500_000)),

@@ -239,10 +239,10 @@ def test_grow_mixed_age_diagnostics_finite(grow):
         assert np.isfinite(val) and val > 0, (name, val)
 
 
-def test_grow_saves_v7_elastic_meta(grow):
+def test_grow_saves_elastic_meta(grow):
     res, ck = grow
     meta = read_checkpoint_meta(ck)
-    assert meta["version"] == 7
+    assert meta["version"] == 8
     assert list(meta["chain_acc_starts"]) == [0, 0, 32, 32]
     assert meta["fold_draws"] == 0
     assert meta["elastic_lineage"] == 1
@@ -318,7 +318,7 @@ def test_v6_checkpoint_migrates_losslessly(donor4_at32, shrink, data,
     acc_start, nothing folded, lineage 0) are exactly what the donor's
     v7 meta records - so an elastic adoption of the v6 twin must land
     bit-for-bit on the v7 shrink result, and the first save after the
-    adoption re-records everything as v7."""
+    adoption re-records everything at the current format."""
     v6 = str(tmp_path / "ck.npz")
     _rewrite_as_v6(donor4_at32, v6)
     meta = read_checkpoint_meta(v6)
@@ -334,9 +334,136 @@ def test_v6_checkpoint_migrates_losslessly(donor4_at32, shrink, data,
     np.testing.assert_array_equal(res.sigma_blocks,
                                   shrink[0].sigma_blocks)
     m2 = read_checkpoint_meta(v6)
-    assert m2["version"] == 7
+    assert m2["version"] == 8
     assert list(m2["chain_acc_starts"]) == [0, 0]
     assert m2["fold_draws"] == 16
+
+
+def _rewrite_as_v7(src, dst):
+    """A byte-faithful v7 twin: same payload leaves (same CRCs), meta
+    stripped of the v8 host-elastic keys."""
+    with np.load(src) as z:
+        arrays = {k: np.array(z[k]) for k in z.files}
+    meta = json.loads(bytes(arrays.pop("__meta__")).decode())
+    meta["version"] = 7
+    for key in ("pod_hosts", "pod_adoptions"):
+        meta.pop(key, None)
+    arrays["__meta__"] = np.frombuffer(
+        json.dumps(meta).encode(), dtype=np.uint8)
+    np.savez(dst, **arrays)
+
+
+def test_v7_checkpoint_migrates_losslessly(donor4_at32, shrink, data,
+                                           tmp_path):
+    """v7 carries no host-elastic meta; its defaults (writer host count
+    from the v7 topology record, zero adoptions) are exactly what the
+    donor's v8 meta records - so an adoption of the v7 twin must land
+    bit-for-bit on the v8 shrink result WITHOUT a spurious pod-adoption
+    bump, and the first save re-records everything at v8."""
+    from dcfm_tpu.utils.checkpoint import pod_meta
+
+    v7 = str(tmp_path / "ck.npz")
+    _rewrite_as_v7(donor4_at32, v7)
+    meta = read_checkpoint_meta(v7)
+    assert meta["version"] == 7
+    assert pod_meta(meta) == (1, 0)
+
+    run = dataclasses.replace(_cfg().run, num_chains=2)
+    cfg = dataclasses.replace(
+        _cfg(), run=run, checkpoint_path=v7, checkpoint_every_chunks=1,
+        checkpoint_keep_last=2, resume=True)
+    res = fit(data, cfg)
+    np.testing.assert_array_equal(res.sigma_blocks,
+                                  shrink[0].sigma_blocks)
+    m2 = read_checkpoint_meta(v7)
+    assert m2["version"] == 8
+    assert pod_meta(m2) == (1, 0)
+
+
+def _transcribe_as_pod_set(src, base, hosts=2):
+    """Rewrite a plain checkpoint as a complete ``.procK-of-H`` set from
+    an H-host pod (every leaf replicated - the scatter arithmetic has
+    its own lossless test): the donor every host-elastic adoption test
+    resumes."""
+    from dcfm_tpu.utils.checkpoint import _atomic_savez, proc_path
+    with np.load(src) as z:
+        meta = json.loads(bytes(z["__meta__"]).decode())
+        leaves = {k: np.array(z[k]) for k in z.files if k != "__meta__"}
+    meta["process_count"] = hosts
+    meta["pod_hosts"] = hosts
+    meta["leaf_meta"] = [{"mode": "replicated"} for _ in leaves]
+    for i in range(hosts):
+        meta["process_index"] = i
+        _atomic_savez(proc_path(base, i, hosts), meta, leaves)
+
+
+def test_pod_set_adoption_matches_single_host_oracle(donor2_at32, data,
+                                                     tmp_path):
+    """H=2 -> H'=1: a checkpoint SET written by a 2-host pod, resumed
+    single-process, must finish bitwise-identical to resuming the same
+    chain state from the plain file (the combined-estimate oracle), and
+    the save after the adoption must record the bumped adoption counter
+    at the new host count."""
+    from dcfm_tpu.utils.checkpoint import pod_meta
+
+    oracle_ck = str(tmp_path / "oracle.npz")
+    shutil.copy(donor2_at32, oracle_ck)
+    run = dataclasses.replace(_cfg().run, num_chains=2)
+    cfg = dataclasses.replace(
+        _cfg(), run=run, checkpoint_path=oracle_ck,
+        checkpoint_every_chunks=1, checkpoint_keep_last=2, resume=True)
+    oracle = fit(data, cfg)
+
+    base = str(tmp_path / "pod.npz")
+    _transcribe_as_pod_set(donor2_at32, base, hosts=2)
+    res = fit(data, dataclasses.replace(cfg, checkpoint_path=base))
+    np.testing.assert_array_equal(res.sigma_blocks, oracle.sigma_blocks)
+
+    m2 = read_checkpoint_meta(base)
+    assert pod_meta(m2) == (1, 1)     # 1 host now, 1 adoption recorded
+
+
+def test_pod_set_adoption_strict_gate_names_the_fix(donor2_at32, data,
+                                                    tmp_path):
+    """elastic=False must refuse the foreign-host-count set with the
+    CONCRETE repair: which host counts disagree and both ways out."""
+    base = str(tmp_path / "pod.npz")
+    _transcribe_as_pod_set(donor2_at32, base, hosts=2)
+    run = dataclasses.replace(_cfg().run, num_chains=2)
+    cfg = dataclasses.replace(
+        _cfg(), run=run, checkpoint_path=base, resume=True,
+        elastic=False)
+    with pytest.raises(ValueError, match="written by a 2-host pod"):
+        fit(data, cfg)
+    try:
+        fit(data, cfg)
+    except ValueError as e:
+        assert "drop\n--no-elastic" in str(e) or "--no-elastic" in str(e)
+        assert "--pod 2" in str(e)
+
+
+def test_events_narrate_pod_adoption(donor2_at32, data, tmp_path):
+    """`dcfm-tpu events` narrates the host-elastic adoption beside the
+    resume decisions: 'pod adopted ... 2 -> 1 host(s)'."""
+    from dcfm_tpu.obs.cli import _print_summary, summarize
+
+    base = str(tmp_path / "pod.npz")
+    _transcribe_as_pod_set(donor2_at32, base, hosts=2)
+    run = dataclasses.replace(_cfg().run, num_chains=2)
+    cfg = dataclasses.replace(
+        _cfg(), run=run, checkpoint_path=base,
+        checkpoint_every_chunks=1, checkpoint_keep_last=2, resume=True)
+    fit(data, cfg)
+    s = summarize(base + ".obs")
+    assert s["pod_adoptions"], s
+    a = s["pod_adoptions"][0]
+    assert (a["from_hosts"], a["to_hosts"]) == (2, 1)
+    assert a["pod_adoptions"] == 1
+    out = []
+    _print_summary(s, out)
+    text = "\n".join(out)
+    assert "pod adopted" in text
+    assert "2 -> 1 host(s)" in text
 
 
 # ---------------------------------------------------------------------------

@@ -177,6 +177,44 @@ def test_multihost_crash_fuzz_sweep_50_points():
     assert '"ok": true' in proc.stdout
 
 
+@pytest.mark.slow
+def test_multihost_pod_elastic_degrade():
+    # Host-elastic acceptance: a REAL SIGKILL of one pod host mid-run;
+    # the supervisor's capacity probe reports 1 survivor and the
+    # relaunch DEGRADES - the single survivor adopts the -of-2 set,
+    # finishes with a Sigma matching the uninterrupted pod run, writes
+    # a CRC-verified cooperative artifact, and the flight recorder
+    # narrates pod_degrade + pod_elastic.  --no-elastic must refuse
+    # with a typed PodCapacityError naming the fix.
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "scripts", "multihost_demo.py"),
+         "--pod-elastic"],
+        env=_demo_env(29935), cwd=_REPO, capture_output=True, text=True,
+        timeout=1200)
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    assert '"degraded_to_one_host": true' in proc.stdout
+    assert '"no_elastic_refuses_typed": true' in proc.stdout
+    assert '"ok": true' in proc.stdout
+
+
+@pytest.mark.slow
+def test_multihost_pod_loss_fuzz_sweep_16_points():
+    # The host-elastic acceptance sweep: 16 seeded host-loss points
+    # (DCFM_FAULT_FUZZ=seed:index:pod) - one host killed at a checkpoint
+    # boundary, inside the multi-host resume gate, or inside a
+    # cooperative-export barrier phase - each relaunched DEGRADED onto
+    # the single survivor.  Every outcome must be a clean degraded
+    # finish (Sigma matching the pod reference, CRC-clean artifact) or
+    # a typed refusal; hangs are bounded by the watchdog and fail.
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "scripts", "multihost_demo.py"),
+         "--pod-fuzz", "20260807", "0", "16"],
+        env=_demo_env(29941), cwd=_REPO, capture_output=True, text=True,
+        timeout=5400)
+    assert proc.returncode == 0, proc.stdout[-3000:] + proc.stderr[-2000:]
+    assert '"ok": true' in proc.stdout
+
+
 def test_initialize_from_env_noop_without_vars():
     # in-process check of the no-op contract (no coordinator set)
     env_backup = {k: os.environ.pop(k, None)

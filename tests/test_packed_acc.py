@@ -209,11 +209,11 @@ def test_dense_v5_checkpoint_migrates_and_resumes_exactly(tmp_path):
     run_long = dataclasses.replace(run_short, mcmc=20)
     fit(Y, FitConfig(model=model, run=run_short, checkpoint_path=ck))
 
-    # rewrite the packed v7 file in the legacy dense v5 layout
+    # rewrite the packed v8 file in the legacy dense v5 layout
     with np.load(ck) as z:
         entries = {k: z[k] for k in z.files}
     meta = json.loads(bytes(entries["__meta__"]).decode())
-    assert meta["version"] == 7
+    assert meta["version"] == 8
     rows, cols = packed_pair_indices(g)
     n_pairs = num_upper_pairs(g)
     r, c = rows[:n_pairs], cols[:n_pairs]
@@ -231,10 +231,11 @@ def test_dense_v5_checkpoint_migrates_and_resumes_exactly(tmp_path):
     meta["version"] = 5
     # drop the config key v5 never had (RunConfig grew sweep_unroll in v6)
     meta["config"]["run"].pop("sweep_unroll", None)
-    # ...and the elastic bookkeeping v7 added (real v5 files carry none;
-    # the loader defaults them - utils/checkpoint.elastic_meta)
+    # ...and the elastic bookkeeping v7 added plus the v8 pod keys (real
+    # v5 files carry none; the loaders default them -
+    # utils/checkpoint.elastic_meta / pod_meta)
     for k in ("chain_acc_starts", "fold_draws", "elastic_lineage",
-              "topology"):
+              "topology", "pod_hosts", "pod_adoptions"):
         meta.pop(k, None)
     # drop the integrity map too: real pre-CRC v5 files carry none, and
     # the v6 file's per-leaf CRCs describe the PACKED layout this rewrite
@@ -252,7 +253,7 @@ def test_dense_v5_checkpoint_migrates_and_resumes_exactly(tmp_path):
     # ...and the rewritten file is re-saved packed (current format) at
     # the new end
     from dcfm_tpu.utils.checkpoint import read_checkpoint_meta
-    assert read_checkpoint_meta(ck)["version"] == 7
+    assert read_checkpoint_meta(ck)["version"] == 8
 
 
 def test_fetch_reads_packed_natively():

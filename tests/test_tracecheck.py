@@ -26,8 +26,8 @@ from dcfm_tpu.analysis.registry import (SkipEntry, TraceKeyRegistry,
                                         TraceSpec, discover, entries, get,
                                         register_trace_entry)
 from dcfm_tpu.analysis.rules import TRACE_RULES
-from dcfm_tpu.parallel.mesh import (CHAIN_AXIS, SHARD_AXIS,
-                                    make_chain_mesh,
+from dcfm_tpu.parallel.mesh import (CHAIN_AXIS, HOST_AXIS, SHARD_AXIS,
+                                    make_chain_mesh, make_pod_mesh,
                                     match_partition_rules)
 from dcfm_tpu.parallel.shard import shard_map
 
@@ -75,6 +75,39 @@ def _shards_psum_spec():
                    in_specs=P(CHAIN_AXIS, SHARD_AXIS),
                    out_specs=P(CHAIN_AXIS, None))
     return TraceSpec(fn=fn, args=(_sds((2, 2)),), mesh=mesh)
+
+
+@register_trace_entry("fixture.hosts_psum", sweep_body=True)
+def _hosts_psum_spec():
+    """A sweep body that pools over the hosts axis alone: partial
+    per-host state mixes mid-sweep, the DCFM1808 violation."""
+    mesh = make_pod_mesh(2, 8)
+
+    def body(x):
+        leaked = jax.lax.psum(x, HOST_AXIS)            # the violation
+        return leaked + jax.lax.psum(x, SHARD_AXIS)    # this one is fine
+
+    fn = shard_map(body, mesh=mesh,
+                   in_specs=P((HOST_AXIS, SHARD_AXIS)),
+                   out_specs=P(None))
+    return TraceSpec(fn=fn, args=(_sds((8,)),), mesh=mesh)
+
+
+@register_trace_entry("fixture.pair_psum", sweep_body=True)
+def _pair_psum_spec():
+    """The sanctioned twin: the X-update/conquer shape, reducing over
+    the FULL (hosts, shards) pair axis in one collective."""
+    mesh = make_pod_mesh(2, 8)
+
+    def body(x):
+        full = jax.lax.psum(x, (HOST_AXIS, SHARD_AXIS))
+        off = jax.lax.axis_index(HOST_AXIS)            # coordinates: exempt
+        return full + off.astype(_f32)
+
+    fn = shard_map(body, mesh=mesh,
+                   in_specs=P((HOST_AXIS, SHARD_AXIS)),
+                   out_specs=P(None))
+    return TraceSpec(fn=fn, args=(_sds((8,)),), mesh=mesh)
 
 
 @register_trace_entry("fixture.bf16_leak")
@@ -187,6 +220,18 @@ def test_chains_spanning_psum_fires_1802():
 
 def test_shard_axis_psum_is_sanctioned():
     assert tracecheck.check_entry(get("fixture.shards_psum")) == []
+
+
+def test_hosts_only_psum_fires_1808():
+    findings = tracecheck.check_entry(get("fixture.hosts_psum"))
+    assert {f.rule for f in findings} == {"DCFM1808"}
+    assert len(findings) == 1
+    assert "'hosts'" in findings[0].message
+    assert "X update" in findings[0].message
+
+
+def test_full_pair_psum_and_host_axis_index_are_sanctioned():
+    assert tracecheck.check_entry(get("fixture.pair_psum")) == []
 
 
 def test_bf16_leak_in_f32_graph_fires_1803():
